@@ -1,0 +1,54 @@
+"""accelerate_tpu — a TPU-native training-acceleration framework.
+
+Brand-new JAX/XLA/Pallas re-design with the capability surface of the
+reference HuggingFace-Accelerate fork (see SURVEY.md): a user writes a plain
+training step; the framework supplies device meshes, GSPMD sharding (DP/FSDP/
+HSDP/TP/CP/SP/EP), mixed precision, data sharding, checkpointing,
+observability, and a launcher CLI.
+"""
+
+__version__ = "0.1.0"
+
+from .parallelism_config import MESH_AXIS_ORDER, ParallelismConfig
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.dataclasses import (
+    AutocastKwargs,
+    ContextParallelConfig,
+    DataLoaderConfiguration,
+    DistributedOperationException,
+    DistributedType,
+    ExpertParallelConfig,
+    FP8RecipeKwargs,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    GradSyncKwargs,
+    InitProcessGroupKwargs,
+    MixedPrecisionType,
+    ProfileKwargs,
+    ProjectConfiguration,
+    SequenceParallelConfig,
+    ShardingStrategy,
+    TensorParallelConfig,
+)
+
+# Populated as modules land; guarded so partial builds stay importable.
+try:
+    from .accelerator import Accelerator
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .data_loader import prepare_data_loader, skip_first_batches
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .big_modeling import init_empty_weights, load_checkpoint_and_dispatch
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .utils.random import set_seed, synchronize_rng_states
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .launchers import debug_launcher, notebook_launcher
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,89 @@
+"""In-jit collectives over named mesh axes — the GSPMD hot-path plane.
+
+These are the explicit collectives used inside ``shard_map`` bodies (ring
+attention KV rotation, Ulysses all-to-alls, MoE dispatch).  Everything else in
+the framework relies on *implicit* collectives: XLA derives psum/all-gather/
+reduce-scatter from sharding annotations on jitted computations — the
+TPU-native replacement for the reference's NCCL calls (SURVEY §2.5).
+
+Axis-name arguments accept a single name or a tuple (joint dims like
+``("dp_replicate", "dp_shard")`` — the reference's flattened mesh dims,
+parallelism_config.py:157-164).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisNames = Union[str, Sequence[str]]
+
+
+def _normalize(axis_names: AxisNames):
+    if isinstance(axis_names, str):
+        return axis_names
+    return tuple(axis_names)
+
+
+def psum(x, axis_names: AxisNames):
+    """All-reduce sum across mesh axes (NCCL all_reduce analog)."""
+    return lax.psum(x, _normalize(axis_names))
+
+
+def pmean(x, axis_names: AxisNames):
+    return lax.pmean(x, _normalize(axis_names))
+
+
+def pmax(x, axis_names: AxisNames):
+    return lax.pmax(x, _normalize(axis_names))
+
+
+def pmin(x, axis_names: AxisNames):
+    return lax.pmin(x, _normalize(axis_names))
+
+
+def all_gather(x, axis_names: AxisNames, axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis`` (NCCL all_gather analog)."""
+    return lax.all_gather(x, _normalize(axis_names), axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_names: AxisNames, axis: int = 0):
+    """Sum-reduce then scatter along ``axis`` (NCCL reduce_scatter analog)."""
+    return lax.psum_scatter(x, _normalize(axis_names), scatter_dimension=axis, tiled=True)
+
+
+def ppermute(x, axis_name: str, perm: Sequence[tuple[int, int]]):
+    """Point-to-point ring permutation — the KV-rotation primitive for ring
+    attention (reference CP 'alltoall' rotate, accelerator.py:1641-1654)."""
+    return lax.ppermute(x, axis_name, perm)
+
+
+def ring_permute(x, axis_name: str, shift: int = 1):
+    """Rotate shards around the ring by ``shift`` (ICI-neighbor traffic)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int, tiled: bool = True):
+    """All-to-all resharding — the Ulysses heads<->sequence swap primitive
+    (reference UlyssesSPAttentionHF, accelerator.py:2370-2394)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str):
+    return lax.axis_size(axis_name)
+
+
+def broadcast_from(x, axis_name: str, src: int = 0):
+    """Broadcast the ``src`` shard to all members of the axis."""
+    n = lax.axis_size(axis_name)
+    full = lax.all_gather(x, axis_name, axis=0, tiled=False)
+    return full[src]

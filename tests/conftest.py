@@ -1,0 +1,49 @@
+"""Test harness config: an 8-device virtual CPU mesh, no TPU required.
+
+SURVEY §4 'Implication for the TPU build': unit tests run on a fake 8-device
+CPU mesh via ``--xla_force_host_platform_device_count=8`` — strictly better
+than the reference's subprocess-only multi-device story.  Subprocess
+self-launch tests (tests/test_launch.py) still exercise the real launcher.
+"""
+
+import os
+
+# Must run before JAX's backend initializes.  Force CPU even when a real TPU
+# platform (e.g. axon tunnel) is present — unit tests always use the virtual
+# 8-device mesh; bench.py exercises the real chip.  jax may already be
+# imported by a sitecustomize, so env vars alone are not enough — use
+# jax.config.update, which works pre-backend-init either way.
+os.environ["JAX_PLATFORMS"] = os.environ.get("ACCELERATE_TEST_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+if os.environ["JAX_PLATFORMS"] == "cpu":
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Singleton hygiene between tests (reference AccelerateTestCase.tearDown
+    resets AcceleratorState, testing.py:650-661)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+@pytest.fixture
+def mesh8():
+    import jax
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    cfg = ParallelismConfig(dp_shard_size=8)
+    return cfg.build_device_mesh(jax.devices())

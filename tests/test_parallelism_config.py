@@ -1,0 +1,87 @@
+"""Mesh construction tests (mirror of reference tests/test_parallelism_config)."""
+
+import jax
+import pytest
+
+from accelerate_tpu.parallelism_config import MESH_AXIS_ORDER, ParallelismConfig
+from accelerate_tpu.utils.environment import patch_environment
+
+
+def test_default_single():
+    cfg = ParallelismConfig()
+    assert cfg.total_size == 1
+
+
+def test_dp_shard_mesh():
+    cfg = ParallelismConfig(dp_shard_size=8)
+    mesh = cfg.build_device_mesh()
+    assert mesh.shape["dp_shard"] == 8
+    assert all(mesh.shape[ax] == 1 for ax in MESH_AXIS_ORDER if ax != "dp_shard")
+
+
+def test_2d_mesh():
+    cfg = ParallelismConfig(dp_shard_size=4, tp_size=2)
+    mesh = cfg.build_device_mesh()
+    assert mesh.shape["dp_shard"] == 4
+    assert mesh.shape["tp"] == 2
+
+
+def test_hsdp_mesh():
+    cfg = ParallelismConfig(dp_replicate_size=2, dp_shard_size=4)
+    mesh = cfg.build_device_mesh()
+    assert mesh.shape["dp_replicate"] == 2
+    assert mesh.shape["dp_shard"] == 4
+    assert cfg.dp_dim_names == ("dp_replicate", "dp_shard")
+
+
+def test_infer_dp_shard():
+    cfg = ParallelismConfig(dp_shard_size=-1, tp_size=2)
+    mesh = cfg.build_device_mesh()
+    assert cfg.dp_shard_size == 4
+    assert mesh.shape["dp_shard"] == 4
+
+
+def test_size_mismatch_raises():
+    cfg = ParallelismConfig(dp_shard_size=3)
+    with pytest.raises(ValueError):
+        cfg.build_device_mesh()
+
+
+def test_cp_sp_mutually_exclusive():
+    cfg = ParallelismConfig(cp_size=2, sp_size=2, dp_shard_size=2)
+    with pytest.raises(ValueError):
+        cfg.build_device_mesh()
+
+
+def test_joint_dims():
+    cfg = ParallelismConfig(dp_shard_size=2, cp_size=2, tp_size=2)
+    assert cfg.dp_shard_cp_dim_names == ("dp_shard", "cp")
+    assert cfg.dp_cp_dim_names == ("dp_shard", "cp")
+    assert cfg.fsdp_dim_names == ("dp_shard", "cp")
+    assert cfg.seq_dim_names == ("cp",)
+    assert cfg.non_data_parallel_size == 4
+    assert cfg.data_parallel_size == 2
+
+
+def test_env_roundtrip():
+    cfg = ParallelismConfig(dp_replicate_size=2, dp_shard_size=2, tp_size=2)
+    with patch_environment(**cfg.to_env()):
+        cfg2 = ParallelismConfig.from_env()
+    assert cfg2.dp_replicate_size == 2
+    assert cfg2.dp_shard_size == 2
+    assert cfg2.tp_size == 2
+    assert cfg2.cp_size == 1
+
+
+def test_batch_spec():
+    from jax.sharding import PartitionSpec as P
+
+    cfg = ParallelismConfig(dp_shard_size=4, cp_size=2)
+    spec = cfg.batch_spec(seq_axis=1, ndim=3)
+    assert spec == P(("dp_shard",), ("cp",), None)
+
+
+def test_mesh_canonical_order():
+    cfg = ParallelismConfig(dp_shard_size=8)
+    mesh = cfg.build_device_mesh()
+    assert tuple(mesh.axis_names) == MESH_AXIS_ORDER

@@ -1,0 +1,100 @@
+"""Tests for state singletons (mirror of reference tests/test_state_checkpointing
++ test_accelerator state behaviors)."""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.utils.dataclasses import DistributedType, GradientAccumulationPlugin
+
+
+def test_partial_state_singleton():
+    s1 = PartialState()
+    s2 = PartialState()
+    assert s1.__dict__ is s2.__dict__
+    assert s1.num_devices == len(jax.devices())
+    assert s1.num_processes == 1
+    assert s1.is_main_process
+    assert s1.distributed_type in (DistributedType.MULTI_DEVICE, DistributedType.NO)
+
+
+def test_partial_state_reset():
+    s = PartialState()
+    assert s.initialized
+    PartialState._reset_state()
+    # borg dict is shared: clearing it de-initializes existing instances too
+    assert not s.initialized
+    s2 = PartialState()
+    assert s2.initialized
+
+
+def test_accelerator_state_mixed_precision():
+    state = AcceleratorState(mixed_precision="bf16")
+    assert state.mixed_precision == "bf16"
+    # borg: second construction keeps first config
+    state2 = AcceleratorState()
+    assert state2.mixed_precision == "bf16"
+
+
+def test_accelerator_state_invalid_precision():
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="int3")
+
+
+def test_accelerator_state_default_mesh():
+    state = AcceleratorState()
+    mesh = state.mesh
+    assert mesh.devices.size == len(jax.devices())
+    assert mesh.shape["dp_shard"] == len(jax.devices())
+
+
+def test_state_delegation():
+    state = AcceleratorState()
+    assert state.num_processes == 1
+    assert state.is_main_process
+    assert state.device is jax.local_devices()[0]
+
+
+def test_split_between_processes_single():
+    s = PartialState()
+    with s.split_between_processes([1, 2, 3]) as inputs:
+        assert inputs == [1, 2, 3]
+
+
+def test_main_process_first():
+    s = PartialState()
+    with s.main_process_first():
+        pass  # single process: no deadlock, no-op barrier
+
+
+def test_on_main_process_decorator():
+    s = PartialState()
+    calls = []
+
+    @s.on_main_process
+    def fn(x):
+        calls.append(x)
+        return x
+
+    fn(5)
+    assert calls == [5]
+
+
+def test_gradient_state():
+    gs = GradientState(GradientAccumulationPlugin(num_steps=4))
+    assert gs.num_steps == 4
+    assert gs.sync_gradients
+    assert not gs.end_of_dataloader
+    assert gs.remainder == -1
+    gs2 = GradientState()
+    assert gs2.num_steps == 4  # borg
+    gs._set_sync_gradients(False)
+    assert not gs2.sync_gradients
+
+
+def test_gradient_accumulation_plugin_validation():
+    with pytest.raises(ValueError):
+        GradientAccumulationPlugin(num_steps=0)
+    with pytest.raises(ValueError):
+        GradientAccumulationPlugin(mode="bogus")
