@@ -1,0 +1,701 @@
+"""Data pipeline: per-rank sharding math + globally-sharded device batches.
+
+TPU-native re-design of reference ``data_loader.py`` (1,451 LoC).  Same
+sharding semantics — ``BatchSamplerShard`` (reference :110) index-level
+stride/split modes with ``even_batches`` head-sample padding,
+``IterableDatasetShard`` (:266), dispatch-from-rank-0 mode (:704), seedable
+deterministic shuffling (:73), skip/resume (:1312-1375) — but the device
+boundary is native JAX: every yielded batch is a **global sharded
+``jax.Array``** laid out along the mesh's data axes
+(``jax.make_array_from_process_local_data``; each host feeds only its
+addressable shards), with one-batch lookahead so H2D overlaps compute
+(the ``MpDeviceLoaderWrapper`` analog, reference :654).
+
+Device-mesh-aware rank remap: TP/CP/SP ranks must receive *identical* batches,
+so the dataloader collapses ``process_index`` by ``non_data_parallel_size``
+(reference data_loader.py:1109-1145).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .ops.operations import (
+    broadcast_object_list,
+    find_batch_size,
+    host_local_to_global,
+    recursively_apply,
+    send_to_device,
+    slice_tensors,
+)
+from .state import GradientState, PartialState
+from .utils.dataclasses import RNGType
+from .utils.imports import is_torch_available
+from .utils.random import get_rng_key, synchronize_rng_states
+
+
+def _is_torch_loader(obj) -> bool:
+    if not is_torch_available():
+        return False
+    import torch.utils.data
+
+    return isinstance(obj, torch.utils.data.DataLoader)
+
+
+def _to_numpy(batch):
+    """Convert torch tensors / lists in a batch pytree to numpy."""
+
+    def _conv(t):
+        if is_torch_available():
+            import torch
+
+            if isinstance(t, torch.Tensor):
+                return t.detach().cpu().numpy()
+        return np.asarray(t)
+
+    def _is_leaf(x):
+        if is_torch_available():
+            import torch
+
+            if isinstance(x, torch.Tensor):
+                return True
+        return isinstance(x, (np.ndarray, jax.Array))
+
+    return recursively_apply(_conv, batch, test_type=_is_leaf)
+
+
+class SeedableRandomSampler:
+    """Deterministic shuffling reseeded per epoch with ``seed + epoch``
+    (reference SeedableRandomSampler data_loader.py:73-107)."""
+
+    def __init__(self, data_source_len: int, seed: Optional[int] = None, epoch: int = 0):
+        self.data_source_len = data_source_len
+        from .utils.random import get_root_seed
+
+        self.initial_seed = seed if seed is not None else get_root_seed()
+        self.epoch = epoch
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.data_source_len
+
+    def __iter__(self) -> Iterator[int]:
+        rng = np.random.default_rng(self.initial_seed + self.epoch)
+        yield from rng.permutation(self.data_source_len).tolist()
+        self.epoch += 1
+
+
+class BatchSamplerShard:
+    """Shard a batch sampler's index batches across ``num_processes``
+    (reference BatchSamplerShard data_loader.py:110-263).
+
+    - ``split_batches=False`` (stride): process k yields batch k of every
+      consecutive group of ``num_processes`` batches.
+    - ``split_batches=True``: each global batch is sliced into
+      ``num_processes`` chunks.
+    - ``even_batches=True`` pads the tail by cycling samples from the
+      beginning so every process yields the same number of equally-sized
+      batches (the duplicates are dropped later by ``gather_for_metrics``).
+    """
+
+    def __init__(
+        self,
+        batch_sampler: Iterable[list[int]],
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+        even_batches: bool = True,
+    ):
+        if split_batches and getattr(batch_sampler, "batch_size", None) is not None:
+            if batch_sampler.batch_size % num_processes != 0:
+                raise ValueError(
+                    f"batch_size {batch_sampler.batch_size} must be divisible by num_processes "
+                    f"{num_processes} when split_batches=True"
+                )
+        self.batch_sampler = batch_sampler
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+        self.even_batches = even_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        self.drop_last = getattr(batch_sampler, "drop_last", False)
+
+    @property
+    def total_length(self):
+        return len(self.batch_sampler)
+
+    def __len__(self):
+        n = len(self.batch_sampler)
+        if self.split_batches:
+            return n
+        if n % self.num_processes == 0:
+            return n // self.num_processes
+        if self.even_batches and not self.drop_last:
+            return math.ceil(n / self.num_processes)
+        return n // self.num_processes + (
+            0 if self.even_batches or self.drop_last else int(self.process_index < n % self.num_processes)
+        )
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.batch_sampler, "set_epoch"):
+            self.batch_sampler.set_epoch(epoch)
+        sampler = getattr(self.batch_sampler, "sampler", None)
+        if sampler is not None and hasattr(sampler, "set_epoch"):
+            sampler.set_epoch(epoch)
+
+    def __iter__(self):
+        return self._iter_with_split() if self.split_batches else self._iter_with_stride()
+
+    def _iter_with_split(self):
+        initial_data: list[int] = []
+        for idx, batch in enumerate(self.batch_sampler):
+            if idx == 0:
+                initial_data = list(batch)
+            if self.batch_size is None:
+                # unknown batch size: infer from first batch
+                self.batch_size = len(batch)
+            chunk = self.batch_size // self.num_processes
+            if len(batch) == self.batch_size:
+                yield batch[self.process_index * chunk : (self.process_index + 1) * chunk]
+            else:  # smaller tail batch
+                if self.drop_last:
+                    return
+                if not self.even_batches:
+                    piece = batch[self.process_index * chunk : (self.process_index + 1) * chunk]
+                    if len(piece):
+                        yield piece
+                else:
+                    while len(batch) < self.batch_size:
+                        batch = batch + initial_data[: self.batch_size - len(batch)]
+                    yield batch[self.process_index * chunk : (self.process_index + 1) * chunk]
+
+    def _iter_with_stride(self):
+        initial_data: list[int] = []
+        batch_to_yield: Optional[list[int]] = None
+        cycle_pos = -1
+        batch_size = self.batch_size
+        for idx, batch in enumerate(self.batch_sampler):
+            if batch_size is None:
+                batch_size = len(batch)
+            # collect one full cycle of batches for tail padding
+            if idx < self.num_processes:
+                initial_data += list(batch)
+            cycle_pos = idx % self.num_processes
+            if cycle_pos == self.process_index:
+                batch_to_yield = list(batch)
+            if cycle_pos == self.num_processes - 1:
+                if len(batch) == batch_size or (not self.even_batches and batch_to_yield):
+                    yield batch_to_yield
+                    batch_to_yield = None
+                elif self.even_batches and not self.drop_last:
+                    # last batch of the cycle is short: pad it (and this
+                    # rank's batch if short) by cycling initial samples
+                    if batch_to_yield is not None:
+                        while len(batch_to_yield) < batch_size:
+                            batch_to_yield += initial_data[: batch_size - len(batch_to_yield)]
+                        yield batch_to_yield
+                        batch_to_yield = None
+        if cycle_pos == self.num_processes - 1 or cycle_pos == -1:
+            return
+        # dataloader ended mid-cycle
+        if self.drop_last:
+            return
+        if not self.even_batches:
+            if batch_to_yield:
+                yield batch_to_yield
+            return
+        # even_batches: every rank must yield one more batch; ranks beyond the
+        # cycle end cycle through initial samples
+        if batch_to_yield is None:
+            start = (self.process_index - cycle_pos - 1) * (batch_size or 1)
+            pool = initial_data
+            while len(pool) < start + (batch_size or 1):
+                pool = pool + initial_data
+            batch_to_yield = pool[start : start + (batch_size or 1)]
+        while batch_size is not None and len(batch_to_yield) < batch_size:
+            batch_to_yield += initial_data[: batch_size - len(batch_to_yield)]
+        yield batch_to_yield
+
+
+class IterableDatasetShard:
+    """Shard an iterable dataset: buffer ``num_processes * batch_size`` items,
+    take this process's slice (reference IterableDatasetShard
+    data_loader.py:266-365)."""
+
+    def __init__(
+        self,
+        dataset: Iterable,
+        batch_size: int = 1,
+        drop_last: bool = False,
+        num_processes: int = 1,
+        process_index: int = 0,
+        split_batches: bool = False,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.num_processes = num_processes
+        self.process_index = process_index
+        self.split_batches = split_batches
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
+
+    def __len__(self):
+        n = len(self.dataset)
+        real_batch = self.batch_size if self.split_batches else self.batch_size * self.num_processes
+        if self.drop_last:
+            return (n // real_batch) * real_batch // self.num_processes
+        return math.ceil(n / real_batch) * real_batch // self.num_processes
+
+    def __iter__(self):
+        real_batch_size = self.batch_size if self.split_batches else self.batch_size * self.num_processes
+        process_batch_size = self.batch_size // self.num_processes if self.split_batches else self.batch_size
+        first_batch = None
+        buffer: list = []
+        for element in self.dataset:
+            buffer.append(element)
+            if len(buffer) == real_batch_size:
+                start = self.process_index * process_batch_size
+                yield from buffer[start : start + process_batch_size]
+                if first_batch is None:
+                    first_batch = buffer.copy()
+                buffer = []
+        if not self.drop_last and len(buffer) > 0:
+            if first_batch is None:
+                first_batch = buffer.copy()
+            while len(buffer) < real_batch_size:
+                buffer += first_batch[: real_batch_size - len(buffer)]
+            start = self.process_index * process_batch_size
+            yield from buffer[start : start + process_batch_size]
+
+
+class DataLoaderStateMixin:
+    """end-of-dataloader / remainder signaling into ``GradientState``
+    (reference data_loader.py:365-405)."""
+
+    def __init_subclass__(cls, **kwargs):
+        cls.end_of_dataloader = False
+        cls.remainder = -1
+
+    def reset(self):
+        self.end_of_dataloader = False
+        self.remainder = -1
+
+    def begin(self):
+        self.reset()
+        try:
+            length = self.total_dataset_length
+            total_batch_size = self.total_batch_size
+            if length is not None and total_batch_size:
+                self.remainder = length % total_batch_size
+        except TypeError:  # length-less iterable dataset
+            pass
+        self.gradient_state._add_dataloader(self)
+
+    def end(self):
+        self.gradient_state._remove_dataloader(self)
+
+
+class DataLoaderShard(DataLoaderStateMixin):
+    """Per-rank device loader: wraps an inner batch iterable, synchronizes RNG
+    at epoch start, converts each batch to a global sharded ``jax.Array`` with
+    one-batch lookahead (reference DataLoaderShard data_loader.py:500-650 +
+    MpDeviceLoaderWrapper :654)."""
+
+    def __init__(
+        self,
+        inner: Iterable,
+        device=None,
+        mesh: Optional[Mesh] = None,
+        batch_spec: Optional[Callable[[Any], PartitionSpec] | PartitionSpec] = None,
+        rng_types: Optional[list] = None,
+        synchronized_generator=None,
+        skip_batches: int = 0,
+        put_on_device: bool = True,
+        _non_blocking: bool = True,
+        _loader_batch_size: Optional[int] = None,
+    ):
+        self.inner = inner
+        self.device = device
+        self.mesh = mesh
+        self.batch_spec = batch_spec
+        self.rng_types = rng_types
+        self.synchronized_generator = synchronized_generator
+        self.skip_batches = skip_batches
+        self.put_on_device = put_on_device
+        self.gradient_state = GradientState()
+        self.iteration = 0
+        self._loader_batch_size = _loader_batch_size
+        self._batches_yielded = 0  # stateful-dataloader resume counter
+
+    # -- device placement ---------------------------------------------------
+
+    def _device_put_batch(self, batch):
+        batch = _to_numpy(batch)
+        if not self.put_on_device:
+            return batch
+        if self.mesh is not None and self.batch_spec is not None:
+            return host_local_to_global(batch, self.mesh, self.batch_spec)
+        return send_to_device(batch, self.device)
+
+    def __iter__(self):
+        if self.rng_types is not None:
+            synchronize_rng_states(self.rng_types, self.synchronized_generator)
+        self.begin()
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(self.iteration)
+        try:
+            iterator = iter(self.inner)
+            # one-batch lookahead: current batch transfers H2D while the
+            # previous one is being consumed (jax dispatch is async)
+            batch_idx = 0
+            current = None
+            have_current = False
+            while True:
+                try:
+                    nxt = next(iterator)
+                except StopIteration:
+                    break
+                if have_current:
+                    if batch_idx > self.skip_batches:
+                        # count before yielding: state_dict() must reflect
+                        # batches already handed out even mid-iteration
+                        self._batches_yielded += 1
+                        yield current
+                current = self._device_put_batch(nxt)
+                have_current = True
+                batch_idx += 1
+            if have_current:
+                self.end_of_dataloader = True
+                if batch_idx > self.skip_batches:
+                    self._batches_yielded += 1
+                    yield current
+        finally:
+            self.iteration += 1
+            self.end()
+
+    def __len__(self):
+        inner_len = len(self.inner)
+        return max(inner_len - self.skip_batches, 0)
+
+    def set_epoch(self, epoch: int):
+        self.iteration = epoch
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(epoch)
+
+    @property
+    def total_batch_size(self):
+        if self._loader_batch_size is not None:
+            return self._loader_batch_size
+        bs = getattr(self.inner, "batch_size", None)
+        if bs is None:
+            sampler = getattr(self.inner, "batch_sampler", None)
+            bs = getattr(sampler, "batch_size", None)
+        return bs
+
+    @property
+    def total_dataset_length(self):
+        dataset = getattr(self.inner, "dataset", self.inner)
+        return len(dataset)
+
+    # -- stateful resume (reference DataLoaderAdapter :408-498) ------------
+
+    def state_dict(self):
+        return {"batches_yielded": self._batches_yielded, "iteration": self.iteration}
+
+    def load_state_dict(self, state_dict):
+        self.skip_batches = state_dict.get("batches_yielded", 0)
+        self.iteration = state_dict.get("iteration", 0)
+
+
+class DataLoaderDispatcher(DataLoaderStateMixin):
+    """Process 0 reads the data and broadcasts it; every process slices its
+    shard — for iterable/non-replicable sources (reference DataLoaderDispatcher
+    data_loader.py:704-960)."""
+
+    def __init__(
+        self,
+        inner: Iterable,
+        split_batches: bool = False,
+        mesh: Optional[Mesh] = None,
+        batch_spec=None,
+        device=None,
+        skip_batches: int = 0,
+        slice_fn: Optional[Callable] = None,
+        _loader_batch_size: Optional[int] = None,
+    ):
+        self.inner = inner
+        self.split_batches = split_batches
+        self.mesh = mesh
+        self.batch_spec = batch_spec
+        self.device = device
+        self.skip_batches = skip_batches
+        self.slice_fn = slice_fn or slice_tensors
+        self.state = PartialState()
+        self.gradient_state = GradientState()
+        self.iteration = 0
+        self._loader_batch_size = _loader_batch_size
+        self._batches_yielded = 0
+
+    def _fetch_batches(self, iterator):
+        """Rank 0 reads one global batch (split mode) or num_processes batches
+        (stride mode) and broadcasts them (reference _fetch_batches :786)."""
+        from .ops.operations import concatenate
+
+        batches, batch = None, None
+        stop_iteration = False
+        if self.state.is_main_process:
+            try:
+                if self.split_batches:
+                    batch = _to_numpy(next(iterator))
+                else:
+                    batches = [_to_numpy(next(iterator)) for _ in range(self.state.num_processes)]
+                    batch = concatenate(batches, dim=0)
+            except StopIteration:
+                stop_iteration = True
+        payload = [batch, stop_iteration]
+        if self.state.num_processes > 1:
+            broadcast_object_list(payload, from_process=0)
+        return payload[0], payload[1]
+
+    def __iter__(self):
+        self.begin()
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(self.iteration)
+        main_iterator = iter(self.inner) if self.state.is_main_process else None
+        batch_idx = 0
+        try:
+            while True:
+                batch, stop = self._fetch_batches(main_iterator)
+                if stop or batch is None:
+                    break
+                whole = find_batch_size(batch)
+                slice_size = whole // self.state.num_processes
+                start = self.state.process_index * slice_size
+                local = self.slice_fn(batch, slice(start, start + slice_size))
+                if self.mesh is not None and self.batch_spec is not None:
+                    local = host_local_to_global(local, self.mesh, self.batch_spec)
+                elif self.device is not None:
+                    local = send_to_device(local, self.device)
+                if batch_idx >= self.skip_batches:
+                    self._batches_yielded += 1
+                    yield local
+                batch_idx += 1
+        finally:
+            self.iteration += 1
+            self.end()
+
+    def __len__(self):
+        whole_length = len(self.inner)
+        if self.split_batches:
+            return whole_length
+        return math.ceil(whole_length / self.state.num_processes)
+
+    @property
+    def total_batch_size(self):
+        bs = self._loader_batch_size or getattr(self.inner, "batch_size", None)
+        if bs is None:
+            return None
+        return bs if self.split_batches else bs * self.state.num_processes
+
+    @property
+    def total_dataset_length(self):
+        dataset = getattr(self.inner, "dataset", self.inner)
+        return len(dataset)
+
+    def set_epoch(self, epoch: int):
+        self.iteration = epoch
+        if hasattr(self.inner, "set_epoch"):
+            self.inner.set_epoch(epoch)
+
+    def state_dict(self):
+        return {"batches_yielded": self._batches_yielded, "iteration": self.iteration}
+
+    def load_state_dict(self, state_dict):
+        self.skip_batches = state_dict.get("batches_yielded", 0)
+        self.iteration = state_dict.get("iteration", 0)
+
+
+# ---------------------------------------------------------------------------
+# prepare_data_loader — the entry point (reference data_loader.py:996-1310)
+# ---------------------------------------------------------------------------
+
+
+def prepare_data_loader(
+    dataloader,
+    device=None,
+    num_processes: Optional[int] = None,
+    process_index: Optional[int] = None,
+    split_batches: bool = False,
+    put_on_device: bool = True,
+    rng_types: Optional[list] = None,
+    dispatch_batches: Optional[bool] = None,
+    even_batches: bool = True,
+    slice_fn_for_dispatch: Optional[Callable] = None,
+    use_seedable_sampler: bool = False,
+    data_seed: Optional[int] = None,
+    non_blocking: bool = True,
+    use_stateful_dataloader: bool = False,
+    mesh: Optional[Mesh] = None,
+    batch_spec: Optional[PartitionSpec] = None,
+    parallelism_config=None,
+):
+    """Re-wrap a dataloader (torch DataLoader or any batch iterable) for
+    per-rank sharding + global-array device placement.
+
+    Mirrors reference ``prepare_data_loader`` (data_loader.py:996): the
+    process grid used for sharding is the **data-parallel** sub-grid — TP/CP/
+    SP ranks are collapsed so they receive identical data
+    (``process_index //= non_data_parallel_size``, reference :1109-1145).
+    """
+    state = PartialState()
+    num_processes = num_processes if num_processes is not None else state.num_processes
+    process_index = process_index if process_index is not None else state.process_index
+
+    if parallelism_config is not None and parallelism_config.non_data_parallel_size > 1:
+        # Collapse non-DP model ranks: all hosts inside one dp group read the
+        # same batches.  On JAX one process spans many devices, so this only
+        # matters multi-host; device-level splitting is done by the global
+        # array sharding itself.
+        non_dp = parallelism_config.non_data_parallel_size
+        if num_processes % non_dp == 0 and non_dp <= num_processes:
+            process_index = process_index // non_dp
+            num_processes = num_processes // non_dp
+
+    if dispatch_batches is None:
+        is_iterable = _is_torch_loader(dataloader) and not hasattr(dataloader.dataset, "__getitem__")
+        dispatch_batches = is_iterable and put_on_device
+
+    if dispatch_batches:
+        return DataLoaderDispatcher(
+            dataloader,
+            split_batches=split_batches,
+            mesh=mesh,
+            batch_spec=batch_spec,
+            device=device if put_on_device else None,
+            slice_fn=slice_fn_for_dispatch,
+            _loader_batch_size=getattr(dataloader, "batch_size", None),
+        )
+
+    synchronized_generator = None
+    inner = dataloader
+    loader_batch_size = getattr(dataloader, "batch_size", None)
+
+    if _is_torch_loader(dataloader):
+        import torch.utils.data
+
+        dataset = dataloader.dataset
+        if isinstance(dataset, torch.utils.data.IterableDataset):
+            if num_processes > 1:
+                dataset = IterableDatasetShard(
+                    dataset,
+                    batch_size=dataloader.batch_size,
+                    drop_last=dataloader.drop_last,
+                    num_processes=num_processes,
+                    process_index=process_index,
+                    split_batches=split_batches,
+                )
+            inner = torch.utils.data.DataLoader(
+                dataset,
+                batch_size=(dataloader.batch_size // num_processes if split_batches else dataloader.batch_size),
+                collate_fn=dataloader.collate_fn,
+                num_workers=dataloader.num_workers,
+                drop_last=dataloader.drop_last,
+            )
+        else:
+            batch_sampler = dataloader.batch_sampler
+            sampler = getattr(batch_sampler, "sampler", None)
+            if use_seedable_sampler and isinstance(sampler, torch.utils.data.RandomSampler):
+                seedable = SeedableRandomSampler(len(dataset), seed=data_seed)
+                batch_sampler = torch.utils.data.BatchSampler(
+                    seedable, batch_sampler.batch_size, batch_sampler.drop_last
+                )
+            if num_processes > 1:
+                batch_sampler = BatchSamplerShard(
+                    batch_sampler,
+                    num_processes=num_processes,
+                    process_index=process_index,
+                    split_batches=split_batches,
+                    even_batches=even_batches,
+                )
+            inner = torch.utils.data.DataLoader(
+                dataset,
+                batch_sampler=batch_sampler,
+                collate_fn=dataloader.collate_fn,
+                num_workers=dataloader.num_workers,
+            )
+        if rng_types is None:
+            rng_types = [RNGType.JAX]
+
+    return DataLoaderShard(
+        inner,
+        device=device if put_on_device else None,
+        mesh=mesh if put_on_device else None,
+        batch_spec=batch_spec,
+        rng_types=rng_types,
+        synchronized_generator=synchronized_generator,
+        put_on_device=put_on_device,
+        _non_blocking=non_blocking,
+        _loader_batch_size=loader_batch_size,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Skip / resume (reference data_loader.py:1312-1451)
+# ---------------------------------------------------------------------------
+
+
+class SkipBatchSampler:
+    """Yield batches of an inner batch sampler starting at ``skip_batches``
+    (reference :1312)."""
+
+    def __init__(self, batch_sampler, skip_batches: int = 0):
+        self.batch_sampler = batch_sampler
+        self.skip_batches = skip_batches
+        self.batch_size = getattr(batch_sampler, "batch_size", None)
+        self.drop_last = getattr(batch_sampler, "drop_last", False)
+
+    def __iter__(self):
+        for idx, batch in enumerate(self.batch_sampler):
+            if idx >= self.skip_batches:
+                yield batch
+
+    def __len__(self):
+        return max(len(self.batch_sampler) - self.skip_batches, 0)
+
+
+class SkipDataLoader:
+    """Iterate a dataloader skipping the first N batches (reference :1335)."""
+
+    def __init__(self, dataloader, skip_batches: int = 0):
+        self.dataloader = dataloader
+        self.skip_batches = skip_batches
+
+    def __iter__(self):
+        for idx, batch in enumerate(self.dataloader):
+            if idx >= self.skip_batches:
+                yield batch
+
+    def __len__(self):
+        return max(len(self.dataloader) - self.skip_batches, 0)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["dataloader"], name)
+
+
+def skip_first_batches(dataloader, num_batches: int = 0):
+    """Fast-forward a (prepared or raw) dataloader for mid-epoch resume
+    (reference skip_first_batches data_loader.py:1375-1449)."""
+    if isinstance(dataloader, (DataLoaderShard, DataLoaderDispatcher)):
+        dataloader.skip_batches = num_batches
+        return dataloader
+    return SkipDataLoader(dataloader, skip_batches=num_batches)

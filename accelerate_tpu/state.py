@@ -404,10 +404,14 @@ class GradientState:
         self.sync_gradients = sync_gradients
 
     def _add_dataloader(self, dataloader):
+        if not self.initialized:  # revived after a test-hygiene reset
+            GradientState.__init__(self)
         self.active_dataloader = dataloader
         self.dataloader_references.append(dataloader)
 
     def _remove_dataloader(self, dataloader):
+        if not self.initialized:  # reset happened while a loader was live
+            return
         if dataloader in self.dataloader_references:
             self.dataloader_references.remove(dataloader)
         self.active_dataloader = self.dataloader_references[-1]

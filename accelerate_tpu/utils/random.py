@@ -1,0 +1,110 @@
+"""Seeding and cross-process RNG synchronization.
+
+TPU-native analog of reference ``utils/random.py`` (156 LoC): ``set_seed``
+(:39) seeds every framework RNG; ``synchronize_rng_states`` (:154) broadcasts
+RNG state from process 0 so shuffles agree across ranks.  JAX adds a twist:
+its PRNG is functional (keys, not global state), so the framework keeps a
+module-level *root key* that samplers/dataloaders fold per-epoch/per-step —
+deterministic and sync-free by construction, which is why ``jax`` appears in
+``rng_types`` but needs no cross-process traffic.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import jax
+import numpy as np
+
+from .dataclasses import RNGType
+from .imports import is_torch_available
+
+_root_key: Optional[jax.Array] = None
+_root_seed: Optional[int] = None
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False):
+    """Seed python/numpy/torch and install the JAX root key
+    (reference random.py:39-66).  ``device_specific`` offsets by process index
+    so each host draws different data-augmentation randomness."""
+    global _root_key, _root_seed
+    if device_specific:
+        from ..state import PartialState
+
+        seed += PartialState().process_index
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    if is_torch_available():
+        import torch
+
+        torch.manual_seed(seed)
+    _root_seed = seed
+    _root_key = jax.random.key(seed)
+    return seed
+
+
+def get_rng_key(fold: Optional[int] = None) -> jax.Array:
+    """The framework root PRNG key, optionally folded with ``fold``
+    (epoch/step index) for a derived stream."""
+    global _root_key
+    if _root_key is None:
+        set_seed(0)
+    return jax.random.fold_in(_root_key, fold) if fold is not None else _root_key
+
+
+def get_root_seed() -> int:
+    global _root_seed
+    if _root_seed is None:
+        set_seed(0)
+    return _root_seed
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None):
+    """Broadcast one RNG stream's state from process 0
+    (reference random.py:69-151)."""
+    from ..ops.operations import broadcast_object_list
+    from ..state import PartialState
+
+    state = PartialState()
+    if rng_type == RNGType.JAX:
+        # Functional keys derived from a shared seed are already identical
+        # across processes; sync the seed to be safe.
+        payload = [get_root_seed()]
+        broadcast_object_list(payload, from_process=0)
+        if state.num_processes > 1:
+            global _root_key, _root_seed
+            _root_seed = payload[0]
+            _root_key = jax.random.key(_root_seed)
+        return
+    if rng_type == RNGType.NUMPY:
+        payload = [np.random.get_state()]
+        broadcast_object_list(payload, from_process=0)
+        np.random.set_state(payload[0])
+        return
+    if rng_type == RNGType.PYTHON:
+        payload = [random.getstate()]
+        broadcast_object_list(payload, from_process=0)
+        random.setstate(payload[0])
+        return
+    if rng_type == RNGType.TORCH and is_torch_available():
+        import torch
+
+        payload = [torch.get_rng_state()]
+        broadcast_object_list(payload, from_process=0)
+        torch.set_rng_state(payload[0])
+        return
+    if rng_type == RNGType.GENERATOR and generator is not None:
+        payload = [generator.get_state() if hasattr(generator, "get_state") else generator.bit_generator.state]
+        broadcast_object_list(payload, from_process=0)
+        if hasattr(generator, "set_state"):
+            generator.set_state(payload[0])
+        else:
+            generator.bit_generator.state = payload[0]
+        return
+
+
+def synchronize_rng_states(rng_types: Iterable, generator=None):
+    """reference random.py:154."""
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(rng_type) if not isinstance(rng_type, RNGType) else rng_type, generator)

@@ -1,0 +1,264 @@
+"""Dataloader sharding-semantics tests (mirror of reference
+tests/test_data_loader.py + scripts/test_distributed_data_loop.py coverage:
+stride/split modes, even_batches padding, iterable sharding, skip/resume,
+device placement as global sharded arrays)."""
+
+import jax
+import numpy as np
+import pytest
+import torch
+import torch.utils.data as tud
+from jax.sharding import PartitionSpec as P
+
+from accelerate_tpu.data_loader import (
+    BatchSamplerShard,
+    DataLoaderDispatcher,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SkipBatchSampler,
+    SkipDataLoader,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from accelerate_tpu.state import GradientState, PartialState
+
+
+class SimpleBatchSampler:
+    def __init__(self, n, batch_size, drop_last=False):
+        self.n = n
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for i in range(self.n):
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+
+def _all_rank_batches(sampler_factory, num_processes, **kwargs):
+    return [
+        list(BatchSamplerShard(sampler_factory(), num_processes=num_processes, process_index=i, **kwargs))
+        for i in range(num_processes)
+    ]
+
+
+def test_stride_even_division():
+    # 8 samples, bs 2 -> 4 batches; 2 procs get 2 each, no padding needed
+    shards = _all_rank_batches(lambda: SimpleBatchSampler(8, 2), 2)
+    assert shards[0] == [[0, 1], [4, 5]]
+    assert shards[1] == [[2, 3], [6, 7]]
+
+
+def test_stride_uneven_even_batches_pads_from_head():
+    # 10 samples, bs 2 -> 5 batches over 2 procs: rank1's last is padded
+    shards = _all_rank_batches(lambda: SimpleBatchSampler(10, 2), 2)
+    assert len(shards[0]) == len(shards[1]) == 3
+    assert shards[0] == [[0, 1], [4, 5], [8, 9]]
+    # rank 1 cycles from the head of the epoch
+    assert shards[1][:2] == [[2, 3], [6, 7]]
+    assert shards[1][2] == [0, 1]
+    assert all(len(b) == 2 for b in shards[1])
+
+
+def test_stride_short_tail_batch_padded():
+    # 9 samples, bs 2 -> batches [..,[8]]: tail padded to size 2
+    shards = _all_rank_batches(lambda: SimpleBatchSampler(9, 2), 2)
+    assert len(shards[0]) == len(shards[1]) == 3
+    for rank in shards:
+        assert all(len(b) == 2 for b in rank)
+    # every index is covered by the union
+    union = {i for rank in shards for b in rank for i in b}
+    assert union == set(range(9))
+
+
+def test_stride_uneven_no_even_batches():
+    shards = _all_rank_batches(lambda: SimpleBatchSampler(10, 2), 2, even_batches=False)
+    assert shards[0] == [[0, 1], [4, 5], [8, 9]]
+    assert shards[1] == [[2, 3], [6, 7]]
+
+
+def test_stride_drop_last():
+    sampler = SimpleBatchSampler(9, 2, drop_last=True)  # 4 full batches
+    shards = [
+        list(BatchSamplerShard(SimpleBatchSampler(9, 2, drop_last=True), num_processes=2, process_index=i))
+        for i in range(2)
+    ]
+    assert shards[0] == [[0, 1], [4, 5]]
+    assert shards[1] == [[2, 3], [6, 7]]
+
+
+def test_split_batches():
+    shards = _all_rank_batches(lambda: SimpleBatchSampler(8, 4), 2, split_batches=True)
+    assert shards[0] == [[0, 1], [4, 5]]
+    assert shards[1] == [[2, 3], [6, 7]]
+
+
+def test_split_batches_tail_padded():
+    shards = _all_rank_batches(lambda: SimpleBatchSampler(6, 4), 2, split_batches=True)
+    assert len(shards[0]) == len(shards[1]) == 2
+    assert shards[0][1] == [4, 5]
+    assert shards[1][1] == [0, 1]  # padded from epoch head
+
+
+def test_split_batches_requires_divisible():
+    with pytest.raises(ValueError):
+        BatchSamplerShard(SimpleBatchSampler(9, 3), num_processes=2, split_batches=True)
+
+
+def test_iterable_dataset_shard():
+    shards = [
+        list(IterableDatasetShard(range(10), batch_size=2, num_processes=2, process_index=i))
+        for i in range(2)
+    ]
+    # buffer of 4: p0 takes [0,1],[4,5]...; p1 takes [2,3],[6,7]...
+    assert shards[0] == [0, 1, 4, 5, 8, 9]
+    assert shards[1] == [2, 3, 6, 7, 0, 1]  # tail padded from first buffer
+
+
+def test_iterable_dataset_shard_drop_last():
+    shards = [
+        list(IterableDatasetShard(range(10), batch_size=2, num_processes=2, process_index=i, drop_last=True))
+        for i in range(2)
+    ]
+    assert shards[0] == [0, 1, 4, 5]
+    assert shards[1] == [2, 3, 6, 7]
+
+
+def test_seedable_sampler_deterministic():
+    s1 = SeedableRandomSampler(10, seed=42)
+    s2 = SeedableRandomSampler(10, seed=42)
+    e0a, e0b = list(s1), list(s2)
+    assert e0a == e0b
+    e1a = list(s1)  # epoch auto-increments
+    assert e1a != e0a
+    s3 = SeedableRandomSampler(10, seed=42, epoch=1)
+    assert list(s3) == e1a
+
+
+def _torch_loader(n=16, bs=4, shuffle=False):
+    data = tud.TensorDataset(torch.arange(n, dtype=torch.float32).reshape(n, 1))
+    return tud.DataLoader(data, batch_size=bs, shuffle=shuffle)
+
+
+def test_dataloader_shard_yields_jax_arrays():
+    dl = prepare_data_loader(_torch_loader())
+    batches = list(dl)
+    assert len(batches) == 4
+    assert isinstance(batches[0][0], jax.Array)
+    np.testing.assert_allclose(np.asarray(batches[0][0]).ravel(), [0, 1, 2, 3])
+
+
+def test_dataloader_shard_gradient_state_signaling():
+    gs = GradientState()
+    dl = prepare_data_loader(_torch_loader())
+    seen_end_flags = []
+    for _ in dl:
+        seen_end_flags.append(gs.end_of_dataloader)
+    assert seen_end_flags == [False, False, False, True]
+    assert not gs.in_dataloader
+
+
+def test_dataloader_shard_remainder():
+    gs = GradientState()
+    dl = prepare_data_loader(_torch_loader(n=10, bs=4))
+    for _ in dl:
+        rem = gs.remainder
+    assert rem == 2
+
+
+def test_dataloader_global_sharding(mesh8):
+    dl = prepare_data_loader(_torch_loader(n=32, bs=8), mesh=mesh8, batch_spec=P(("dp_shard",), None))
+    batch = next(iter(dl))
+    x = batch[0]
+    assert isinstance(x, jax.Array)
+    assert len(x.sharding.device_set) == 8
+    assert x.shape == (8, 1)
+
+
+def test_dataloader_two_rank_simulation():
+    # simulate 2 dataloader ranks in one process (reference runs subprocesses)
+    dls = [
+        prepare_data_loader(_torch_loader(n=16, bs=4), num_processes=2, process_index=i, put_on_device=False)
+        for i in range(2)
+    ]
+    b0 = [np.asarray(b[0]).ravel().tolist() for b in dls[0]]
+    b1 = [np.asarray(b[0]).ravel().tolist() for b in dls[1]]
+    assert len(b0) == len(b1) == 2
+    union = {v for batch in b0 + b1 for v in batch}
+    assert union == set(float(i) for i in range(16))
+
+
+def test_dataloader_total_batch_size_and_length():
+    dl = prepare_data_loader(_torch_loader(n=16, bs=4))
+    assert dl.total_batch_size == 4
+    assert dl.total_dataset_length == 16
+    assert len(dl) == 4
+
+
+def test_skip_batch_sampler():
+    s = SkipBatchSampler(SimpleBatchSampler(8, 2), skip_batches=2)
+    assert list(s) == [[4, 5], [6, 7]]
+    assert len(s) == 2
+
+
+def test_skip_dataloader():
+    dl = SkipDataLoader(_torch_loader(), skip_batches=2)
+    batches = [np.asarray(b[0]).ravel().tolist() for b in dl]
+    assert batches == [[8, 9, 10, 11], [12, 13, 14, 15]]
+
+
+def test_skip_first_batches_on_prepared():
+    dl = prepare_data_loader(_torch_loader())
+    dl = skip_first_batches(dl, 3)
+    batches = list(dl)
+    assert len(batches) == 1
+    np.testing.assert_allclose(np.asarray(batches[0][0]).ravel(), [12, 13, 14, 15])
+
+
+def test_stateful_resume():
+    dl = prepare_data_loader(_torch_loader())
+    it = iter(dl)
+    next(it), next(it)
+    sd = dl.state_dict()
+    assert sd["batches_yielded"] == 2
+    dl2 = prepare_data_loader(_torch_loader())
+    dl2.load_state_dict(sd)
+    remaining = list(dl2)
+    assert len(remaining) == 2
+    np.testing.assert_allclose(np.asarray(remaining[0][0]).ravel(), [8, 9, 10, 11])
+
+
+def test_dispatcher_single_process():
+    dl = DataLoaderDispatcher(_torch_loader(n=8, bs=4))
+    batches = [np.asarray(b[0]).ravel().tolist() for b in dl]
+    assert batches == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+
+def test_seedable_via_prepare():
+    dl = prepare_data_loader(_torch_loader(shuffle=True), use_seedable_sampler=True, data_seed=7)
+    a = [np.asarray(b[0]).ravel().tolist() for b in dl]
+    dl2 = prepare_data_loader(_torch_loader(shuffle=True), use_seedable_sampler=True, data_seed=7)
+    b = [np.asarray(x[0]).ravel().tolist() for x in dl2]
+    assert a == b  # deterministic across constructions
+    flat = sorted(v for batch in a for v in batch)
+    assert flat == [float(i) for i in range(16)]
+
+
+def test_dataloader_parallelism_rank_collapse():
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    # single process: non-dp collapse must be a no-op, not a crash
+    cfg = ParallelismConfig(dp_shard_size=4, tp_size=2)
+    dl = prepare_data_loader(_torch_loader(), parallelism_config=cfg)
+    assert len(list(dl)) == 4
