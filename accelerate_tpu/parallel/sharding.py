@@ -1,0 +1,219 @@
+"""Parameter-sharding planner: FSDP/ZeRO/TP/HSDP as PartitionSpec assignment.
+
+This is the heart of the strategy layer (SURVEY §2.4): where the reference
+wraps models in engines (torch FSDP accelerator.py:1885, DTensor
+``fully_shard`` fsdp_utils.py:621, DeepSpeed zero-stage engines), the
+TPU-native design assigns a :class:`NamedSharding` to every parameter — XLA's
+GSPMD partitioner then *is* the runtime.  FSDP ≅ shard params/grads/optimizer
+state over ``dp_shard`` (+``cp`` under the flattened ``dp_shard_cp`` joint dim,
+reference parallelism_config.py:157-164); TP = rule-matched specs on attention
+/MLP matrices; HSDP = replicate over ``dp_replicate`` (DCN) while sharding
+over ``dp_shard`` (ICI).
+
+The "auto wrap policy" analog (reference fsdp auto_wrap_policy
+accelerator.py:1909-1937) is ``min_weight_size``: parameters smaller than it
+stay replicated — sharding tiny tensors costs more in collective latency than
+it saves in HBM.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallelism_config import ParallelismConfig
+from ..utils.dataclasses import FullyShardedDataParallelPlugin, ShardingStrategy
+
+logger = logging.getLogger(__name__)
+
+
+def path_str(path) -> str:
+    """Render a jax key path as 'a/b/0/c' for regex rule matching."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _axis_size(mesh: Mesh, axes) -> int:
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
+def _spec_for_leaf(
+    path: str,
+    shape: tuple[int, ...],
+    mesh: Mesh,
+    fsdp_axes: tuple[str, ...],
+    min_weight_size: int,
+    tp_rules: Sequence[tuple[str, PartitionSpec]],
+) -> PartitionSpec:
+    ndim = len(shape)
+    spec: list = [None] * ndim
+
+    # 1. TP rules first (they own specific dims)
+    for pattern, rule_spec in tp_rules:
+        if re.search(pattern, path):
+            for d, entry in enumerate(rule_spec):
+                if d >= ndim or entry is None:
+                    continue
+                size = _axis_size(mesh, entry)
+                if size > 1 and shape[d] % size == 0:
+                    spec[d] = entry
+                elif size > 1:
+                    logger.warning(
+                        "TP rule %r wants to shard dim %d of %s %s but %d %% %d != 0; replicating",
+                        pattern, d, path, shape, shape[d], size,
+                    )
+            break
+
+    # 2. FSDP: shard the largest still-free, divisible dim
+    fsdp_size = _axis_size(mesh, fsdp_axes)
+    if fsdp_size > 1 and int(np.prod(shape)) >= min_weight_size:
+        candidates = sorted(
+            (d for d in range(ndim) if spec[d] is None and shape[d] % fsdp_size == 0),
+            key=lambda d: shape[d],
+            reverse=True,
+        )
+        if candidates:
+            spec[candidates[0]] = fsdp_axes if len(fsdp_axes) > 1 else fsdp_axes[0]
+
+    return PartitionSpec(*spec)
+
+
+def make_sharding_plan(
+    params,
+    mesh: Mesh,
+    parallelism_config: Optional[ParallelismConfig] = None,
+    fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+    tp_rules: Optional[Sequence[tuple[str, PartitionSpec]]] = None,
+):
+    """Assign a NamedSharding to every parameter leaf.
+
+    ``params`` may be a real pytree or a tree of ``jax.ShapeDtypeStruct``
+    (abstract planning — the big-model path, no materialization needed).
+    Returns a pytree of :class:`NamedSharding` with the same structure.
+    """
+    cfg = parallelism_config or ParallelismConfig()
+    tp_rules = list(tp_rules or [])
+
+    strategy = fsdp_plugin.sharding_strategy if fsdp_plugin is not None else (
+        ShardingStrategy.FULL_SHARD if cfg.dp_shard_size > 1 else ShardingStrategy.NO_SHARD
+    )
+    min_size = fsdp_plugin.min_weight_size if fsdp_plugin is not None else 2**12
+
+    if strategy in (ShardingStrategy.FULL_SHARD, ShardingStrategy.HYBRID_SHARD):
+        fsdp_axes = cfg.fsdp_dim_names or (("dp_shard",) if mesh.shape.get("dp_shard", 1) > 1 else ())
+    else:
+        # NO_SHARD / SHARD_GRAD_OP: parameters replicated across dp
+        # (grad/optimizer sharding for SHARD_GRAD_OP is applied to opt_state
+        # only — see make_opt_state_sharding_plan)
+        fsdp_axes = ()
+
+    def _leaf(path, leaf):
+        shape = tuple(leaf.shape) if hasattr(leaf, "shape") else ()
+        if not shape:
+            return NamedSharding(mesh, PartitionSpec())
+        return NamedSharding(
+            mesh, _spec_for_leaf(path_str(path), shape, mesh, tuple(fsdp_axes), min_size, tp_rules)
+        )
+
+    return jax.tree_util.tree_map_with_path(_leaf, params)
+
+
+def make_opt_state_sharding_plan(
+    opt_state_shapes,
+    params_plan,
+    mesh: Mesh,
+    parallelism_config: Optional[ParallelismConfig] = None,
+    fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+):
+    """Sharding plan for optimizer state (the ZeRO-1/2 axis of the design).
+
+    Moment tensors that mirror a parameter inherit that parameter's sharding;
+    under SHARD_GRAD_OP (ZeRO-2 analog) mirrors are *additionally* sharded
+    even though params are replicated.  Scalar counts replicate.
+    """
+    cfg = parallelism_config or ParallelismConfig()
+    plugin = fsdp_plugin
+    shard_opt = plugin is None or plugin.sharding_strategy != ShardingStrategy.NO_SHARD
+
+    # index param shardings by path for mirror matching (optax moment trees
+    # embed the param tree, so param paths appear as suffixes)
+    flat_plan = {path_str(p): s for p, s in jax.tree_util.tree_flatten_with_path(
+        params_plan, is_leaf=lambda x: isinstance(x, NamedSharding))[0]}
+
+    min_size = plugin.min_weight_size if plugin is not None else 2**12
+    if shard_opt:
+        fsdp_axes = cfg.fsdp_dim_names or (("dp_shard",) if mesh.shape.get("dp_shard", 1) > 1 else ())
+    else:
+        fsdp_axes = ()
+
+    def _leaf(path, leaf):
+        shape = tuple(leaf.shape) if hasattr(leaf, "shape") else ()
+        if not shape:
+            return NamedSharding(mesh, PartitionSpec())
+        p = path_str(path)
+        # moment tensors under optax appear with the param path as suffix
+        for param_path, sharding in flat_plan.items():
+            if p.endswith(param_path) and len(sharding.spec) <= len(shape):
+                if sharding.spec and any(s is not None for s in sharding.spec):
+                    return NamedSharding(mesh, sharding.spec)
+                break
+        return NamedSharding(mesh, _spec_for_leaf(p, shape, mesh, tuple(fsdp_axes), min_size, []))
+
+    return jax.tree_util.tree_map_with_path(_leaf, opt_state_shapes)
+
+
+# ---------------------------------------------------------------------------
+# Built-in TP rule tables (the transformers tp_plan="auto" analog,
+# reference accelerator.py:1870-1879)
+# ---------------------------------------------------------------------------
+
+# Megatron-style column/row parallel layout for transformer blocks:
+# qkv/up projections column-parallel (shard output dim), out/down projections
+# row-parallel (shard input dim), embeddings shard vocab, norms replicate.
+TRANSFORMER_TP_RULES: list[tuple[str, PartitionSpec]] = [
+    (r"(embed_tokens|embedding|wte|word_embeddings)/embedding$", PartitionSpec("tp", None)),
+    (r"(q_proj|k_proj|v_proj|query|key|value|wq|wk|wv|in_proj|qkv)/kernel$", PartitionSpec(None, "tp")),
+    (r"(o_proj|out_proj|wo|dense(?!_4h)|attn_out)/kernel$", PartitionSpec("tp", None)),
+    (r"(gate_proj|up_proj|wi|w1|w3|fc1|dense_h_to_4h|c_fc)/kernel$", PartitionSpec(None, "tp")),
+    (r"(down_proj|wo_mlp|w2|fc2|dense_4h_to_h|c_proj)/kernel$", PartitionSpec("tp", None)),
+    (r"(lm_head|output|score)/kernel$", PartitionSpec(None, "tp")),
+]
+
+
+def get_tp_rules(plan: str = "auto"):
+    """Rule table lookup (models may register their own)."""
+    if plan in ("auto", "transformer"):
+        return TRANSFORMER_TP_RULES
+    if plan in ("none", None):
+        return []
+    raise ValueError(f"unknown tp plan {plan!r}")
+
+
+def shard_params(params, plan):
+    """device_put a real param pytree onto its plan (initial placement)."""
+    return jax.tree_util.tree_map(lambda p, s: jax.device_put(p, s), params, plan)
+
+
+def replicated_plan(params, mesh: Mesh):
+    return jax.tree_util.tree_map(lambda _: NamedSharding(mesh, PartitionSpec()), params)

@@ -1,0 +1,71 @@
+"""LR scheduler wrapper.
+
+TPU-native re-design of reference ``scheduler.py`` (98 LoC,
+``AcceleratedScheduler`` :25).  optax schedules are pure functions of the
+step count, so the scheduler does not need to be 'stepped' inside the hot
+loop — the step count in the optimizer state drives it.  This wrapper keeps
+the reference's semantics for code that reads the LR or steps manually:
+
+- steps only count when the optimizer actually stepped (accumulation
+  boundary / no fp16 overflow — reference :54-68);
+- ``step_with_optimizer`` + ``split_batches=False`` advances
+  ``num_processes`` steps per call so per-process schedules line up with the
+  global-batch schedule (reference :69-82).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+from .state import AcceleratorState, GradientState
+
+
+class AcceleratedScheduler:
+    """Wraps an optax schedule (``Callable[[int], float]``)."""
+
+    def __init__(
+        self,
+        schedule: Union[Callable[[int], float], optax.Schedule],
+        optimizer=None,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        if not callable(schedule):
+            raise TypeError(f"AcceleratedScheduler expects an optax schedule callable, got {type(schedule)}")
+        self.schedule = schedule
+        self.optimizer = optimizer
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.gradient_state = GradientState()
+        self._step_count = 0
+
+    def step(self, *args, **kwargs):
+        if not self.step_with_optimizer:
+            self._step_count += 1
+            return
+        if not self.gradient_state.sync_gradients:
+            # mid-accumulation: schedule holds (but count bumps if the plugin
+            # asks schedules to track every batch — reference :62-64)
+            if self.gradient_state.plugin.adjust_scheduler:
+                return
+        if self.split_batches:
+            self._step_count += 1
+        else:
+            self._step_count += AcceleratorState().num_processes
+
+    def get_last_lr(self):
+        return [float(self.schedule(max(self._step_count - 1, 0)))]
+
+    def get_lr(self):
+        return [float(self.schedule(self._step_count))]
+
+    def state_dict(self):
+        return {"step_count": self._step_count}
+
+    def load_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step_count", 0)
+
+    def __repr__(self):
+        return f"AcceleratedScheduler(schedule={self.schedule}, step={self._step_count})"

@@ -1,0 +1,56 @@
+"""Deterministic toy fixtures (reference test_utils/training.py:
+RegressionModel/RegressionDataset — same golden-parity role, JAX-native)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class RegressionDataset:
+    """y = a*x + b + noise (reference training.py RegressionDataset)."""
+
+    def __init__(self, a=2.0, b=3.0, length=64, seed=42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.05 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def regression_init_params():
+    import jax.numpy as jnp
+
+    return {"a": jnp.zeros(()), "b": jnp.zeros(())}
+
+
+def regression_apply(params, x):
+    return params["a"] * x + params["b"]
+
+
+def regression_loss_fn(params, batch):
+    import jax.numpy as jnp
+
+    pred = regression_apply(params, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def make_regression_loader(length=64, batch_size=16, seed=42):
+    import torch
+    import torch.utils.data as tud
+
+    ds = RegressionDataset(length=length, seed=seed)
+
+    class _TorchDS(tud.Dataset):
+        def __len__(self):
+            return len(ds)
+
+        def __getitem__(self, i):
+            item = ds[i]
+            return {"x": torch.tensor(item["x"]), "y": torch.tensor(item["y"])}
+
+    return tud.DataLoader(_TorchDS(), batch_size=batch_size, shuffle=False)

@@ -1,0 +1,273 @@
+"""Accelerator end-to-end tests: the golden-parity strategy from the reference
+(test_utils/scripts/test_script.py training_check :449 — single-process
+baseline vs distributed/precision configs must produce identical or
+near-identical weights) plus grad-accumulation parity (test_sync.py :207)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+from accelerate_tpu.utils.dataclasses import (
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    ShardingStrategy,
+)
+
+
+def _train(accelerator, n_epochs=10, lr=0.1, max_grad_norm=None, batch_size=16, accum=False):
+    dl = accelerator.prepare(make_regression_loader(batch_size=batch_size))
+    tx = accelerator.prepare(optax.sgd(lr))
+    params = regression_init_params()
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.prepare_train_step(regression_loss_fn, max_grad_norm=max_grad_norm)
+    losses = []
+    for _ in range(n_epochs):
+        for batch in dl:
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    return state, losses
+
+
+def test_single_device_training_converges():
+    acc = Accelerator()
+    state, losses = _train(acc)
+    assert losses[-1] < losses[0]
+    assert float(state.params["a"]) == pytest.approx(2.0, abs=0.3)
+    assert float(state.params["b"]) == pytest.approx(3.0, abs=0.3)
+    assert int(state.step) == 40
+
+
+def test_dp_sharded_matches_baseline():
+    # golden parity: dp-sharded run produces the same weights as single-logic run
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
+    state, _ = _train(acc, n_epochs=2)
+    a_sharded, b_sharded = float(state.params["a"]), float(state.params["b"])
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc2 = Accelerator(parallelism_config=ParallelismConfig())  # all axes 1 -> but needs 8 devices
+    # use default mesh (dp over all devices is the natural default) — compare
+    # against a manual optax loop instead for a device-free baseline
+    params = regression_init_params()
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+    dl = make_regression_loader(batch_size=16)
+    for _ in range(2):
+        for batch in dl:
+            np_batch = {"x": jnp.asarray(batch["x"].numpy()), "y": jnp.asarray(batch["y"].numpy())}
+            grads = jax.grad(regression_loss_fn)(params, np_batch)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(a_sharded, float(params["a"]), rtol=1e-5)
+    np.testing.assert_allclose(b_sharded, float(params["b"]), rtol=1e-5)
+
+
+def test_gradient_accumulation_in_step_parity():
+    # accum over k microbatches == one big batch (SGD linearity)
+    acc = Accelerator(gradient_accumulation_steps=4)
+    state, _ = _train(acc, n_epochs=1, batch_size=16)
+    a_accum = float(state.params["a"])
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc2 = Accelerator()
+    state2, _ = _train(acc2, n_epochs=1, batch_size=16)
+    np.testing.assert_allclose(a_accum, float(state2.params["a"]), rtol=1e-5)
+
+
+def test_gradient_accumulation_across_steps():
+    plugin = GradientAccumulationPlugin(num_steps=2, mode="across_steps")
+    acc = Accelerator(gradient_accumulation_plugin=plugin)
+    dl = acc.prepare(make_regression_loader(batch_size=8))
+    tx = acc.prepare(optax.sgd(0.1))
+    state = acc.create_train_state(regression_init_params(), tx)
+    step = acc.prepare_train_step(regression_loss_fn)
+    params_before = float(state.params["a"])
+    batches = list(dl)
+    state, m = step(state, batches[0])
+    # first microstep: params unchanged, grads buffered
+    assert float(state.params["a"]) == params_before
+    assert int(state.accum_step) == 1
+    state, m = step(state, batches[1])
+    assert float(state.params["a"]) != params_before
+    assert int(state.accum_step) == 0
+
+
+def test_fsdp_shards_params_and_opt_state(mesh8):
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=0),
+    )
+    params = {"w": jnp.ones((16, 4)), "b": jnp.ones((4,))}
+    tx = optax.adam(1e-3)
+    state = acc.create_train_state(params, tx)
+    w_spec = state.params["w"].sharding.spec
+    assert w_spec == P("dp_shard", None) or w_spec == P(("dp_shard",), None)
+    # adam moments inherit the param sharding (ZeRO property)
+    mu_w = state.opt_state[0].mu["w"]
+    assert mu_w.sharding.spec == w_spec
+    # small scalar-ish params can't shard evenly -> b stays replicated on dim0 only if divisible
+    assert state.params["b"].sharding.spec in (P("dp_shard"), P(None), P())
+
+
+def test_tp_sharding_rules():
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=4, tp_size=2))
+    params = {"layers_0": {"q_proj": {"kernel": jnp.ones((16, 8))}, "o_proj": {"kernel": jnp.ones((8, 16))}}}
+    state = acc.create_train_state(params, optax.sgd(0.1))
+    q = state.params["layers_0"]["q_proj"]["kernel"]
+    o = state.params["layers_0"]["o_proj"]["kernel"]
+    assert q.sharding.spec[1] == "tp" or q.sharding.spec[1] == ("tp",)
+    assert o.sharding.spec[0] == "tp" or o.sharding.spec[0] == ("tp",)
+
+
+def test_fp16_loss_scaling_step():
+    # torch-GradScaler semantics: the 2^16 initial scale overflows on early
+    # steps, the scale backs off (x0.5) and overflowed steps skip the update
+    # (reference optimizer.py:163-177, scheduler hold :66-68)
+    acc = Accelerator(mixed_precision="fp16")
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), optax.sgd(0.01))
+    assert state.loss_scale is not None
+    step = acc.prepare_train_step(regression_loss_fn)
+    a0 = float(state.params["a"])
+    overflowed = stepped = False
+    for _ in range(3):
+        for batch in dl:
+            prev_a = float(state.params["a"])
+            state, metrics = step(state, batch)
+            if not bool(metrics["grads_finite"]):
+                overflowed = True
+                assert float(state.params["a"]) == prev_a  # skipped step
+            else:
+                stepped = True
+            assert np.isfinite(float(metrics["loss"]))
+    assert overflowed and stepped
+    assert float(state.loss_scale.scale) < 2.0**16
+    assert float(state.params["a"]) != a0
+
+
+def test_bf16_policy_applied():
+    acc = Accelerator(mixed_precision="bf16")
+    seen_dtypes = []
+
+    def probing_loss(params, batch):
+        seen_dtypes.append(params["a"].dtype)
+        return regression_loss_fn(params, batch)
+
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), optax.sgd(0.1))
+    step = acc.prepare_train_step(probing_loss)
+    state, _ = step(state, next(iter(dl)))
+    assert seen_dtypes[0] == jnp.bfloat16
+    assert state.params["a"].dtype == jnp.float32  # master weights stay fp32
+
+
+def test_max_grad_norm_clipping():
+    acc = Accelerator()
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), optax.sgd(1.0))
+    step = acc.prepare_train_step(regression_loss_fn, max_grad_norm=0.001)
+    before = float(state.params["a"])
+    state, metrics = step(state, next(iter(dl)))
+    # update magnitude bounded by lr * max_norm
+    assert abs(float(state.params["a"]) - before) <= 0.0011
+
+
+def test_clip_grad_norm_eager():
+    acc = Accelerator()
+    grads = {"w": jnp.full((4,), 10.0)}
+    clipped, norm = acc.clip_grad_norm_(grads, max_norm=1.0)
+    assert float(norm) == pytest.approx(20.0)
+    assert float(global_norm_of(clipped)) == pytest.approx(1.0, rel=1e-3)
+
+
+def global_norm_of(tree):
+    from accelerate_tpu.accelerator import global_norm
+
+    return global_norm(tree)
+
+
+def test_backward_raises_with_guidance():
+    acc = Accelerator()
+    with pytest.raises(RuntimeError, match="prepare_train_step"):
+        acc.backward(jnp.float32(1.0))
+
+
+def test_optimizer_step_raises_with_guidance():
+    acc = Accelerator()
+    opt = acc.prepare(optax.sgd(0.1))
+    with pytest.raises(RuntimeError, match="train step"):
+        opt.step()
+
+
+def test_prepare_preserves_order_and_types():
+    acc = Accelerator()
+    dl, tx, sched = acc.prepare(make_regression_loader(), optax.adam(1e-3), optax.linear_schedule(1e-3, 0.0, 100))
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.optimizer import AcceleratedOptimizer
+    from accelerate_tpu.scheduler import AcceleratedScheduler
+
+    assert isinstance(dl, DataLoaderShard)
+    assert isinstance(tx, AcceleratedOptimizer)
+    assert isinstance(sched, AcceleratedScheduler)
+
+
+def test_scheduler_stepping():
+    acc = Accelerator()
+    sched = acc.prepare(optax.linear_schedule(1.0, 0.0, 10))
+    sched.step()
+    assert sched._step_count == 1
+    assert sched.get_last_lr()[0] == pytest.approx(1.0)
+
+
+def test_gather_for_metrics_drops_duplicates():
+    acc = Accelerator()
+    gs = acc.gradient_state
+
+    class FakeDL:
+        end_of_dataloader = True
+        remainder = 5
+
+    gs._add_dataloader(FakeDL())
+    out = acc.gather_for_metrics(np.arange(8))
+    assert out.tolist() == [0, 1, 2, 3, 4]
+    gs._remove_dataloader(gs.active_dataloader)
+
+
+def test_accumulate_context_flags():
+    plugin = GradientAccumulationPlugin(num_steps=2, mode="across_steps")
+    acc = Accelerator(gradient_accumulation_plugin=plugin)
+    with acc.accumulate():
+        assert not acc.sync_gradients
+    with acc.accumulate():
+        assert acc.sync_gradients
+
+
+def test_eval_step():
+    acc = Accelerator(mixed_precision="bf16")
+    state = acc.create_train_state(regression_init_params(), optax.sgd(0.1))
+
+    def eval_fn(params, batch):
+        return params["a"] * batch["x"] + params["b"]
+
+    estep = acc.prepare_eval_step(eval_fn)
+    out = estep(state.params, {"x": jnp.ones(4)})
+    assert out.shape == (4,)
+
+
+def test_set_and_check_trigger():
+    acc = Accelerator()
+    assert not acc.check_trigger()
+    acc.set_trigger()
+    assert acc.check_trigger()
+    assert not acc.check_trigger()  # reset after firing
