@@ -1,0 +1,10 @@
+from .bert import BertConfig, BertForSequenceClassification, make_bert_loss_fn
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    causal_lm_loss,
+    count_params,
+    flops_per_token,
+    make_llama_loss_fn,
+)
+from .resnet import ResNet, ResNetConfig, make_resnet_loss_fn

@@ -1,0 +1,127 @@
+"""BERT-class encoder for sequence classification — the ``nlp_example`` model
+(reference examples/nlp_example.py fine-tunes bert-base on GLUE/MRPC; that
+script is BASELINE.json config #1).
+
+TPU-first: same MXU-friendly shapes, fp32 softmax, pluggable attention; the
+parameter naming (query/key/value/dense) matches the TP rule table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .llama import native_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=128, max_position_embeddings=128)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        b, t, _ = x.shape
+        q = dense(cfg.hidden_size, name="query")(x).reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
+        k = dense(cfg.hidden_size, name="key")(x).reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
+        v = dense(cfg.hidden_size, name="value")(x).reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
+        segment_ids = None
+        if attention_mask is not None:
+            # padding mask as segment ids: pad tokens form their own segment
+            segment_ids = attention_mask.astype(jnp.int32)
+        out = native_attention(q, k, v, causal=False, segment_ids=segment_ids)
+        out = out.reshape(b, t, cfg.hidden_size)
+        return dense(cfg.hidden_size, name="dense")(out)
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None):
+        cfg = self.config
+        ln = partial(nn.LayerNorm, epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=jnp.float32)
+        attn_out = BertSelfAttention(cfg, name="attention")(x, attention_mask)
+        x = ln(name="attention_norm")(x + attn_out)
+        dense = partial(nn.Dense, dtype=cfg.dtype, param_dtype=jnp.float32)
+        h = dense(cfg.intermediate_size, name="intermediate")(x)
+        h = nn.gelu(h, approximate=False)
+        h = dense(cfg.hidden_size, name="output")(h)
+        return ln(name="output_norm")(x + h)
+
+
+class BertForSequenceClassification(nn.Module):
+    """``__call__(input_ids, attention_mask, token_type_ids) -> logits``."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        b, t = input_ids.shape
+        embed = partial(nn.Embed, features=cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32)
+        x = embed(cfg.vocab_size, name="word_embeddings")(input_ids)
+        x = x + embed(cfg.max_position_embeddings, name="position_embeddings")(
+            jnp.broadcast_to(jnp.arange(t), (b, t))
+        )
+        if token_type_ids is not None:
+            x = x + embed(cfg.type_vocab_size, name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="embeddings_norm")(x)
+        for i in range(cfg.num_hidden_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+        pooled = nn.tanh(
+            nn.Dense(cfg.hidden_size, dtype=jnp.float32, param_dtype=jnp.float32, name="pooler")(
+                x[:, 0].astype(jnp.float32)
+            )
+        )
+        return nn.Dense(cfg.num_labels, dtype=jnp.float32, param_dtype=jnp.float32, name="classifier")(pooled)
+
+
+def make_bert_loss_fn(model: BertForSequenceClassification):
+    def loss_fn(params, batch):
+        logits = model.apply(
+            params,
+            batch["input_ids"],
+            attention_mask=batch.get("attention_mask"),
+            token_type_ids=batch.get("token_type_ids"),
+        )
+        labels = batch["labels"]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+    return loss_fn

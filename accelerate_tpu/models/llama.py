@@ -1,0 +1,271 @@
+"""Llama-family decoder — the flagship model (BASELINE.json north star:
+Llama-2-7B fine-tune; reference exercises it via transformers + FSDP2,
+benchmarks/fsdp2 + examples/torch_native_parallelism).
+
+TPU-first design notes:
+- bf16 compute / fp32 master weights via the Accelerator policy; all matmuls
+  shaped for the MXU (head_dim multiples of 128 recommended).
+- Parameter paths (``q_proj/k_proj/v_proj/o_proj``, ``gate_proj/up_proj/
+  down_proj``, ``embed_tokens``, ``lm_head``) line up with the TP rule table
+  (parallel/sharding.py TRANSFORMER_TP_RULES), so tensor parallelism is pure
+  sharding annotation.
+- Attention implementation is pluggable: "native" (XLA fused softmax),
+  "flash" (Pallas kernel, ops/flash_attention.py), "ring" (context-parallel
+  shard_map kernel, parallel/context_parallel.py) — selected by config.
+- ``remat`` wraps each block in ``jax.checkpoint`` (the activation-
+  checkpointing analog, reference fsdp_utils.py:588).
+- GQA (num_kv_heads < num_heads) supported throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    attn_implementation: str = "native"  # native | flash | ring
+    remat: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-scale config (toy fixture role, reference test_utils)."""
+        defaults = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def llama2_7b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def llama3_8b(cls, **kw):
+        defaults = dict(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            rope_theta=500000.0, max_position_embeddings=8192,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def llama2_1b(cls, **kw):
+        """~1.1B config (TinyLlama-style) — fits one v5e chip in bf16."""
+        defaults = dict(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=22, num_attention_heads=32, num_key_value_heads=4,
+            max_position_embeddings=2048,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float) -> tuple[np.ndarray, np.ndarray]:
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(max_len, dtype=np.float32)
+    freqs = np.outer(t, inv_freq)
+    return np.cos(freqs), np.sin(freqs)
+
+
+def apply_rope(x, cos, sin, positions):
+    """x: [B, T, H, D]; cos/sin: [max_len, D/2]; positions: [B, T]."""
+    cos = cos[positions][:, :, None, :]  # [B, T, 1, D/2]
+    sin = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def native_attention(q, k, v, *, causal: bool = True, segment_ids=None):
+    """Reference-semantics attention, fp32 softmax, XLA-fused.
+
+    q: [B, T, H, D]; k/v: [B, S, Hkv, D] (GQA broadcast here)."""
+    b, t, h, d = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    if hkv != h:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(seg_mask[:, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def get_attention_impl(name: str) -> Callable:
+    if name == "native":
+        return native_attention
+    if name == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention
+    if name == "ring":
+        from ..parallel.context_parallel import ring_attention
+
+        return ring_attention
+    raise ValueError(f"unknown attention implementation {name!r}")
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        q = dense(cfg.num_attention_heads * cfg.head_dim, name="q_proj")(x)
+        k = dense(cfg.num_key_value_heads * cfg.head_dim, name="k_proj")(x)
+        v = dense(cfg.num_key_value_heads * cfg.head_dim, name="v_proj")(x)
+        b, t = x.shape[:2]
+        q = q.reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
+
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_position_embeddings, cfg.rope_theta)
+        cos, sin = jnp.asarray(cos), jnp.asarray(sin)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+
+        attn = get_attention_impl(cfg.attn_implementation)
+        out = attn(q, k, v, causal=True, segment_ids=segment_ids)
+        out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
+        return dense(cfg.hidden_size, name="o_proj")(out)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
+        up = dense(cfg.intermediate_size, name="up_proj")(x)
+        return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        h = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x), positions, segment_ids
+        )
+        out = h + LlamaMLP(cfg, name="mlp")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h)
+        )
+        return out
+
+
+class LlamaForCausalLM(nn.Module):
+    """Decoder LM head model.  ``__call__(input_ids) -> logits``."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.config
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed_tokens"
+        )
+        x = embed(input_ids)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.num_hidden_layers):
+            x = block(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+        if cfg.tie_word_embeddings:
+            logits = embed.attend(x.astype(jnp.float32))
+        else:
+            logits = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="lm_head"
+            )(x.astype(jnp.float32))
+        return logits
+
+
+def causal_lm_loss(logits, labels, ignore_index: int = -100):
+    """Shifted next-token cross-entropy (matches transformers CausalLM loss)."""
+    logits = logits[:, :-1].astype(jnp.float32)
+    labels = labels[:, 1:]
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    return -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def make_llama_loss_fn(model: LlamaForCausalLM):
+    def loss_fn(params, batch):
+        logits = model.apply(params, batch["input_ids"], segment_ids=batch.get("segment_ids"))
+        return causal_lm_loss(logits, batch["labels"])
+
+    return loss_fn
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token ≈ 6*N + 12*L*H*D*T attention term (PaLM appendix
+    formula) — used for MFU accounting in bench.py."""
+    n_params = (
+        cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_word_embeddings else 2)
+        + cfg.num_hidden_layers * (
+            cfg.hidden_size * cfg.head_dim * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads)
+            + cfg.num_attention_heads * cfg.head_dim * cfg.hidden_size
+            + 3 * cfg.hidden_size * cfg.intermediate_size
+            + 2 * cfg.hidden_size
+        )
+        + cfg.hidden_size
+    )
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.num_attention_heads * cfg.head_dim * seq_len
+    return 6 * n_params + attn_flops

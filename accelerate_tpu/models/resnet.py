@@ -1,0 +1,100 @@
+"""ResNet image classifier — the ``cv_example`` model (reference
+examples/cv_example.py trains a ResNet; BASELINE.json config #2).
+
+TPU-first: NHWC layout (XLA's preferred conv layout on TPU), bf16 compute,
+fp32 batch-norm statistics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)  # resnet18
+    num_filters: int = 64
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def resnet18(cls, **kw):
+        return cls(stage_sizes=(2, 2, 2, 2), **kw)
+
+    @classmethod
+    def resnet50(cls, **kw):
+        return cls(stage_sizes=(3, 4, 6, 3), **kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(stage_sizes=(1, 1), num_filters=8, num_classes=10)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), name="conv2")(y)
+        y = norm(name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters, (1, 1), strides=(self.strides, self.strides), name="downsample")(x)
+            residual = norm(name="bn_down")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """``__call__(images[B,H,W,C]) -> logits`` with batch-norm mutable state
+    under the 'batch_stats' collection."""
+
+    config: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.config
+        x = nn.Conv(cfg.num_filters, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=jnp.float32, name="stem_conv")(x.astype(cfg.dtype))
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, num_blocks in enumerate(cfg.stage_sizes):
+            for block in range(num_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = ResNetBlock(cfg.num_filters * 2**stage, strides=strides, dtype=cfg.dtype,
+                                name=f"stage{stage}_block{block}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, param_dtype=jnp.float32, name="classifier")(
+            x.astype(jnp.float32)
+        )
+
+
+def make_resnet_loss_fn(model: ResNet):
+    import jax
+
+    def loss_fn(params_and_stats, batch):
+        params = {"params": params_and_stats["params"], "batch_stats": params_and_stats["batch_stats"]}
+        logits, updates = model.apply(
+            params, batch["image"], train=True, mutable=["batch_stats"]
+        )
+        labels = batch["label"]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+        return loss, updates["batch_stats"]
+
+    return loss_fn
