@@ -1,0 +1,222 @@
+"""Fused flash attention — Pallas TPU kernel.
+
+The MFU-critical op (SURVEY §7 hard parts: '≥45% MFU on v5e requires fused
+flash attention').  Blockwise online-softmax attention: K/V stream through
+VMEM in (block_k, head_dim) tiles while a (block_q, head_dim) fp32 accumulator
+and running (max, denom) stats live in scratch — memory O(T) instead of
+O(T²), and every matmul lands on the MXU at 128-aligned tiles.
+
+Causal masking skips fully-masked KV blocks (upper-triangular blocks cost
+zero compute — the grid still visits them but predication makes them free).
+
+Backward: recompute-based custom VJP — the forward kernel saves only (out,
+logsumexp); the backward recomputes attention blockwise via XLA (fused by the
+compiler, fp32 softmax).  This is the standard TPU trade: HBM traffic is the
+bottleneck, recompute is cheap on the MXU.
+
+Falls back to interpret mode off-TPU so the same tests run on the CPU mesh.
+reference parity: the engines' flash kernels (torch sdpa/TE fused attn) the
+reference delegates to (SURVEY §2.4 P8 note — 'blockwise = flash-attention
+Pallas kernel tiling').
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, sm_scale, block_q, block_k, seq_len):
+    """Grid: (batch*heads, q_blocks, kv_blocks); kv dim is innermost/serial."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    # causal: skip blocks entirely above the diagonal
+    should_compute = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(rows >= cols, scores, DEFAULT_MASK_VALUE)
+
+        m_prev = m_scratch[:]  # [block_q, 1]
+        m_cur = jnp.max(scores, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scratch[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scratch[:] = m_new
+        l_scratch[:] = l_new
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scratch[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scratch[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scratch[:] + jnp.log(safe_l))[:, 0]
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
+    """q/k/v: [BH, T, D] → (out [BH, T, D], lse [BH, T])."""
+    bh, t, d = q.shape
+    s = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, s)
+    grid = (bh, pl.cdiv(t, block_q), pl.cdiv(s, block_k))
+
+    kernel = functools.partial(
+        _attn_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k, seq_len=s
+    )
+    scratch_shapes = []
+    if _HAS_PLTPU:
+        scratch_shapes = [
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ]
+        compiler_params = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        )
+    else:  # pragma: no cover
+        raise RuntimeError("pallas tpu backend unavailable")
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            # lse carried as [BH, 1, T] so the block's last two dims meet
+            # the (8, 128) tiling rule: (1, block_q) with 1 == array dim
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
+        ],
+        scratch_shapes=scratch_shapes,
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, 0, :]
+
+
+def _reference_attention(q, k, v, causal, sm_scale):
+    """[BH, T, D] XLA attention used for the recompute backward."""
+    scores = jnp.einsum("btd,bsd->bts", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        t, s = scores.shape[-2:]
+        mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
+        scores = jnp.where(mask[None], scores, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bts,bsd->btd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+
+    def f(q, k, v):
+        return _reference_attention(q, k, v, causal, sm_scale)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    *,
+    causal: bool = True,
+    segment_ids=None,
+    sm_scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """Drop-in replacement for :func:`models.llama.native_attention`.
+
+    q: [B, T, H, D]; k/v: [B, S, Hkv, D] (GQA handled by repeat).
+    segment_ids unsupported in the fused kernel (falls back to native).
+    """
+    if segment_ids is not None:
+        from ..models.llama import native_attention
+
+        return native_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+
+    b, t, h, d = q.shape
+    s, hkv = k.shape[1], k.shape[2]
+    if hkv != h:
+        rep = h // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    if interpret is None:
+        interpret = not _on_tpu()
+
+    # [B, T, H, D] -> [B*H, T, D]
+    def to_bhd(x, length):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, length, d)
+
+    out = _flash(to_bhd(q, t), to_bhd(k, s), to_bhd(v, s), causal, sm_scale, block_q, block_k, interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)

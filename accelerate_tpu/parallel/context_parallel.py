@@ -1,0 +1,204 @@
+"""Context parallelism: ring attention over the ``cp`` mesh axis.
+
+TPU-native re-design of reference CP (P8, accelerator.py:1641-1654 +
+maybe_context_parallel :4076-4140): the sequence dimension is sharded over
+``cp`` and attention runs blockwise while KV shards rotate around the ring.
+
+Two rotate methods, matching the reference's ``set_rotate_method``:
+- ``allgather``: gather all KV once, one local attention (cheap at moderate
+  seq, one collective);
+- ``alltoall`` (ring): KV streams neighbor-to-neighbor via ``ppermute`` over
+  ICI; memory O(T/cp), comm overlapped with compute by XLA's latency-hiding
+  scheduler — this is ring attention proper.
+
+Causal masking across shards uses **zigzag load balancing** (reference CP
+docs' load-balanced ordering): shard i holds chunks (i, 2cp-1-i) so every
+rank does equal causal work.  Helpers ``zigzag_shard``/``zigzag_unshard``
+reorder the sequence on the host before sharding.
+
+Numerics: blockwise online-softmax combine across ring steps (same math as
+flash attention's running max/denom, applied shard-to-shard in fp32).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scores_mask, sm_scale):
+    """One (q-shard, kv-shard) block: returns (numerator, denom, max) in fp32.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; scores_mask: [Tq, Tk] bool or None.
+    """
+    scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * sm_scale
+    if scores_mask is not None:
+        scores = jnp.where(scores_mask[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)  # [B,H,Tq,1]
+    # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1 — zero them
+    row_valid = m > NEG_INF / 2
+    p = jnp.where(row_valid, jnp.exp(scores - m), 0.0)
+    num = jnp.einsum("bhts,bshd->bthd", p.astype(v.dtype), v).astype(jnp.float32)
+    denom = jnp.sum(p, axis=-1)[..., None].transpose(0, 2, 1, 3)  # [B,Tq,H,1]
+    m = m[..., 0].transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
+    return num, denom, m
+
+
+def _combine(acc, new):
+    """Online-softmax combine of two partial attentions."""
+    num_a, den_a, m_a = acc
+    num_n, den_n, m_n = new
+    m = jnp.maximum(m_a, m_n)
+    alpha = jnp.exp(m_a - m)
+    beta = jnp.exp(m_n - m)
+    return (num_a * alpha + num_n * beta, den_a * alpha + den_n * beta, m)
+
+
+def _chunk_index_map(cp: int):
+    """Zigzag layout: rank i holds global chunks (i, 2cp-1-i)."""
+    return [(i, 2 * cp - 1 - i) for i in range(cp)]
+
+
+def zigzag_shard(x, cp: int, axis: int = 1):
+    """Reorder a [B, T, ...] array so contiguous per-rank shards carry zigzag
+    chunk pairs.  Apply on host before forming the global array."""
+    t = x.shape[axis]
+    assert t % (2 * cp) == 0, f"seq len {t} must divide 2*cp={2*cp}"
+    chunks = np.split(np.asarray(x), 2 * cp, axis=axis)
+    order = [c for pair in _chunk_index_map(cp) for c in pair]
+    return np.concatenate([chunks[i] for i in order], axis=axis)
+
+
+def zigzag_unshard(x, cp: int, axis: int = 1):
+    t = x.shape[axis]
+    chunks = np.split(np.asarray(x), 2 * cp, axis=axis)
+    order = [c for pair in _chunk_index_map(cp) for c in pair]
+    inverse = np.argsort(order)
+    return np.concatenate([chunks[i] for i in inverse], axis=axis)
+
+
+def _zigzag_positions(t_local: int, t_global: int, cp_rank, cp: int):
+    """Global token positions held by ``cp_rank`` under zigzag layout."""
+    chunk = t_global // (2 * cp)
+    first = cp_rank * chunk
+    second = (2 * cp - 1 - cp_rank) * chunk
+    return jnp.concatenate([first + jnp.arange(chunk), second + jnp.arange(chunk)])
+
+
+def ring_attention_sharded(
+    q, k, v, *, axis_name: str = "cp", causal: bool = True, sm_scale: Optional[float] = None,
+    rotate_method: str = "alltoall", zigzag: bool = True,
+):
+    """The shard_map body: q/k/v are LOCAL shards [B, T/cp, H, D].
+
+    With ``alltoall`` KV rotates ``cp`` times around the ring (ppermute);
+    with ``allgather`` KV is gathered once and attention is a single local
+    block.  Causal masks are built from global zigzag positions.
+    """
+    cp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    t_global = t_local * cp
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+
+    if zigzag and causal:
+        q_pos = _zigzag_positions(t_local, t_global, rank, cp)
+    else:
+        q_pos = rank * t_local + jnp.arange(t_local)
+
+    def mask_for(kv_rank):
+        if not causal:
+            return None
+        if zigzag:
+            k_pos = _zigzag_positions(t_local, t_global, kv_rank, cp)
+        else:
+            k_pos = kv_rank * t_local + jnp.arange(t_local)
+        return q_pos[:, None] >= k_pos[None, :]
+
+    if rotate_method == "allgather":
+        k_all = lax.all_gather(k, axis_name, axis=0, tiled=False)  # [cp, B, T/cp, H, D]
+        v_all = lax.all_gather(v, axis_name, axis=0, tiled=False)
+        acc = None
+        for kv_rank in range(cp):
+            part = _block_attend(q, k_all[kv_rank], v_all[kv_rank], mask_for(kv_rank), sm_scale)
+            acc = part if acc is None else _combine(acc, part)
+        num, den, _ = acc
+        return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+
+    # ring: step s sees KV originally from rank (rank - s) mod cp
+    def ring_step(s, carry):
+        k_cur, v_cur, acc = carry
+        kv_rank = (rank - s) % cp
+        masks = [mask_for(r) for r in range(cp)]
+        mask = None
+        if causal:
+            # select the right mask for this step's kv source rank
+            mask = jnp.stack(masks)[kv_rank]
+        part = _block_attend(q, k_cur, v_cur, mask, sm_scale)
+        acc = _combine(acc, part)
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc)
+
+    zero_acc = (
+        jnp.zeros((b, t_local, h, d), jnp.float32),
+        jnp.zeros((b, t_local, h, 1), jnp.float32),
+        jnp.full((b, t_local, h, 1), NEG_INF, jnp.float32),
+    )
+    carry = (k, v, zero_acc)
+    for s in range(cp):  # unrolled: cp is small; lets XLA overlap ppermute+compute
+        carry = ring_step(s, carry)
+    _, _, (num, den, _) = carry
+    return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = "alltoall", zigzag: bool = True):
+    """Build the mesh-bound ring attention usable inside a jitted model.
+
+    Returns ``attn(q, k, v, causal=True, segment_ids=None)`` operating on
+    GLOBAL arrays whose sequence dim is sharded over ``axis_name``.
+    """
+
+    def attn(q, k, v, *, causal: bool = True, segment_ids=None):
+        if segment_ids is not None:
+            raise NotImplementedError("ring attention does not support segment_ids yet")
+        h_kv = k.shape[2]
+        h_q = q.shape[2]
+        if h_kv != h_q:
+            rep = h_q // h_kv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        spec = P(None, axis_name, None, None)
+        body = functools.partial(
+            ring_attention_sharded, axis_name=axis_name, causal=causal,
+            rotate_method=rotate_method, zigzag=zigzag,
+        )
+        return shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
+        )(q, k, v)
+
+    return attn
+
+
+def ring_attention(q, k, v, *, causal: bool = True, segment_ids=None):
+    """Config-name entry (models.llama attn_implementation='ring'): resolves
+    the mesh from the ambient AcceleratorState."""
+    from ..state import AcceleratorState
+
+    state = AcceleratorState()
+    cfg = state.parallelism_config
+    rotate = "alltoall"
+    return make_ring_attention(state.mesh, rotate_method=rotate)(
+        q, k, v, causal=causal, segment_ids=segment_ids
+    )

@@ -1,0 +1,120 @@
+"""Ulysses/ALST sequence parallelism over the ``sp`` mesh axis.
+
+TPU-native re-design of reference P9 (DeepSpeed ``UlyssesSPAttentionHF``
+head-scatter all-to-all + ``UlyssesSPDataLoaderAdapter`` sequence sharding,
+reference accelerator.py:2370-2409): activations are sharded along the
+*sequence* dim everywhere except inside attention, where two ``all_to_all``s
+re-shard to the *head* dim so every rank computes full-sequence attention for
+its subset of heads — 'two all_to_alls around attention', the natural
+``shard_map`` over ICI (SURVEY §2.4 P9).
+
+Requires num_heads % sp == 0 and seq_len % sp == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ulysses_attention_sharded(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+                              inner_attn: Optional[Callable] = None):
+    """shard_map body.  q/k/v local: [B, T/sp, H, D] → out [B, T/sp, H, D].
+
+    all_to_all #1: seq-sharded → head-sharded ([B, T, H/sp, D]);
+    full-sequence attention on local heads;
+    all_to_all #2: back to seq-sharded.
+    """
+    sp = lax.axis_size(axis_name)
+    b, t_local, h, d = q.shape
+
+    def seq2head(x):
+        # split heads across ranks, concat sequence: [B, T/sp, H, D] -> [B, T, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    q_h, k_h, v_h = seq2head(q), seq2head(k), seq2head(v)
+    if inner_attn is None:
+        from ..models.llama import native_attention
+
+        inner_attn = native_attention
+    out_h = inner_attn(q_h, k_h, v_h, causal=causal)
+    return head2seq(out_h)
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Optional[Callable] = None):
+    """Mesh-bound Ulysses attention on GLOBAL arrays (seq dim sharded over
+    ``axis_name``)."""
+
+    def attn(q, k, v, *, causal: bool = True, segment_ids=None):
+        if segment_ids is not None:
+            raise NotImplementedError("ulysses attention does not support segment_ids yet")
+        h_q, h_kv = q.shape[2], k.shape[2]
+        sp = mesh.shape[axis_name]
+        if h_kv != h_q:
+            rep = h_q // h_kv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if h_q % sp != 0:
+            raise ValueError(f"num_heads {h_q} must be divisible by sp={sp}")
+        spec = P(None, axis_name, None, None)
+        body = functools.partial(ulysses_attention_sharded, axis_name=axis_name, causal=causal,
+                                 inner_attn=inner_attn)
+        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                         check_rep=False)(q, k, v)
+
+    return attn
+
+
+def ulysses_attention(q, k, v, *, causal: bool = True, segment_ids=None):
+    """Config-name entry resolving the ambient mesh."""
+    from ..state import AcceleratorState
+
+    state = AcceleratorState()
+    return make_ulysses_attention(state.mesh)(q, k, v, causal=causal, segment_ids=segment_ids)
+
+
+# ---------------------------------------------------------------------------
+# Sequence-sharding dataloader adapter
+# (reference UlyssesSPDataLoaderAdapter accelerator.py:2396-2409)
+# ---------------------------------------------------------------------------
+
+
+def shard_batch_along_sequence(batch, mesh: Mesh, axis_name: str = "sp", seq_axis: int = 1,
+                               batch_axes=("dp_replicate", "dp_shard")):
+    """Re-spec a global batch so its sequence dim is sharded over sp/cp.
+
+    The loss must then be averaged with the sequence shards in the
+    denominator — use ``cross_rank_token_mean`` below (the reference's
+    dp_cp loss-averaging dims, parallelism_config.py:146-155)."""
+    from jax.sharding import NamedSharding
+
+    def _respec(x):
+        if np.ndim(x) <= seq_axis:
+            return x
+        entries: list = [tuple(a for a in batch_axes if mesh.shape[a] > 1) or None]
+        entries += [None] * (np.ndim(x) - 1)
+        entries[seq_axis] = axis_name
+        return jax.device_put(x, NamedSharding(mesh, P(*entries)))
+
+    return jax.tree_util.tree_map(_respec, batch)
+
+
+def cross_rank_token_mean(per_token_loss, mask, axis_names):
+    """Differentiable cross-rank loss aggregation (reference Ulysses loss
+    helper): sum(loss*mask)/sum(mask) with both sums psum'd over the sequence
+    (and dp) axes — call inside shard_map or rely on GSPMD reductions."""
+    num = jnp.sum(per_token_loss * mask)
+    den = jnp.sum(mask)
+    num = lax.psum(num, axis_names)
+    den = lax.psum(den, axis_names)
+    return num / jnp.maximum(den, 1.0)

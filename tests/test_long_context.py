@@ -1,0 +1,152 @@
+"""Long-context attention tests: flash (interpret), ring CP (both rotate
+methods, zigzag), Ulysses SP — all against the native reference on the
+8-device CPU mesh (reference parity role: CP/SP correctness, SURVEY §5
+'long-context')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.models.llama import native_attention
+from accelerate_tpu.ops.flash_attention import flash_attention
+from accelerate_tpu.parallel.context_parallel import (
+    make_ring_attention,
+    zigzag_shard,
+    zigzag_unshard,
+)
+from accelerate_tpu.parallel.sequence_parallel import make_ulysses_attention
+from accelerate_tpu.parallelism_config import ParallelismConfig
+
+
+def _qkv(b=2, t=32, h=4, d=8, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, t, h, d)), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.fixture
+def cp_mesh():
+    return ParallelismConfig(cp_size=8).build_device_mesh()
+
+
+@pytest.fixture
+def sp_mesh():
+    return ParallelismConfig(sp_size=4, dp_shard_size=2).build_device_mesh()
+
+
+def test_flash_matches_native_interpret():
+    q, k, v = _qkv()
+    for causal in (True, False):
+        ref = native_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_grads_match_native():
+    q, k, v = _qkv()
+    f = lambda q: jnp.sum(flash_attention(q, k, v, causal=True, block_q=8, block_k=8, interpret=True) ** 2)
+    g = lambda q: jnp.sum(native_attention(q, k, v, causal=True) ** 2)
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(q)), np.asarray(jax.grad(g)(q)), atol=5e-5)
+
+
+def test_flash_gqa():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 16, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 16, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 16, 2, 8)), jnp.float32)
+    ref = native_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=8, block_k=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("rotate", ["allgather", "alltoall"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_native(cp_mesh, rotate, causal):
+    q, k, v = _qkv(t=32)
+    ref = native_attention(q, k, v, causal=causal)
+    # zigzag layout: host-reorder, shard, attend, un-reorder
+    qz = jnp.asarray(zigzag_shard(q, 8))
+    kz = jnp.asarray(zigzag_shard(k, 8))
+    vz = jnp.asarray(zigzag_shard(v, 8))
+    spec = NamedSharding(cp_mesh, P(None, "cp", None, None))
+    qz, kz, vz = jax.device_put(qz, spec), jax.device_put(kz, spec), jax.device_put(vz, spec)
+    attn = make_ring_attention(cp_mesh, rotate_method=rotate, zigzag=True)
+    out = attn(qz, kz, vz, causal=causal)
+    out = zigzag_unshard(np.asarray(out), 8)
+    np.testing.assert_allclose(out, np.asarray(ref), atol=1e-4)
+
+
+def test_ring_attention_gqa(cp_mesh):
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 32, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    ref = native_attention(q, k, v, causal=True)
+    qz, kz, vz = (jnp.asarray(zigzag_shard(x, 8)) for x in (q, k, v))
+    attn = make_ring_attention(cp_mesh, rotate_method="alltoall", zigzag=True)
+    out = zigzag_unshard(np.asarray(attn(qz, kz, vz, causal=True)), 8)
+    np.testing.assert_allclose(out, np.asarray(ref), atol=1e-4)
+
+
+def test_ring_attention_differentiable(cp_mesh):
+    q, k, v = _qkv(t=16)
+    attn = make_ring_attention(cp_mesh, rotate_method="alltoall", zigzag=False)
+
+    def f(q):
+        return jnp.sum(attn(q, k, v, causal=True) ** 2)
+
+    def g(q):
+        return jnp.sum(native_attention(q, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(q)), np.asarray(jax.grad(g)(q)), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_native(sp_mesh, causal):
+    q, k, v = _qkv(t=32, h=4)
+    ref = native_attention(q, k, v, causal=causal)
+    spec = NamedSharding(sp_mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    attn = make_ulysses_attention(sp_mesh)
+    out = attn(qs, ks, vs, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_ulysses_head_divisibility_error(sp_mesh):
+    q, k, v = _qkv(t=32, h=3)
+    attn = make_ulysses_attention(sp_mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        attn(q, k, v)
+
+
+def test_ulysses_in_jitted_train_step(sp_mesh):
+    """Ulysses attention composes under jit + grad (the train-step path)."""
+    q, k, v = _qkv(t=32, h=4)
+    attn = make_ulysses_attention(sp_mesh)
+
+    @jax.jit
+    def loss(q, k, v):
+        return jnp.sum(attn(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss)(q, k, v)
+    assert g.shape == q.shape
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_cross_rank_token_mean(sp_mesh):
+    from jax.experimental.shard_map import shard_map
+
+    from accelerate_tpu.parallel.sequence_parallel import cross_rank_token_mean
+
+    loss = jnp.arange(32.0).reshape(1, 32)
+    mask = jnp.ones((1, 32))
+
+    def body(loss, mask):
+        return cross_rank_token_mean(loss, mask, ("sp",))
+
+    f = shard_map(body, mesh=sp_mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+                  out_specs=P(), check_rep=False)
+    out = float(f(loss, mask))
+    assert out == pytest.approx(float(jnp.mean(loss)))
