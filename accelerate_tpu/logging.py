@@ -1,0 +1,58 @@
+"""Multi-process-aware logging.
+
+Port of reference ``logging.py`` (126 LoC): ``MultiProcessAdapter`` (:23)
+gates records on ``main_process_only`` and supports ``in_order`` rank-by-rank
+emission (barrier-sequenced)."""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """reference logging.py:23 — same kwargs contract:
+    ``logger.info(msg, main_process_only=True)`` or ``in_order=True``."""
+
+    @staticmethod
+    def _should_log(main_process_only):
+        from .state import PartialState
+
+        return not main_process_only or PartialState().is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if int(os.environ.get("ACCELERATE_LOG_LEVEL", -1)) >= 0:
+            self.logger.setLevel(int(os.environ["ACCELERATE_LOG_LEVEL"]))
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        if self.isEnabledFor(level):
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            elif in_order:
+                from .state import PartialState
+
+                state = PartialState()
+                for i in range(state.num_processes):
+                    if i == state.process_index:
+                        msg, kwargs = self.process(msg, kwargs)
+                        self.logger.log(level, msg, *args, **kwargs)
+                    state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str = None) -> MultiProcessAdapter:
+    """reference get_logger (logging.py:84)."""
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    logger = logging.getLogger(name)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

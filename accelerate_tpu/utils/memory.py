@@ -1,0 +1,92 @@
+"""Memory utilities.
+
+Port of reference ``utils/memory.py``: ``find_executable_batch_size`` (:115)
+— the OOM-retry decorator that halves the batch size until the function
+succeeds — and ``release_memory`` (:66).  On JAX the OOM signal is
+``XlaRuntimeError: RESOURCE_EXHAUSTED`` (HBM) instead of torch's
+``CUDA out of memory``; device stats come from ``Device.memory_stats()``.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+import jax
+
+
+def release_memory(*objects):
+    """Drop references + free compiled executables/live buffers
+    (reference memory.py:66)."""
+    if len(objects) == 1 and isinstance(objects[0], (list, tuple)):
+        objects = list(objects[0])
+    else:
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    gc.collect()
+    jax.clear_caches()
+    return objects
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """True for HBM/host OOM errors (reference should_reduce_batch_size
+    memory.py:84 — same role, XLA error strings)."""
+    statements = (
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "Resource exhausted",
+        "Allocation failure",
+    )
+    if isinstance(exception, MemoryError):
+        return True
+    return isinstance(exception, Exception) and any(s in str(exception) for s in statements)
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None, starting_batch_size: int = 128
+):
+    """Decorator: retries ``function(batch_size, ...)`` halving
+    ``batch_size`` on OOM (reference memory.py:115-176)."""
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    batch_size_holder = [starting_batch_size]
+
+    def decorator(*args, **kwargs):
+        batch_size_holder[0] = starting_batch_size
+        while True:
+            if batch_size_holder[0] == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                params = list(inspect.signature(function).parameters.keys())
+                if len(params) < 1 or params[0] != "batch_size":
+                    raise TypeError(
+                        f"Batch size was passed into `{function.__name__}` as the first argument, but its "
+                        f"signature is {params} — the first argument must be `batch_size`."
+                    )
+                return function(batch_size_holder[0], *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    gc.collect()
+                    jax.clear_caches()
+                    batch_size_holder[0] //= 2
+                else:
+                    raise
+
+    return decorator
+
+
+def get_device_memory_stats(device=None) -> dict:
+    """HBM stats for observability (reference device memory probes,
+    SURVEY §2.9 TPU-native note)."""
+    device = device or jax.local_devices()[0]
+    stats = device.memory_stats() or {}
+    return {
+        "bytes_in_use": stats.get("bytes_in_use", 0),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use", 0),
+        "bytes_limit": stats.get("bytes_limit", 0),
+    }

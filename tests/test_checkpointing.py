@@ -1,0 +1,180 @@
+"""Checkpoint/resume tests (mirror of reference tests/test_state_checkpointing.py:
+save/load roundtrip, automatic naming + retention GC, RNG restore, custom
+objects, model export/merge)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.checkpointing import (
+    list_checkpoints,
+    load_model_params,
+    merge_weights,
+    parse_size,
+    save_model,
+)
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+
+def _setup(tmp_path, **acc_kwargs):
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+        ),
+        **acc_kwargs,
+    )
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), optax.adam(0.05))
+    step = acc.prepare_train_step(regression_loss_fn)
+    return acc, dl, state, step
+
+
+def test_save_load_roundtrip(tmp_path):
+    acc, dl, state, step = _setup(tmp_path)
+    for batch in dl:
+        state, _ = step(state, batch)
+    ckpt_dir = acc.save_state(train_state=state)
+    a_saved = float(state.params["a"])
+    step_saved = int(state.step)
+
+    # continue training, then restore
+    for batch in dl:
+        state, _ = step(state, batch)
+    assert float(state.params["a"]) != a_saved
+
+    template = acc.create_train_state(regression_init_params(), optax.adam(0.05))
+    restored = acc.load_state(ckpt_dir, train_state=template)
+    assert float(restored.params["a"]) == a_saved
+    assert int(restored.step) == step_saved
+    # optimizer state restored too
+    assert float(restored.opt_state[0].mu["a"]) != 0.0
+
+
+def test_automatic_naming_and_retention(tmp_path):
+    acc, dl, state, step = _setup(tmp_path)
+    for i in range(3):
+        acc.save_state(train_state=state)
+    ckpts = list_checkpoints(str(tmp_path))
+    # total_limit=2: oldest GC'd
+    assert [os.path.basename(c) for c in ckpts] == ["checkpoint_1", "checkpoint_2"]
+
+
+def test_rng_state_roundtrip(tmp_path):
+    import random
+
+    from accelerate_tpu.utils.random import set_seed
+
+    acc, dl, state, step = _setup(tmp_path)
+    set_seed(123)
+    ckpt = acc.save_state(train_state=state)
+    vals_expected = [random.random(), np.random.rand()]
+    set_seed(999)
+    acc.load_state(ckpt)
+    vals_restored = [random.random(), np.random.rand()]
+    assert vals_expected[0] == vals_restored[0]
+    assert vals_expected[1] == vals_restored[1]
+
+
+def test_custom_object_checkpointing(tmp_path):
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def state_dict(self):
+            return {"n": self.n}
+
+        def load_state_dict(self, sd):
+            self.n = sd["n"]
+
+    acc, dl, state, step = _setup(tmp_path)
+    counter = Counter()
+    acc.register_for_checkpointing(counter)
+    counter.n = 7
+    ckpt = acc.save_state(train_state=state)
+    counter.n = 0
+    acc.load_state(ckpt)
+    assert counter.n == 7
+
+
+def test_register_invalid_object_raises(tmp_path):
+    acc, *_ = _setup(tmp_path)
+    with pytest.raises(ValueError):
+        acc.register_for_checkpointing(object())
+
+
+def test_dataloader_state_saved(tmp_path):
+    acc, dl, state, step = _setup(tmp_path)
+    it = iter(dl)
+    next(it)
+    next(it)
+    ckpt = acc.save_state(train_state=state)
+    sd = json.loads(open(os.path.join(ckpt, "sampler_states.json")).read())
+    assert sd[0]["batches_yielded"] == 2
+
+
+def test_save_model_and_reload(tmp_path):
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
+    params = {"dense": {"kernel": jnp.arange(32.0).reshape(8, 4), "bias": jnp.ones(4)}}
+    state = acc.create_train_state(params, optax.sgd(0.1))
+    files = save_model(acc, state, str(tmp_path / "model"))
+    assert files and files[0].endswith(".safetensors")
+    loaded = load_model_params(str(tmp_path / "model"))
+    np.testing.assert_allclose(loaded["dense"]["kernel"], np.arange(32.0).reshape(8, 4))
+
+
+def test_save_model_sharded_index(tmp_path):
+    acc = Accelerator()
+    params = {f"w{i}": jnp.ones((64, 64)) for i in range(4)}  # 16KB each fp32
+    state = acc.create_train_state(params, optax.sgd(0.1))
+    files = save_model(acc, state, str(tmp_path / "model"), max_shard_size="20KB")
+    assert len(files) > 1
+    assert (tmp_path / "model" / "model.safetensors.index.json").exists()
+    loaded = load_model_params(str(tmp_path / "model"))
+    assert set(loaded.keys()) == {f"w{i}" for i in range(4)}
+
+
+def test_merge_weights(tmp_path):
+    acc, dl, state, step = _setup(tmp_path)
+    ckpt = acc.save_state(train_state=state)
+    out = merge_weights(ckpt, str(tmp_path / "merged"))
+    assert os.path.exists(out)
+
+
+def test_parse_size():
+    assert parse_size("10GB") == 10 * 2**30
+    assert parse_size("512 MB") == 512 * 2**20
+    with pytest.raises(ValueError):
+        parse_size("ten gigs")
+
+
+def test_resume_mid_epoch(tmp_path):
+    """save mid-epoch -> load in a fresh accelerator -> skip_first_batches
+    continues from the right batch (reference skip_first_batches :4238)."""
+    acc, dl, state, step = _setup(tmp_path)
+    it = iter(dl)
+    first = next(it)
+    second = next(it)
+    ckpt = acc.save_state(train_state=state)
+
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc2, dl2, state2, step2 = _setup(tmp_path)
+    acc2.load_state(ckpt)
+    remaining = list(dl2)
+    assert len(remaining) == 2  # 4 batches total, 2 consumed pre-save
+    # the resumed loader starts at batch index 2 -> samples 32..47
+    expected = [make_regression_loader(batch_size=16).dataset[i]["x"].item() for i in range(32, 48)]
+    np.testing.assert_allclose(np.asarray(remaining[0]["x"]).ravel(), expected, rtol=1e-6)

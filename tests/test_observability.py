@@ -1,0 +1,112 @@
+"""Trackers / logging / memory-util tests (reference tests/test_tracking.py +
+test_memory_utils.py coverage)."""
+
+import json
+import logging
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.logging import get_logger
+from accelerate_tpu.tracking import JSONLTracker, filter_trackers, resolve_tracker
+from accelerate_tpu.utils.memory import (
+    find_executable_batch_size,
+    get_device_memory_stats,
+    release_memory,
+    should_reduce_batch_size,
+)
+
+
+def test_jsonl_tracker_roundtrip(tmp_path):
+    tracker = JSONLTracker("run1", logging_dir=str(tmp_path))
+    tracker.store_init_configuration({"lr": 0.1, "nested": {"a": 1}})
+    tracker.log({"loss": 1.5}, step=0)
+    tracker.log({"loss": 1.0}, step=1)
+    cfg = json.loads((tmp_path / "run1" / "config.json").read_text())
+    assert cfg["lr"] == 0.1
+    lines = [json.loads(l) for l in (tmp_path / "run1" / "metrics.jsonl").read_text().splitlines()]
+    assert [l["loss"] for l in lines] == [1.5, 1.0]
+    assert lines[1]["_step"] == 1
+
+
+def test_accelerator_tracker_glue(tmp_path):
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("proj", config={"bs": 8})
+    acc.log({"loss": 0.5}, step=0)
+    tracker = acc.get_tracker("jsonl")
+    assert tracker is not None
+    acc.end_training()
+    assert (tmp_path / "proj" / "metrics.jsonl").exists()
+
+
+def test_filter_trackers_unknown_raises():
+    with pytest.raises(ValueError):
+        filter_trackers("definitely_not_a_tracker")
+
+
+def test_multiprocess_logger(caplog):
+    logger = get_logger("accelerate_tpu.test")
+    with caplog.at_level(logging.INFO, logger="accelerate_tpu.test"):
+        logger.info("hello", main_process_only=True)
+    assert any("hello" in r.message for r in caplog.records)
+
+
+def test_find_executable_batch_size():
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=64)
+    def train(batch_size):
+        attempts.append(batch_size)
+        if batch_size > 16:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+        return batch_size
+
+    assert train() == 16
+    assert attempts == [64, 32, 16]
+
+
+def test_find_executable_batch_size_non_oom_propagates():
+    @find_executable_batch_size(starting_batch_size=8)
+    def train(batch_size):
+        raise ValueError("unrelated")
+
+    with pytest.raises(ValueError, match="unrelated"):
+        train()
+
+
+def test_find_executable_batch_size_signature_check():
+    @find_executable_batch_size(starting_batch_size=8)
+    def train(foo):
+        return foo
+
+    with pytest.raises(TypeError, match="batch_size"):
+        train()
+
+
+def test_should_reduce_batch_size():
+    assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert should_reduce_batch_size(MemoryError())
+    assert not should_reduce_batch_size(ValueError("nope"))
+
+
+def test_release_memory():
+    a, b = np.ones(10), np.ones(10)
+    a, b = release_memory(a, b)
+    assert a is None and b is None
+
+
+def test_device_memory_stats():
+    stats = get_device_memory_stats()
+    assert set(stats) == {"bytes_in_use", "peak_bytes_in_use", "bytes_limit"}
+
+
+def test_profile_context(tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    acc = Accelerator()
+    handler = ProfileKwargs(output_trace_dir=str(tmp_path / "trace"))
+    with acc.profile(handler):
+        jax.numpy.ones(8).sum()
+    assert (tmp_path / "trace").exists()
