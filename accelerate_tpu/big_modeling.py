@@ -114,13 +114,53 @@ def get_max_memory(max_memory: Optional[dict] = None) -> dict:
 
         out["cpu"] = int(psutil.virtual_memory().available * 0.9)
     except ImportError:
-        out["cpu"] = int(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES") * 0.9)
+        out["cpu"] = int(_available_host_memory() * 0.9)
     return out
+
+
+def _available_host_memory() -> int:
+    """Available (not total) host RAM, /proc/meminfo fallback for no-psutil
+    hosts; budgeting total RAM would overcommit an already-loaded host."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    # last resort: assume half of physical RAM is usable
+    return int(os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES") * 0.5)
 
 
 # ---------------------------------------------------------------------------
 # Placement planner (device_map analog for over-HBM models)
 # ---------------------------------------------------------------------------
+
+
+def _placement_units(
+    params, sizes: dict[str, int], max_unit: int, no_split: frozenset[str]
+) -> list[str]:
+    """Split the tree into placement units: descend into any subtree larger
+    than ``max_unit`` (the biggest single budget) unless it is listed in
+    ``no_split``; keep tree (layer) order so adjacent layers stay on the
+    same tier (reference infer_auto_device_map iterates modules in order)."""
+    units: list[str] = []
+
+    def _walk(node, path):
+        splittable = (
+            isinstance(node, Mapping)
+            and len(node) > 0
+            and path not in no_split
+            and (path == "" or sizes.get(path, 0) > max_unit)
+        )
+        if splittable:
+            for k, v in node.items():
+                _walk(v, f"{path}.{k}" if path else str(k))
+        elif path:
+            units.append(path)
+
+    _walk(params, "")
+    return units
 
 
 def infer_auto_placement(
@@ -129,22 +169,29 @@ def infer_auto_placement(
     no_split_paths: Optional[list[str]] = None,
     offload_to_disk: bool = True,
 ) -> dict[str, Union[int, str]]:
-    """Greedy assignment of top-level subtrees to device HBM / 'cpu' / 'disk'
-    budgets (reference infer_auto_device_map modeling.py:1278).  Returns
-    {subtree_path: target}.  Under GSPMD multi-chip sharding handles
-    splitting; this planner handles *capacity overflow* (host/disk tiers for
-    >HBM models)."""
+    """Greedy assignment of subtrees to device HBM / 'cpu' / 'disk' budgets
+    (reference infer_auto_device_map modeling.py:1278).  Returns
+    {subtree_path: target} with dot-separated paths.  Subtrees too big for
+    any single budget are recursively split down to leaves (flax trees have
+    a single 'params' root, so descending is required for tiering to do
+    anything); ``no_split_paths`` pins listed subtrees to one tier.  Under
+    GSPMD multi-chip sharding handles *splitting*; this planner handles
+    *capacity overflow* (host/disk tiers for >HBM models)."""
     budgets = dict(get_max_memory(max_memory))
     sizes = compute_module_sizes(params)
-    top_level = sorted(
-        (p for p in sizes if p and "." not in p),
-        key=lambda p: -sizes[p],
-    )
     device_targets = [k for k in budgets if isinstance(k, int)]
     order = device_targets + ["cpu"] + (["disk"] if offload_to_disk else [])
+    # Units larger than the biggest HBM budget are split so device memory can
+    # still be packed; cpu budget is the ceiling only when no devices exist.
+    max_unit = max(
+        (budgets[t] for t in device_targets),
+        default=budgets.get("cpu", 0),
+    )
+    units = _placement_units(params, sizes, max_unit, frozenset(no_split_paths or ()))
+
     placement: dict[str, Union[int, str]] = {}
-    for path in top_level:
-        size = sizes[path]
+    for path in units:
+        size = sizes.get(path, 0)
         placed = False
         for target in order:
             if target == "disk":
@@ -173,10 +220,12 @@ class OffloadStore:
     """Disk-backed weights: one .dat memmap per tensor + index.json
     (reference OffloadedWeightsLoader offload.py:127 format)."""
 
-    def __init__(self, save_folder: Union[str, os.PathLike]):
+    def __init__(self, save_folder: Union[str, os.PathLike], autoflush: bool = True):
         self.folder = Path(save_folder)
         self.folder.mkdir(parents=True, exist_ok=True)
         self.index_file = self.folder / "index.json"
+        self.autoflush = autoflush
+        self._dirty = False
         self.index: dict[str, dict] = (
             json.loads(self.index_file.read_text()) if self.index_file.exists() else {}
         )
@@ -188,7 +237,17 @@ class OffloadStore:
         mm[...] = arr.reshape(arr.shape or (1,))
         mm.flush()
         self.index[key] = {"dtype": str(arr.dtype), "shape": list(arr.shape)}
-        self.index_file.write_text(json.dumps(self.index))
+        self._dirty = True
+        if self.autoflush:
+            self.flush()
+
+    def flush(self) -> None:
+        """Write index.json once; bulk writers pass autoflush=False and call
+        this at the end (index rewrite per tensor is O(n²) over a 10k-tensor
+        checkpoint)."""
+        if self._dirty:
+            self.index_file.write_text(json.dumps(self.index))
+            self._dirty = False
 
     def load(self, key: str) -> np.ndarray:
         meta = self.index[key]
@@ -205,15 +264,47 @@ class OffloadStore:
 
 def offload_state_dict(save_dir: str, state_dict: Mapping[str, Any]) -> OffloadStore:
     """reference offload_state_dict (offload.py:85)."""
-    store = OffloadStore(save_dir)
+    store = OffloadStore(save_dir, autoflush=False)
     for k, v in state_dict.items():
         store.save(k, v)
+    store.flush()
     return store
 
 
 # ---------------------------------------------------------------------------
 # Checkpoint streaming into shards
 # ---------------------------------------------------------------------------
+
+
+def _path_key(path) -> str:
+    """'/'-joined key for a tree_flatten_with_path path (DictKey/SequenceKey/
+    GetAttrKey all covered)."""
+    parts = []
+    for k in path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(k, attr):
+                parts.append(str(getattr(k, attr)))
+                break
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _normalize_placement(placement: Mapping[str, Any]) -> dict[str, Any]:
+    """Placement maps use dot-separated paths (the compute_module_sizes /
+    infer_auto_placement convention) but '/' is accepted too."""
+    return {k.replace(".", "/"): v for k, v in placement.items()}
+
+
+def _lookup_placement(key: str, normalized: Mapping[str, Any]):
+    """Most-specific entry for '/'-keyed ``key`` in a ``_normalize_placement``
+    result; ancestors match, deepest wins."""
+    parts = key.split("/")
+    for depth in range(len(parts), 0, -1):
+        hit = normalized.get("/".join(parts[:depth]))
+        if hit is not None:
+            return hit
+    return None
 
 
 def _iter_checkpoint_tensors(checkpoint_path: Union[str, os.PathLike]):
@@ -266,18 +357,19 @@ def load_checkpoint_in_model(
     :217 — but no per-layer hooks: arrays land in their final shards.
     """
     flat_abstract = {
-        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path): leaf
+        _path_key(path): leaf
         for path, leaf in jax.tree_util.tree_flatten_with_path(abstract_params)[0]
     }
     flat_plan = {}
     if sharding_plan is not None:
         flat_plan = {
-            "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path): s
+            _path_key(path): s
             for path, s in jax.tree_util.tree_flatten_with_path(
                 sharding_plan, is_leaf=lambda x: isinstance(x, NamedSharding)
             )[0]
         }
-    store = OffloadStore(offload_folder) if offload_folder else None
+    store = OffloadStore(offload_folder, autoflush=False) if offload_folder else None
+    normalized_placement = _normalize_placement(offload_placement) if offload_placement else None
     loaded: dict[str, Any] = {}
     unexpected = []
 
@@ -285,32 +377,40 @@ def load_checkpoint_in_model(
         name = key_map(name) if key_map else name
         return name.replace(".", "/")
 
-    for name, tensor in _iter_checkpoint_tensors(checkpoint):
-        key = _normalize(name)
-        if key not in flat_abstract:
-            unexpected.append(name)
-            continue
-        target_dtype = dtype or flat_abstract[key].dtype
-        tensor = np.asarray(tensor)
-        if tuple(tensor.shape) != tuple(flat_abstract[key].shape):
-            raise ValueError(
-                f"shape mismatch for {name}: checkpoint {tensor.shape} vs model {flat_abstract[key].shape}"
-            )
-        placement = None
-        if offload_placement:
-            top = key.split("/")[0]
-            placement = offload_placement.get(top, offload_placement.get(key))
-        if placement == "disk":
-            if store is None:
-                raise ValueError("offload_placement says 'disk' but no offload_folder given")
-            store.save(key, tensor.astype(target_dtype))
-            loaded[key] = store.load(key)
-        elif placement == "cpu":
-            loaded[key] = tensor.astype(target_dtype)
-        else:
-            sharding = flat_plan.get(key)
-            arr = jax.numpy.asarray(tensor, dtype=target_dtype)
-            loaded[key] = jax.device_put(arr, sharding) if sharding is not None else jax.device_put(arr)
+    try:
+        for name, tensor in _iter_checkpoint_tensors(checkpoint):
+            key = _normalize(name)
+            if key not in flat_abstract:
+                unexpected.append(name)
+                continue
+            target_dtype = dtype or flat_abstract[key].dtype
+            tensor = np.asarray(tensor)
+            if tuple(tensor.shape) != tuple(flat_abstract[key].shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: checkpoint {tensor.shape} vs model {flat_abstract[key].shape}"
+                )
+            placement = _lookup_placement(key, normalized_placement) if normalized_placement else None
+            if placement == "disk":
+                if store is None:
+                    raise ValueError("offload_placement says 'disk' but no offload_folder given")
+                store.save(key, tensor.astype(target_dtype))
+                loaded[key] = store.load(key)
+            elif placement == "cpu":
+                loaded[key] = tensor.astype(target_dtype)
+            else:
+                sharding = flat_plan.get(key)
+                arr = jax.numpy.asarray(tensor, dtype=target_dtype)
+                if sharding is not None:
+                    loaded[key] = jax.device_put(arr, sharding)
+                elif isinstance(placement, (int, np.integer)):
+                    loaded[key] = jax.device_put(arr, jax.local_devices()[int(placement)])
+                else:
+                    loaded[key] = jax.device_put(arr)
+    finally:
+        # keep index.json consistent with any .dat files already rewritten,
+        # even when a shape-mismatch/strict error aborts the stream
+        if store is not None:
+            store.flush()
 
     missing = [k for k in flat_abstract if k not in loaded]
     if strict and (missing or unexpected):
@@ -321,10 +421,7 @@ def load_checkpoint_in_model(
 
     # unflatten back to the original structure
     paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(abstract_params)
-    leaves = [
-        loaded["/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)]
-        for path, _ in paths_leaves
-    ]
+    leaves = [loaded[_path_key(path)] for path, _ in paths_leaves]
     return jax.tree_util.tree_unflatten(treedef, leaves), store
 
 
@@ -375,12 +472,14 @@ def dispatch_model(params, placement: dict[str, Union[int, str]], offload_folder
     """Place an already-materialized pytree per a placement map
     (reference dispatch_model big_modeling.py:310)."""
     devices = jax.local_devices()
-    store = OffloadStore(offload_folder) if offload_folder else None
+    store = OffloadStore(offload_folder, autoflush=False) if offload_folder else None
+    normalized = _normalize_placement(placement)
 
     def _place(path, leaf):
-        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        top = key.split("/")[0]
-        target = placement.get(top, placement.get(key, 0))
+        key = _path_key(path)
+        target = _lookup_placement(key, normalized)
+        if target is None:
+            target = 0
         if target == "disk":
             if store is None:
                 raise ValueError("disk placement requires offload_folder")
@@ -390,7 +489,12 @@ def dispatch_model(params, placement: dict[str, Union[int, str]], offload_folder
             return np.asarray(leaf)
         return jax.device_put(leaf, devices[int(target)])
 
-    return jax.tree_util.tree_map_with_path(_place, params), store
+    try:
+        placed = jax.tree_util.tree_map_with_path(_place, params)
+    finally:
+        if store is not None:
+            store.flush()
+    return placed, store
 
 
 def offloaded_apply(apply_fn: Callable, device=None):

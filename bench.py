@@ -1,0 +1,117 @@
+"""Headline benchmark: Llama decoder training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric is tokens/sec/chip for a bf16 Llama-family causal-LM train step
+(flash-attention Pallas kernel, donated buffers, fused optimizer under one
+jit).  ``vs_baseline`` is measured MFU / 0.45 — the BASELINE.json north-star
+MFU target for the reference's TPU path ("Llama fine-tune at >=45% MFU").
+"""
+
+import json
+import time
+
+import numpy as np
+
+# Per-chip peak bf16 FLOP/s by TPU generation (public spec sheets).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12, "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12, "trillium": 918e12,
+    "cpu": 1e12,  # nominal, so CPU smoke runs still report a line
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower().replace(" ", "")
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
+    from accelerate_tpu.models.llama import count_params, flops_per_token
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # ~600M decoder: fits one v5e chip with fp32 Adam state; seq 2048.
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=2048, attn_implementation="flash",
+            remat=True, dtype=jnp.bfloat16,
+        )
+        batch, seq, iters = 8, 2048, 10
+    else:  # CPU smoke mode
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters = 4, 128, 3
+
+    model = LlamaForCausalLM(cfg)
+    n_dev = jax.device_count()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=n_dev),
+        mixed_precision="bf16",
+    )
+
+    ids = jnp.ones((batch, seq), jnp.int32)
+    params = model.init(jax.random.key(0), ids[:, :8])
+    state = acc.create_train_state(params, optax.adamw(3e-4), apply_fn=model.apply)
+    step = acc.prepare_train_step(make_llama_loss_fn(model), max_grad_norm=1.0)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    from jax.sharding import NamedSharding
+
+    spec = acc._default_batch_spec()(tokens)
+    make_batch = lambda arr: {
+        "input_ids": jax.device_put(arr, NamedSharding(acc.mesh, spec)),
+        "labels": jax.device_put(arr, NamedSharding(acc.mesh, spec)),
+    }
+    b = make_batch(tokens)
+
+    # Warmup (compile + first run); the loss fetch forces full execution.
+    for _ in range(2):
+        state, metrics = step(state, b)
+        float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, b)
+    float(metrics["loss"])  # host fetch: everything up to here has executed
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    toks_per_step = batch * seq
+    toks_per_sec = toks_per_step * iters / dt
+    per_chip = toks_per_sec / n_dev
+    step_flops = flops_per_token(cfg, seq) * toks_per_step
+    mfu = (step_flops * iters / dt) / (_peak_flops(jax.devices()[0]) * n_dev)
+
+    print(json.dumps({
+        "metric": "llama_bf16_train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "params": count_params(state.params),
+            "batch": batch, "seq_len": seq,
+            "step_time_ms": round(dt / iters * 1e3, 2),
+            "loss": round(float(metrics["loss"]), 4),
+            "backend": jax.default_backend(),
+            "device": getattr(jax.devices()[0], "device_kind", "?"),
+            "n_devices": n_dev,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

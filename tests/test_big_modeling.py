@@ -59,6 +59,34 @@ def test_infer_auto_placement_overflow_to_cpu_disk():
     assert placement["small"] == "disk"
 
 
+def test_infer_auto_placement_descends_below_root():
+    """A flax-style tree has a single 'params' root bigger than any budget;
+    the planner must split it across tiers instead of offloading wholesale."""
+    params = {"params": {
+        "layer0": {"w": jax.ShapeDtypeStruct((256,), jnp.float32)},   # 1024 B
+        "layer1": {"w": jax.ShapeDtypeStruct((256,), jnp.float32)},   # 1024 B
+        "layer2": {"w": jax.ShapeDtypeStruct((256,), jnp.float32)},   # 1024 B
+    }}
+    placement = infer_auto_placement(params, max_memory={0: 1100, "cpu": 1100})
+    assert placement == {
+        "params.layer0": 0, "params.layer1": "cpu", "params.layer2": "disk",
+    }
+
+
+def test_infer_auto_placement_no_split_paths():
+    params = {"params": {
+        "block": {
+            "a": jax.ShapeDtypeStruct((256,), jnp.float32),
+            "b": jax.ShapeDtypeStruct((256,), jnp.float32),
+        },
+    }}
+    placement = infer_auto_placement(
+        params, max_memory={0: 1100, "cpu": 4096}, no_split_paths=["params.block"]
+    )
+    # block (2048 B) may not be split: both halves land on cpu together
+    assert placement == {"params.block": "cpu"}
+
+
 def test_infer_auto_placement_raises_when_full():
     params = {"big": jax.ShapeDtypeStruct((1024,), jnp.float32)}
     with pytest.raises(ValueError, match="Cannot place"):
@@ -130,6 +158,36 @@ def test_load_checkpoint_and_dispatch(tmp_path):
     )
     logits = model.apply(params, jnp.ones((1, 8), jnp.int32))
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_load_checkpoint_dotted_placement_and_int_target(tmp_path):
+    """Placement keys use the dotted compute_module_sizes convention and may
+    target a device index; both must be honored during streaming."""
+    abstract = {"params": {
+        "inner": {"w": jax.ShapeDtypeStruct((4,), jnp.float32)},
+        "x": jax.ShapeDtypeStruct((4,), jnp.float32),
+    }}
+    np.savez(tmp_path / "ckpt.npz", **{
+        "params.inner.w": np.arange(4, dtype=np.float32),
+        "params.x": np.ones(4, dtype=np.float32),
+    })
+    params, _ = load_checkpoint_in_model(
+        abstract, tmp_path / "ckpt.npz",
+        offload_placement={"params.inner": "cpu", "params.x": 1},
+    )
+    assert isinstance(params["params"]["inner"]["w"], np.ndarray)
+    assert not isinstance(params["params"]["inner"]["w"], jax.Array)
+    assert params["params"]["x"].devices() == {jax.local_devices()[1]}
+
+
+def test_offload_store_bulk_flush(tmp_path):
+    store = OffloadStore(tmp_path, autoflush=False)
+    store.save("a", np.ones(2))
+    assert not store.index_file.exists()
+    store.flush()
+    assert json.loads(store.index_file.read_text())["a"]["shape"] == [2]
+    # reopened store sees the flushed index
+    assert "a" in OffloadStore(tmp_path)
 
 
 def test_offloaded_apply(tmp_path):
