@@ -1,0 +1,6 @@
+"""``python -m accelerate_tpu`` → the root CLI."""
+
+from .commands.accelerate_cli import main
+
+if __name__ == "__main__":
+    main()

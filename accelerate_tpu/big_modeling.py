@@ -85,6 +85,12 @@ def compute_module_sizes(params, prefix: str = "") -> dict[str, int]:
                 total += _walk(v, f"{path}.{k}" if path else str(k))
             sizes[path] = total
             return total
+        if isinstance(node, (list, tuple)):
+            total = 0
+            for i, v in enumerate(node):
+                total += _walk(v, f"{path}.{i}" if path else str(i))
+            sizes[path] = total
+            return total
         nbytes = int(np.prod(node.shape)) * _dtype_size(node.dtype) if hasattr(node, "shape") else 0
         sizes[path] = nbytes
         return nbytes

@@ -1,0 +1,1 @@
+"""CLI subcommands (reference commands/ — SURVEY §2.10)."""

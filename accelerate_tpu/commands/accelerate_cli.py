@@ -1,0 +1,47 @@
+"""Root CLI — ``accelerate-tpu <subcommand>``
+(reference commands/accelerate_cli.py:28, 8 subcommands).
+
+Subcommands: config, env, launch, test, estimate-memory, merge-weights,
+tpu-config.  (The reference's ``to-fsdp2`` config converter has no analog —
+under GSPMD every strategy is already a sharding config of one mechanism.)
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .config import config_command_parser
+from .env import env_command_parser
+from .estimate import estimate_command_parser
+from .launch import launch_command_parser
+from .merge import merge_command_parser
+from .test import test_command_parser
+from .tpu import tpu_command_parser
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu",
+        usage="accelerate-tpu <command> [<args>]",
+        description="TPU-native training acceleration launcher and tools.",
+        allow_abbrev=False,
+    )
+    subparsers = parser.add_subparsers(dest="command", required=True)
+
+    config_command_parser(subparsers)
+    env_command_parser(subparsers)
+    launch_command_parser(subparsers)
+    test_command_parser(subparsers)
+    estimate_command_parser(subparsers)
+    merge_command_parser(subparsers)
+    tpu_command_parser(subparsers)
+
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        parser.print_help()
+        raise SystemExit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,169 @@
+"""``accelerate-tpu config`` — launch configuration store + questionnaire.
+
+TPU-native re-design of reference ``commands/config/`` (cluster.py:924-line
+interactive flow, config_args.py YAML dataclass).  One flat dataclass replaces
+the reference's cluster/sagemaker split: on TPU there is exactly one execution
+model (one process per host over an ICI/DCN mesh), so the questionnaire is a
+short, linear flow instead of a 900-line decision tree.
+
+Config precedence (reference contract, commands/launch.py:1196): CLI flag >
+YAML config file > built-in default.  The file location honors
+``ACCELERATE_CONFIG_FILE`` and defaults to
+``~/.cache/accelerate_tpu/default_config.yaml``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Optional
+
+import yaml
+
+DEFAULT_CONFIG_DIR = Path(
+    os.environ.get("ACCELERATE_TPU_CACHE", Path.home() / ".cache" / "accelerate_tpu")
+)
+DEFAULT_CONFIG_FILE = DEFAULT_CONFIG_DIR / "default_config.yaml"
+
+# Fields the launcher transports to workers as env vars (utils/launch.py).
+CONFIG_VERSION = 1
+
+
+@dataclass
+class LaunchConfig:
+    """The persisted launch configuration (reference config_args.py:40
+    ``BaseConfig``/``ClusterConfig``)."""
+
+    config_version: int = CONFIG_VERSION
+    # -- process topology (one process per host on TPU) --------------------
+    num_processes: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    # -- execution ---------------------------------------------------------
+    use_cpu: bool = False
+    mixed_precision: str = "no"  # no | bf16 | fp16 | fp8
+    gradient_accumulation_steps: int = 1
+    debug: bool = False
+    # -- parallelism axes (PARALLELISM_CONFIG_* transport) -----------------
+    dp_replicate_size: int = 1
+    dp_shard_size: int = -1  # -1: infer remainder at runtime
+    cp_size: int = 1
+    sp_size: int = 1
+    tp_size: int = 1
+    ep_size: int = 1
+    # -- FSDP/ZeRO sharding knobs (FSDP_* transport) -----------------------
+    use_fsdp: bool = False
+    fsdp_sharding_strategy: str = "FULL_SHARD"
+    fsdp_offload_params: bool = False
+    fsdp_activation_checkpointing: bool = False
+    # -- free-form env passthrough ----------------------------------------
+    env: dict = field(default_factory=dict)
+
+    def save(self, path: os.PathLike | str = DEFAULT_CONFIG_FILE) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            yaml.safe_dump(asdict(self), f, sort_keys=False)
+        return path
+
+    @classmethod
+    def load(cls, path: Optional[os.PathLike | str] = None) -> "LaunchConfig":
+        path = Path(path or default_config_path())
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+        known = {f_.name for f_ in cls.__dataclass_fields__.values()}  # type: ignore[attr-defined]
+        unknown = {k: v for k, v in raw.items() if k not in known}
+        cfg = cls(**{k: v for k, v in raw.items() if k in known})
+        # Forward-compat: stash unknown keys into env passthrough untouched.
+        if unknown:
+            cfg.env.update({k: str(v) for k, v in unknown.items()})
+        return cfg
+
+
+def default_config_path() -> Path:
+    return Path(os.environ.get("ACCELERATE_CONFIG_FILE", DEFAULT_CONFIG_FILE))
+
+
+def load_config_or_default(path: Optional[str] = None) -> LaunchConfig:
+    """Load the YAML config if present, else built-in defaults."""
+    target = Path(path) if path else default_config_path()
+    if target.is_file():
+        return LaunchConfig.load(target)
+    return LaunchConfig()
+
+
+# ---------------------------------------------------------------------------
+# Interactive questionnaire (reference commands/config/cluster.py)
+# ---------------------------------------------------------------------------
+
+
+def _ask(prompt: str, default, cast=str):
+    raw = input(f"{prompt} [{default}]: ").strip()
+    if not raw:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "y")
+    return cast(raw)
+
+
+def interactive_config() -> LaunchConfig:
+    cfg = LaunchConfig()
+    print("accelerate-tpu configuration (enter to accept defaults)")
+    cfg.num_processes = _ask("How many processes (= TPU hosts)?", 1, int)
+    if cfg.num_processes > 1:
+        cfg.main_process_ip = _ask("Coordinator (process-0) IP?", "127.0.0.1")
+        cfg.main_process_port = _ask("Coordinator port?", 29500, int)
+    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)?", "bf16")
+    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
+    cfg.use_fsdp = _ask("Shard parameters/optimizer state (FSDP/ZeRO-3)?", True, bool)
+    cfg.tp_size = _ask("Tensor-parallel size?", 1, int)
+    cfg.cp_size = _ask("Context-parallel size (ring attention)?", 1, int)
+    cfg.sp_size = _ask("Sequence-parallel size (Ulysses)?", 1, int)
+    cfg.ep_size = _ask("Expert-parallel size (MoE)?", 1, int)
+    cfg.dp_shard_size = -1 if cfg.use_fsdp else 1
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# argparse wiring
+# ---------------------------------------------------------------------------
+
+
+def config_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Create a launch config file for accelerate-tpu."
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", description=description, help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config", description=description)
+    parser.add_argument(
+        "--config_file", default=None,
+        help=f"Where to save the config (default {DEFAULT_CONFIG_FILE})",
+    )
+    parser.add_argument(
+        "--default", action="store_true",
+        help="Write the non-interactive default config (single host, bf16, FSDP).",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser
+
+
+def config_command(args):
+    if args.default:
+        cfg = LaunchConfig(mixed_precision="bf16", use_fsdp=True, dp_shard_size=-1)
+    else:
+        cfg = interactive_config()
+    path = cfg.save(args.config_file or default_config_path())
+    print(f"accelerate-tpu config saved at {path}")
+
+
+def main():
+    args = config_command_parser().parse_args()
+    config_command(args)
+
+
+if __name__ == "__main__":
+    main()

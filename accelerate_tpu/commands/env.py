@@ -1,0 +1,56 @@
+"""``accelerate-tpu env`` — environment dump (reference commands/env.py:131)."""
+
+from __future__ import annotations
+
+import argparse
+import platform
+
+from .config import default_config_path
+
+
+def env_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Print the accelerate-tpu environment (for bug reports)."
+    if subparsers is not None:
+        parser = subparsers.add_parser("env", description=description, help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu env", description=description)
+    if subparsers is not None:
+        parser.set_defaults(func=env_command)
+    return parser
+
+
+def env_command(args) -> None:
+    import jax
+    import numpy as np
+
+    from .. import __version__
+
+    info = {
+        "accelerate_tpu version": __version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "jax version": jax.__version__,
+        "numpy version": np.__version__,
+        "JAX backend": jax.default_backend(),
+        "Device count": jax.device_count(),
+        "Devices": ", ".join(getattr(d, "device_kind", str(d)) for d in jax.local_devices()),
+        "Process": f"{jax.process_index()}/{jax.process_count()}",
+    }
+    cfg = default_config_path()
+    info["Config file"] = f"{cfg} ({'exists' if cfg.is_file() else 'not found'})"
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for key, val in info.items():
+        print(f"- `{key}`: {val}")
+    if cfg.is_file():
+        print("- Config contents:")
+        for line in cfg.read_text().splitlines():
+            print(f"\t{line}")
+
+
+def main():
+    env_command(env_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

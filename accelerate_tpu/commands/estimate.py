@@ -1,0 +1,140 @@
+"""``accelerate-tpu estimate-memory`` — HBM footprint estimator
+(reference commands/estimate.py:318 ``accelerate estimate-memory``).
+
+The reference meta-loads an HF model and prints a per-dtype size table.  Here
+the abstract load is ``jax.eval_shape`` over the model's ``init`` — zero FLOPs,
+zero bytes — and the table adds the TPU-relevant training footprint: params +
+grads (same dtype) + Adam moments (fp32 m,v) + master fp32 params when
+training in bf16.
+
+Model sources: a built-in family (``llama``/``bert``/``resnet`` with preset or
+flag-overridden dims) or an HF-style ``config.json`` via ``--config_file``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5}
+
+
+def _sizeof_fmt(num: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(num) < 1024.0:
+            return f"{num:.2f} {unit}"
+        num /= 1024.0
+    return f"{num:.2f} PB"
+
+
+def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Estimate HBM needed to serve/train a model (abstract init, no allocation)."
+    if subparsers is not None:
+        parser = subparsers.add_parser("estimate-memory", description=description, help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
+    parser.add_argument("model", choices=["llama", "bert", "resnet"], help="Model family.")
+    parser.add_argument("--config_file", default=None,
+                        help="HF-style config.json with model dims (overrides flags).")
+    parser.add_argument("--hidden_size", type=int, default=None)
+    parser.add_argument("--intermediate_size", type=int, default=None)
+    parser.add_argument("--num_hidden_layers", type=int, default=None)
+    parser.add_argument("--num_attention_heads", type=int, default=None)
+    parser.add_argument("--num_key_value_heads", type=int, default=None)
+    parser.add_argument("--vocab_size", type=int, default=None)
+    parser.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"],
+                        choices=list(_DTYPE_BYTES))
+    parser.add_argument("--num_chips", type=int, default=1,
+                        help="Divide the sharded footprint over this many chips (FSDP/TP).")
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def _build_config(args):
+    overrides = {
+        k: getattr(args, k)
+        for k in ("hidden_size", "intermediate_size", "num_hidden_layers",
+                  "num_attention_heads", "num_key_value_heads", "vocab_size")
+        if getattr(args, k, None) is not None
+    }
+    if args.config_file:
+        with open(args.config_file) as f:
+            raw = json.load(f)
+        overrides = {**{k: v for k, v in raw.items() if k in (
+            "hidden_size", "intermediate_size", "num_hidden_layers",
+            "num_attention_heads", "num_key_value_heads", "vocab_size",
+            "max_position_embeddings", "rms_norm_eps",
+        )}, **overrides}
+    return overrides
+
+
+def abstract_param_sizes(model_family: str, overrides: dict) -> tuple[int, int, dict]:
+    """Return (total_params, largest_layer_params, per_module_params) from an
+    abstract ``eval_shape`` init — the meta-device analog
+    (reference create_empty_model estimate.py / init_empty_weights)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import BertConfig, BertForSequenceClassification, LlamaConfig, LlamaForCausalLM, ResNet, ResNetConfig
+
+    if model_family == "llama":
+        cfg = LlamaConfig(**overrides) if overrides else LlamaConfig()
+        model = LlamaForCausalLM(cfg)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+    elif model_family == "bert":
+        cfg = BertConfig(**{k: v for k, v in overrides.items() if hasattr(BertConfig, k) or k in BertConfig.__dataclass_fields__})
+        model = BertForSequenceClassification(cfg)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+    else:
+        resnet_fields = set(ResNetConfig.__dataclass_fields__)
+        bad = [k for k in overrides if k not in resnet_fields]
+        if bad:
+            raise ValueError(
+                f"overrides {bad} do not apply to resnet (valid: {sorted(resnet_fields)})"
+            )
+        cfg = ResNetConfig(**overrides)
+        model = ResNet(cfg)
+        dummy = jnp.zeros((1, 32, 32, 3), jnp.float32)
+
+    shapes = jax.eval_shape(lambda: model.init(jax.random.key(0), dummy))
+    per_module: dict[str, int] = {}
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(shapes):
+        n = int(1)
+        for d in leaf.shape:
+            n *= d
+        total += n
+        top = jax.tree_util.keystr(path[:2]) if len(path) >= 2 else jax.tree_util.keystr(path)
+        per_module[top] = per_module.get(top, 0) + n
+    largest = max(per_module.values()) if per_module else 0
+    return total, largest, per_module
+
+
+def estimate_command(args) -> None:
+    total, largest, _ = abstract_param_sizes(args.model, _build_config(args))
+    n = max(args.num_chips, 1)
+    print(f"Model: {args.model}  parameters: {total:,}  (largest module: {largest:,})"
+          + (f"  sharded over {n} chips" if n > 1 else ""))
+    header = f"{'dtype':>9} | {'largest module':>14} | {'weights':>10} | {'+grads':>10} | {'train (Adam)':>12}"
+    print(header)
+    print("-" * len(header))
+    for dtype in args.dtypes:
+        b = _DTYPE_BYTES[dtype]
+        weights = total * b / n
+        grads = weights * 2
+        # Adam: m+v in fp32 (8B/param) + fp32 master copy when not fp32 weights.
+        opt = total * 8 / n + (total * 4 / n if dtype != "float32" else 0)
+        train = weights * 2 + opt
+        print(f"{dtype:>9} | {_sizeof_fmt(largest * b / n):>14} | {_sizeof_fmt(weights):>10} "
+              f"| {_sizeof_fmt(grads):>10} | {_sizeof_fmt(train):>12}")
+    print("\nNote: activations excluded (batch/seq dependent); use remat "
+          "(FSDP_ACTIVATION_CHECKPOINTING) to bound them.")
+
+
+def main():
+    estimate_command(estimate_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""``accelerate-tpu launch`` — the launcher CLI (reference commands/launch.py,
+1,409 LoC; SURVEY §3.1).
+
+The reference fans out to 6 launchers (torchrun, deepspeed PDSH, xmp.spawn,
+pod-SSH, sagemaker, simple).  On TPU there is one execution model — one
+process per host, collectives over ICI/DCN — so this collapses to three modes:
+
+- **simple**: one process, exec-style (`num_processes==1`, the default);
+- **local multi-process**: spawn N local processes with a shared coordinator
+  (CPU fake-mesh testing and single-host multi-process; the analog of the
+  reference's torchrun local path commands/launch.py:1023);
+- **multi-host**: this invocation IS worker ``machine_rank`` of N; set the
+  coordinator env and exec the script (reference pod path :1117 — but without
+  the SSH orchestration: run the same command on every host, as Cloud TPU
+  tooling already does).
+
+Config precedence: CLI flag > YAML config file > defaults
+(reference ``_validate_launch_command`` :1196).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from .config import LaunchConfig, load_config_or_default
+from ..utils.launch import (
+    apply_cpu_device_flags,
+    prepare_multiprocess_env,
+    prepare_simple_launcher_cmd_env,
+    prepare_tpu_pod_env,
+)
+
+_PARALLEL_FLAGS = ("dp_replicate_size", "dp_shard_size", "cp_size", "sp_size", "tp_size", "ep_size")
+
+
+def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Launch a training script on TPU (or a CPU fake mesh)."
+    if subparsers is not None:
+        parser = subparsers.add_parser("launch", description=description, help=description, add_help=True)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu launch", description=description)
+
+    parser.add_argument("--config_file", default=None, help="YAML config to launch with.")
+    # topology
+    parser.add_argument("--num_processes", type=int, default=None, help="Total processes (= TPU hosts).")
+    parser.add_argument("--machine_rank", type=int, default=None, help="Rank of this host (multi-host mode).")
+    parser.add_argument("--main_process_ip", default=None, help="Coordinator (rank-0 host) IP.")
+    parser.add_argument("--main_process_port", type=int, default=None, help="Coordinator port.")
+    parser.add_argument("--multi_host", action="store_true",
+                        help="This invocation is one worker of a multi-host launch (needs --machine_rank).")
+    # execution
+    parser.add_argument("--cpu", action="store_true", help="Force CPU platform (fake-mesh testing).")
+    parser.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    parser.add_argument("--debug", action="store_true", help="ACCELERATE_DEBUG_MODE collective shape checks.")
+    parser.add_argument("--num_cpu_devices", type=int, default=None,
+                        help="Virtual CPU devices per process (XLA_FLAGS host platform device count).")
+    # parallelism axes
+    for flag in _PARALLEL_FLAGS:
+        parser.add_argument(f"--{flag}", type=int, default=None)
+    # FSDP/ZeRO
+    parser.add_argument("--use_fsdp", action="store_true", default=None)
+    parser.add_argument("--fsdp_sharding_strategy", default=None,
+                        choices=["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD"])
+    parser.add_argument("--fsdp_offload_params", action="store_true", default=None)
+    parser.add_argument("--fsdp_activation_checkpointing", action="store_true", default=None)
+    # script
+    parser.add_argument("-m", "--module", action="store_true", help="Run the script as a python module.")
+    parser.add_argument("--no_python", action="store_true", help="Exec the script directly (no python prefix).")
+    parser.add_argument("training_script", help="Script (or module with -m) to launch.")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER, help="Script arguments.")
+
+    if subparsers is not None:
+        parser.set_defaults(func=launch_command)
+    return parser
+
+
+def _merge_args_into_config(args, config: LaunchConfig) -> LaunchConfig:
+    """CLI flag > YAML file > default (reference launch.py:1196)."""
+    direct = (
+        "num_processes", "machine_rank", "main_process_ip", "main_process_port",
+        "mixed_precision", "gradient_accumulation_steps",
+        "use_fsdp", "fsdp_sharding_strategy", "fsdp_offload_params",
+        "fsdp_activation_checkpointing", *_PARALLEL_FLAGS,
+    )
+    for name in direct:
+        val = getattr(args, name, None)
+        if val is not None:
+            setattr(config, name, val)
+    if args.cpu:
+        config.use_cpu = True
+    if args.debug:
+        config.debug = True
+    return config
+
+
+def _validate(config: LaunchConfig):
+    for f in _PARALLEL_FLAGS:
+        v = getattr(config, f)
+        if v == -1 and f == "dp_shard_size":
+            continue  # dp_shard_size=-1 means "infer the remainder"
+        if v < 1:
+            raise ValueError(f"{f} must be >= 1 (only dp_shard_size may be -1), got {v}")
+    if config.num_processes < 1:
+        raise ValueError("num_processes must be >= 1")
+
+
+def _spawn_local_workers(cmd, args, config) -> int:
+    """Spawn N local worker processes, wait, propagate first failure
+    (reference simple_launcher :986-995 exit-code handling)."""
+    import time
+
+    procs = []
+    for pid in range(config.num_processes):
+        env = prepare_multiprocess_env(args, config, pid)
+        apply_cpu_device_flags(env, args.num_cpu_devices)
+        procs.append(subprocess.Popen(cmd, env=env))
+    # Poll ALL workers so a crash in worker k>0 surfaces immediately instead
+    # of after worker 0's distributed-init timeout.
+    code = 0
+    live = dict(enumerate(procs))
+    while live:
+        for pid in list(live):
+            ret = live[pid].poll()
+            if ret is None:
+                continue
+            del live[pid]
+            if ret != 0 and code == 0:
+                code = ret
+                print(f"worker {pid} exited with code {ret}", file=sys.stderr)
+                for other in live.values():
+                    other.terminate()
+        if live:
+            time.sleep(0.2)
+    return code
+
+
+def launch_command(args) -> None:
+    config = _merge_args_into_config(args, load_config_or_default(args.config_file))
+    _validate(config)
+    if args.multi_host and args.machine_rank is None:
+        raise ValueError("--multi_host needs --machine_rank (this host's rank)")
+    cmd, env = prepare_simple_launcher_cmd_env(args, config)
+
+    # Pod metadata only fills topology the user left unspecified — explicit
+    # flags always win (flag > file > default precedence).
+    explicit_topology = (
+        args.num_processes is not None or args.machine_rank is not None
+        or args.main_process_ip is not None or args.multi_host
+    )
+    pod_env = None if explicit_topology else prepare_tpu_pod_env(args, config)
+    if pod_env is not None:
+        # On a TPU pod: this host is one worker; topology came from metadata.
+        env = pod_env
+    elif args.multi_host or args.machine_rank is not None:
+        if config.main_process_ip is None:
+            raise ValueError("multi-host launch needs --main_process_ip")
+        if config.main_process_port is None:
+            # A random free port is only valid when one parent spawns all
+            # workers; independent hosts must agree on the coordinator.
+            raise ValueError("multi-host launch needs an explicit --main_process_port")
+        env = prepare_multiprocess_env(args, config, config.machine_rank)
+    elif config.num_processes > 1:
+        sys.exit(_spawn_local_workers(cmd, args, config))
+
+    apply_cpu_device_flags(env, args.num_cpu_devices)
+    proc = subprocess.Popen(cmd, env=env)
+    sys.exit(proc.wait())
+
+
+def main():
+    args = launch_command_parser().parse_args()
+    launch_command(args)
+
+
+if __name__ == "__main__":
+    main()

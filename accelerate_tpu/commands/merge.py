@@ -1,0 +1,41 @@
+"""``accelerate-tpu merge-weights`` — consolidate a sharded checkpoint into
+single-file model weights (reference commands/merge.py:69 wrapping
+``merge_fsdp_weights`` fsdp_utils.py:366)."""
+
+from __future__ import annotations
+
+import argparse
+
+
+def merge_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Merge a sharded (Orbax) checkpoint into consolidated safetensors weights."
+    if subparsers is not None:
+        parser = subparsers.add_parser("merge-weights", description=description, help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu merge-weights", description=description)
+    parser.add_argument("checkpoint_directory", help="Sharded checkpoint directory (from save_state).")
+    parser.add_argument("output_path", help="Directory to write consolidated weights into.")
+    parser.add_argument("--unsafe_serialization", action="store_true",
+                        help="Write pickled .npz instead of safetensors.")
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser
+
+
+def merge_command(args) -> None:
+    from ..checkpointing import merge_weights
+
+    merge_weights(
+        args.checkpoint_directory,
+        args.output_path,
+        safe_serialization=not args.unsafe_serialization,
+    )
+    print(f"Merged weights written to {args.output_path}")
+
+
+def main():
+    merge_command(merge_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

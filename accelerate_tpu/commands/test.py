@@ -1,0 +1,44 @@
+"""``accelerate-tpu test`` — one-command cluster sanity run
+(reference commands/test.py:65, running the in-package
+``test_utils/scripts/test_script.py`` under the current config)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Run the bundled end-to-end sanity script under `accelerate-tpu launch`."
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", description=description, help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test", description=description)
+    parser.add_argument("--config_file", default=None, help="Config to test with.")
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args) -> None:
+    from ..test_utils import test_script_path
+
+    script = test_script_path()
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.launch"]
+    if args.config_file is not None:
+        cmd += ["--config_file", args.config_file]
+    cmd.append(str(script))
+    result = subprocess.run(cmd, env=os.environ.copy())
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    sys.exit(result.returncode)
+
+
+def main():
+    test_command(test_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""``accelerate-tpu tpu-config`` — Cloud TPU pod command runner
+(reference commands/tpu.py:157 ``accelerate tpu-config``).
+
+Builds the ``gcloud compute tpus tpu-vm ssh --worker=all`` command that
+installs/launches on every pod host.  ``--debug`` prints without running —
+also the behavior when gcloud is absent."""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import subprocess
+
+from .config import load_config_or_default
+
+
+def tpu_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Run a setup/launch command on all workers of a Cloud TPU pod."
+    if subparsers is not None:
+        parser = subparsers.add_parser("tpu-config", description=description, help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tpu-config", description=description)
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--tpu_name", default=None, help="TPU name (else from config env passthrough).")
+    parser.add_argument("--tpu_zone", default=None, help="TPU zone.")
+    parser.add_argument("--command", action="append", help="Command(s) to run on each worker.")
+    parser.add_argument("--install_accelerate", action="store_true",
+                        help="Prepend a pip install of accelerate_tpu from PyPI/wheel.")
+    parser.add_argument("--accelerate_version", default="latest")
+    parser.add_argument("--debug", action="store_true", help="Print the gcloud command, don't run it.")
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command)
+    return parser
+
+
+def tpu_command(args) -> None:
+    config = load_config_or_default(args.config_file)
+    tpu_name = args.tpu_name or config.env.get("tpu_name")
+    tpu_zone = args.tpu_zone or config.env.get("tpu_zone")
+    if tpu_name is None or tpu_zone is None:
+        raise ValueError("--tpu_name and --tpu_zone are required (or set in the config env block)")
+
+    commands = list(args.command or [])
+    if args.install_accelerate:
+        version = "" if args.accelerate_version == "latest" else f"=={args.accelerate_version}"
+        commands.insert(0, f"pip install accelerate_tpu{version}")
+    if not commands:
+        raise ValueError("no --command given")
+
+    remote = "; ".join(commands)
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", tpu_name,
+        f"--zone={tpu_zone}", "--worker=all", f"--command={remote}",
+    ]
+    if args.debug:
+        print(" ".join(cmd))
+        return
+    if shutil.which("gcloud") is None:
+        raise RuntimeError(
+            "gcloud not found — install the Google Cloud SDK, or re-run with "
+            "--debug to print the command:\n  " + " ".join(cmd)
+        )
+    print(f"Running {remote} on all workers of {tpu_name}")
+    subprocess.run(cmd, check=True)
+
+
+def main():
+    tpu_command(tpu_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    main()

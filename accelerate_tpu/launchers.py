@@ -1,0 +1,94 @@
+"""Notebook / debug launchers (reference launchers.py:41 ``notebook_launcher``,
+:276 ``debug_launcher``).
+
+On TPU a notebook process already owns every local chip, so ``num_processes``
+means *hosts*: in-notebook multi-process only makes sense on the CPU platform
+(fake mesh testing), where N spawned processes form a real collective world
+over a local coordinator — the analog of the reference's fork/spawn +
+``PrepareForLaunch`` dance (launchers.py:160-236).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .utils.environment import get_free_port, patch_environment
+from .utils.launch import PrepareForLaunch
+
+
+def notebook_launcher(
+    function: Callable,
+    args: tuple = (),
+    num_processes: Optional[int] = None,
+    mixed_precision: str = "no",
+    use_port: Optional[int] = None,
+    master_addr: str = "127.0.0.1",
+    node_rank: int = 0,
+    num_nodes: int = 1,
+) -> Any:
+    """Launch ``function(*args)`` for (notebook) training.
+
+    - On TPU (or any accelerator platform): run in-process — the process
+      already addresses all local devices, GSPMD handles the rest (the
+      reference instead needed ``xmp.spawn`` per-core, launchers.py:112-133).
+    - ``num_processes > 1``: spawn that many CPU processes forming a real
+      collective world (reference multi-GPU fork path :160).
+    """
+    in_colab_or_single = num_processes in (None, 0, 1)
+    if in_colab_or_single:
+        with patch_environment(ACCELERATE_MIXED_PRECISION=mixed_precision):
+            return function(*args)
+
+    # num_processes > 1: workers always form a CPU collective world
+    # (ACCELERATE_USE_CPU in their env) — a TPU process already owns all local
+    # chips, so in-notebook multi-process is a CPU-testing feature by design.
+    # Don't probe jax for the platform here: any backend query would
+    # initialize XLA in the parent and make forking unsafe.
+    import multiprocessing
+
+    # Multi-node notebooks (reference launchers.py:41 node_rank/num_nodes):
+    # ``num_processes`` is the per-node count; the world is num_nodes as big
+    # and this node owns the contiguous rank block starting at its offset.
+    if not (0 <= node_rank < num_nodes):
+        raise ValueError(f"node_rank {node_rank} must be in [0, {num_nodes})")
+    if num_nodes > 1 and use_port is None:
+        raise ValueError("multi-node notebook launch needs an explicit use_port every node agrees on")
+    world_size = num_processes * num_nodes
+    rank_offset = node_rank * num_processes
+    port = use_port or get_free_port()
+    env = {
+        "ACCELERATE_USE_CPU": "true",
+        "ACCELERATE_MIXED_PRECISION": mixed_precision,
+        "ACCELERATE_COORDINATOR_ADDRESS": f"{master_addr}:{port}",
+        "ACCELERATE_NUM_PROCESSES": str(world_size),
+    }
+    # Fork so functions defined in a notebook cell survive into workers (the
+    # reference forks for the same reason, launchers.py:160-236) — but only
+    # while the parent hasn't initialized an XLA backend, which fork would
+    # duplicate into a broken state (the reference's CUDA-initialized check).
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError(
+            "notebook_launcher needs a JAX-untouched process to fork workers "
+            "from; restart the notebook and call it before any jax operation "
+            "(the analog of the reference's 'CUDA already initialized' guard)."
+        )
+    ctx = multiprocessing.get_context("fork")
+    procs = []
+    for pid in range(rank_offset, rank_offset + num_processes):
+        p = ctx.Process(target=PrepareForLaunch(function, env, pid), args=args)
+        p.start()
+        procs.append(p)
+    for pid, p in enumerate(procs):
+        p.join()
+        if p.exitcode != 0:
+            for other in procs:
+                if other.is_alive():
+                    other.terminate()
+            raise RuntimeError(f"process {pid} exited with code {p.exitcode}")
+
+
+def debug_launcher(function: Callable, args: tuple = (), num_processes: int = 2) -> Any:
+    """2-process CPU launch for CI debugging (reference launchers.py:276)."""
+    return notebook_launcher(function, args, num_processes=num_processes)

@@ -264,9 +264,14 @@ class PartialState:
             print(*args, **kwargs)
 
     def destroy_process_group(self):
-        """Tear down the multi-host runtime (reference state.py:700-715)."""
+        """Tear down the multi-host runtime (reference state.py:700-715).
+
+        Barriers first: without it the first process to exit kills the
+        coordination service while peers still heartbeat, turning a clean run
+        into a fatal "Socket closed" on the laggards."""
         global _jax_distributed_initialized
         if _jax_distributed_initialized:
+            self.wait_for_everyone()
             jax.distributed.shutdown()
             _jax_distributed_initialized = False
 

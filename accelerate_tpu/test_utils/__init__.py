@@ -1,0 +1,30 @@
+"""In-package test harness (reference test_utils/ — SURVEY §2.12)."""
+
+from pathlib import Path
+
+from .testing import (
+    AccelerateTestCase,
+    TempDirTestCase,
+    assert_trees_all_close,
+    device_count,
+    execute_subprocess,
+    get_backend,
+    get_launch_command,
+    require_multi_device,
+    require_tpu,
+    skip,
+    slow,
+)
+from .training import (
+    RegressionDataset,
+    make_regression_loader,
+    regression_apply,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def test_script_path() -> Path:
+    """Path to the bundled end-to-end sanity script run by
+    ``accelerate-tpu test`` (reference test_utils/scripts/test_script.py)."""
+    return Path(__file__).parent / "scripts" / "test_script.py"

@@ -1,0 +1,2 @@
+"""Rank-parallel assertion scripts run under ``accelerate-tpu launch``
+(reference test_utils/scripts/ — SURVEY §4 subprocess self-launch tier)."""

@@ -1,0 +1,181 @@
+"""Test-harness utilities (reference test_utils/testing.py, 879 LoC).
+
+Same shape as the reference: backend abstraction (:83), launch-command builder
+(:111), ``require_*`` skip decorators (:152-598), singleton-hygiene base
+classes (:617-661), and an async subprocess runner (:764) used by the
+subprocess *self-launch* tests (SURVEY §4) — a pytest test launches
+``accelerate-tpu launch`` pointing at an assertion script shipped inside the
+package (``test_utils/scripts/``) and every rank asserts.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import unittest
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Backend abstraction (reference get_backend testing.py:83)
+# ---------------------------------------------------------------------------
+
+
+def get_backend() -> tuple[str, int, callable]:
+    """(platform, device_count, memory_allocated_fn) — backend-parametric so
+    the same test runs on tpu/cpu (reference runs on cuda/xpu/.../cpu)."""
+    import jax
+
+    platform = jax.default_backend()
+
+    def _memory_allocated(device_index: int = 0) -> int:
+        stats = jax.local_devices()[device_index].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    return platform, jax.device_count(), _memory_allocated
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+# ---------------------------------------------------------------------------
+# Skip decorators (reference testing.py:152-598)
+# ---------------------------------------------------------------------------
+
+skip = unittest.skip
+
+
+def slow(test_case):
+    """Skip unless RUN_SLOW=1 (reference :157)."""
+    from ..utils.environment import parse_flag_from_env
+
+    return unittest.skipUnless(parse_flag_from_env("RUN_SLOW"), "test is slow")(test_case)
+
+
+def require_multi_device(test_case):
+    """Skip unless >1 device is visible (reference :388)."""
+    return unittest.skipUnless(device_count() > 1, "test requires multiple devices")(test_case)
+
+
+def require_tpu(test_case):
+    """Skip unless running on real TPU hardware (reference require_tpu :347)."""
+    import jax
+
+    return unittest.skipUnless(jax.default_backend() == "tpu", "test requires TPU")(test_case)
+
+
+def require_cpu(test_case):
+    import jax
+
+    return unittest.skipUnless(jax.default_backend() == "cpu", "test requires CPU platform")(test_case)
+
+
+# ---------------------------------------------------------------------------
+# Launch-command builder + subprocess runner (reference :111, :764)
+# ---------------------------------------------------------------------------
+
+
+def get_launch_command(num_processes: int = 1, num_cpu_devices: Optional[int] = None, **kwargs) -> list[str]:
+    """Build an ``accelerate-tpu launch`` prefix (reference get_launch_command
+    testing.py:111)."""
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+           "--num_processes", str(num_processes)]
+    if num_cpu_devices:
+        cmd += ["--num_cpu_devices", str(num_cpu_devices)]
+    for key, value in kwargs.items():
+        if value is True:
+            cmd.append(f"--{key}")
+        elif value is not False and value is not None:
+            cmd += [f"--{key}", str(value)]
+    return cmd
+
+
+DEFAULT_LAUNCH_COMMAND = get_launch_command(num_processes=2)
+
+
+def execute_subprocess(cmd: list[str], env: Optional[dict] = None, timeout: int = 600) -> subprocess.CompletedProcess:
+    """Run a launch command, raising with captured output on failure
+    (reference execute_subprocess_async testing.py:764)."""
+    env = env or os.environ.copy()
+    # The package may be run from a source tree without installation — make
+    # sure spawned workers can import it.
+    pkg_root = str(Path(__file__).resolve().parents[2])
+    env["PYTHONPATH"] = os.pathsep.join(p for p in (pkg_root, env.get("PYTHONPATH")) if p)
+    result = subprocess.run(
+        [str(c) for c in cmd], env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"command {' '.join(map(str, cmd))!r} failed with code {result.returncode}\n"
+            f"--- stdout ---\n{result.stdout}\n--- stderr ---\n{result.stderr}"
+        )
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Base classes (reference :617-663)
+# ---------------------------------------------------------------------------
+
+
+class TempDirTestCase(unittest.TestCase):
+    """Provides ``self.tmpdir``, cleared between tests (reference :617)."""
+
+    clear_on_setup = True
+
+    @classmethod
+    def setUpClass(cls):
+        cls._tmp = tempfile.TemporaryDirectory()
+        cls.tmpdir = Path(cls._tmp.name)
+
+    @classmethod
+    def tearDownClass(cls):
+        cls._tmp.cleanup()
+
+    def setUp(self):
+        if self.clear_on_setup:
+            for path in sorted(self.tmpdir.glob("**/*"), reverse=True):
+                if path.is_file():
+                    path.unlink()
+                elif path.is_dir():
+                    path.rmdir()
+
+
+class AccelerateTestCase(unittest.TestCase):
+    """Resets the state singletons between tests (reference :650 —
+    AcceleratorState leak prevention)."""
+
+    def tearDown(self):
+        from ..state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        super().tearDown()
+
+
+# ---------------------------------------------------------------------------
+# Assertions
+# ---------------------------------------------------------------------------
+
+
+def assert_trees_all_close(a, b, rtol: float = 1e-5, atol: float = 1e-6, err_msg: str = ""):
+    """Pytree-wide allclose with path-labelled failures."""
+    import jax
+
+    flat_a = jax.tree_util.tree_leaves_with_path(a)
+    flat_b = jax.tree_util.tree_leaves_with_path(b)
+    assert len(flat_a) == len(flat_b), f"tree structure mismatch: {len(flat_a)} vs {len(flat_b)} leaves"
+    for (path, la), (_, lb) in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(la), np.asarray(lb), rtol=rtol, atol=atol,
+            err_msg=f"{err_msg} at {jax.tree_util.keystr(path)}",
+        )

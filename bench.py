@@ -16,19 +16,24 @@ import numpy as np
 # Per-chip peak bf16 FLOP/s by TPU generation (public spec sheets).
 _PEAK_FLOPS = {
     "v4": 275e12,
-    "v5e": 197e12, "v5litepod": 197e12,
+    "v5e": 197e12, "v5litepod": 197e12, "v5lite": 197e12,
     "v5p": 459e12,
     "v6e": 918e12, "trillium": 918e12,
     "cpu": 1e12,  # nominal, so CPU smoke runs still report a line
 }
 
 
-def _peak_flops(device) -> float:
+def _peak_flops(device) -> tuple[float, bool]:
+    """(per-chip peak bf16 FLOP/s, known) — ``known`` False means the device
+    kind matched no table entry and the v5e figure was assumed."""
     kind = getattr(device, "device_kind", "cpu").lower().replace(" ", "")
     for key, val in _PEAK_FLOPS.items():
         if key in kind:
-            return val
-    return 197e12
+            return val, True
+    import sys
+
+    print(f"bench.py: unknown device kind {kind!r}; assuming v5e peak for MFU", file=sys.stderr)
+    return 197e12, False
 
 
 def main():
@@ -93,7 +98,8 @@ def main():
     toks_per_sec = toks_per_step * iters / dt
     per_chip = toks_per_sec / n_dev
     step_flops = flops_per_token(cfg, seq) * toks_per_step
-    mfu = (step_flops * iters / dt) / (_peak_flops(jax.devices()[0]) * n_dev)
+    peak, peak_known = _peak_flops(jax.devices()[0])
+    mfu = (step_flops * iters / dt) / (peak * n_dev)
 
     print(json.dumps({
         "metric": "llama_bf16_train_tokens_per_sec_per_chip",
@@ -109,6 +115,7 @@ def main():
             "backend": jax.default_backend(),
             "device": getattr(jax.devices()[0], "device_kind", "?"),
             "n_devices": n_dev,
+            "peak_flops_assumed": not peak_known,
         },
     }))
 

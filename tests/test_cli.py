@@ -1,0 +1,169 @@
+"""CLI-layer tests (reference tests/test_cli.py:643 — config round-trip,
+flag>file>default precedence, estimator output, env transport)."""
+
+import argparse
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+from accelerate_tpu.commands.config import LaunchConfig, load_config_or_default
+from accelerate_tpu.commands.estimate import abstract_param_sizes
+from accelerate_tpu.commands.launch import (
+    _merge_args_into_config,
+    _validate,
+    launch_command_parser,
+)
+from accelerate_tpu.utils.launch import (
+    prepare_multiprocess_env,
+    prepare_simple_launcher_cmd_env,
+)
+
+
+def _parse_launch(argv):
+    return launch_command_parser().parse_args(argv)
+
+
+def test_config_roundtrip(tmp_path):
+    cfg = LaunchConfig(num_processes=4, mixed_precision="bf16", tp_size=2, use_fsdp=True)
+    path = cfg.save(tmp_path / "cfg.yaml")
+    loaded = LaunchConfig.load(path)
+    assert loaded == cfg
+
+
+def test_config_forward_compat_unknown_keys(tmp_path):
+    path = tmp_path / "cfg.yaml"
+    path.write_text(yaml.safe_dump({"num_processes": 2, "some_future_key": "x"}))
+    loaded = LaunchConfig.load(path)
+    assert loaded.num_processes == 2
+    assert loaded.env["some_future_key"] == "x"
+
+
+def test_load_config_or_default_missing_file(tmp_path):
+    assert load_config_or_default(str(tmp_path / "nope.yaml")) == LaunchConfig()
+
+
+def test_flag_beats_file(tmp_path):
+    cfg_path = tmp_path / "cfg.yaml"
+    LaunchConfig(mixed_precision="fp16", tp_size=4).save(cfg_path)
+    args = _parse_launch(["--config_file", str(cfg_path), "--mixed_precision", "bf16", "script.py"])
+    merged = _merge_args_into_config(args, LaunchConfig.load(cfg_path))
+    assert merged.mixed_precision == "bf16"  # flag wins
+    assert merged.tp_size == 4  # file survives where no flag given
+
+
+def test_multi_host_requires_rank_and_port():
+    from accelerate_tpu.commands.launch import launch_command
+
+    with pytest.raises(ValueError, match="machine_rank"):
+        launch_command(_parse_launch(["--multi_host", "--main_process_ip", "1.2.3.4",
+                                      "--main_process_port", "29500", "script.py"]))
+    with pytest.raises(ValueError, match="main_process_port"):
+        launch_command(_parse_launch(["--machine_rank", "0", "--main_process_ip", "1.2.3.4",
+                                      "--num_processes", "2", "script.py"]))
+
+
+def test_explicit_topology_beats_pod_metadata(monkeypatch):
+    """Explicit flags must win over pod metadata (flag > file > default)."""
+    from accelerate_tpu.commands import launch as launch_mod
+
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    captured = {}
+
+    def fake_popen(cmd, env=None):
+        captured["env"] = env
+
+        class _P:
+            def wait(self):
+                return 0
+
+        return _P()
+
+    monkeypatch.setattr(launch_mod.subprocess, "Popen", fake_popen)
+    monkeypatch.setattr(launch_mod.sys, "exit", lambda code=0: None)
+    launch_mod.launch_command(_parse_launch(["--num_processes", "1", "script.py"]))
+    # pod metadata would have set ACCELERATE_NUM_PROCESSES=2
+    assert "ACCELERATE_NUM_PROCESSES" not in captured["env"]
+
+
+def test_compute_module_sizes_counts_list_subtrees():
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import compute_module_sizes
+
+    params = {"layers": [{"w": np.zeros((4, 4), np.float32)}, {"w": np.zeros((8,), np.float32)}]}
+    sizes = compute_module_sizes(params)
+    assert sizes[""] == 4 * 4 * 4 + 8 * 4
+    assert sizes["layers.0"] == 64
+    assert sizes["layers.1.w"] == 32
+
+
+def test_validate_rejects_bad_sizes():
+    cfg = LaunchConfig(tp_size=0)
+    with pytest.raises(ValueError):
+        _validate(cfg)
+    cfg = LaunchConfig(tp_size=-1, dp_shard_size=-1)
+    with pytest.raises(ValueError):
+        _validate(cfg)
+
+
+def test_env_transport_simple():
+    args = _parse_launch(["--mixed_precision", "bf16", "--tp_size", "2", "--use_fsdp", "script.py", "--lr", "3"])
+    config = _merge_args_into_config(args, LaunchConfig())
+    cmd, env = prepare_simple_launcher_cmd_env(args, config)
+    assert cmd[-3:] == ["script.py", "--lr", "3"]
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["PARALLELISM_CONFIG_TP_SIZE"] == "2"
+    assert env["ACCELERATE_USE_FSDP"] == "true"
+    assert env["FSDP_SHARDING_STRATEGY"] == "FULL_SHARD"
+
+
+def test_env_transport_multiprocess():
+    args = _parse_launch(["--num_processes", "2", "script.py"])
+    config = _merge_args_into_config(args, LaunchConfig())
+    env0 = prepare_multiprocess_env(args, config, 0)
+    env1 = prepare_multiprocess_env(args, config, 1)
+    assert env0["ACCELERATE_NUM_PROCESSES"] == "2"
+    assert env0["ACCELERATE_PROCESS_ID"] == "0"
+    assert env1["ACCELERATE_PROCESS_ID"] == "1"
+    # every worker must agree on the coordinator
+    assert env0["ACCELERATE_COORDINATOR_ADDRESS"] == env1["ACCELERATE_COORDINATOR_ADDRESS"]
+
+
+def test_tpu_pod_env_autodetect(monkeypatch):
+    from accelerate_tpu.utils.launch import prepare_tpu_pod_env
+
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1,host2,host3")
+    args = _parse_launch(["script.py"])
+    config = _merge_args_into_config(args, LaunchConfig())
+    env = prepare_tpu_pod_env(args, config)
+    assert env is not None
+    assert env["ACCELERATE_NUM_PROCESSES"] == "4"
+    assert env["ACCELERATE_PROCESS_ID"] == "1"
+    assert env["ACCELERATE_COORDINATOR_ADDRESS"].startswith("host0:")
+
+
+def test_estimate_param_sizes():
+    total, largest, per_module = abstract_param_sizes(
+        "llama",
+        {"hidden_size": 64, "intermediate_size": 128, "num_hidden_layers": 2,
+         "num_attention_heads": 4, "num_key_value_heads": 2, "vocab_size": 256},
+    )
+    assert total > 0 and largest > 0
+    assert largest <= total
+    assert sum(per_module.values()) == total
+
+
+def test_cli_help_lists_subcommands():
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu", "--help"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0
+    for sub in ("config", "env", "launch", "test", "estimate-memory", "merge-weights", "tpu-config"):
+        assert sub in result.stdout

@@ -1,0 +1,78 @@
+"""Subprocess self-launch tests (SURVEY §4 tier-2: a pytest test builds an
+``accelerate-tpu launch`` command pointing at the bundled assertion script and
+every rank asserts — reference tests/test_multidevice.py:52 pattern)."""
+
+import os
+
+from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+from accelerate_tpu.test_utils import test_script_path as _script_path
+
+
+def _clean_env(**extra):
+    env = {k: v for k, v in os.environ.items() if not k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_"))}
+    # Workers force the platform via ACCELERATE_USE_CPU (launch --cpu);
+    # drop the pytest XLA_FLAGS so each worker sizes its own device world.
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra)
+    return env
+
+
+def test_single_process_self_launch():
+    cmd = get_launch_command(num_processes=1, num_cpu_devices=4) + [str(_script_path())]
+    result = execute_subprocess(cmd, env=_clean_env())
+    assert "ALL CHECKS PASSED" in result.stdout
+
+
+def test_two_process_self_launch():
+    cmd = get_launch_command(num_processes=2, num_cpu_devices=2) + [str(_script_path())]
+    result = execute_subprocess(cmd, env=_clean_env())
+    assert "ALL CHECKS PASSED" in result.stdout
+
+
+def test_launch_env_reaches_script(tmp_path):
+    probe = tmp_path / "probe.py"
+    probe.write_text(
+        "import os\n"
+        "assert os.environ['ACCELERATE_MIXED_PRECISION'] == 'bf16'\n"
+        "assert os.environ['PARALLELISM_CONFIG_TP_SIZE'] == '2'\n"
+        "assert os.environ['ACCELERATE_GRADIENT_ACCUMULATION_STEPS'] == '4'\n"
+        "print('ENV OK')\n"
+    )
+    cmd = get_launch_command(
+        num_processes=1, mixed_precision="bf16", tp_size=2, gradient_accumulation_steps=4,
+    ) + [str(probe)]
+    result = execute_subprocess(cmd, env=_clean_env())
+    assert "ENV OK" in result.stdout
+
+
+def test_debug_launcher_forms_collective_world(tmp_path):
+    """debug_launcher forks a 2-process CPU world from a JAX-untouched parent
+    (reference launchers.py:276 debug_launcher under gloo)."""
+    script = tmp_path / "nb.py"
+    script.write_text(
+        "def train():\n"
+        "    from accelerate_tpu import PartialState\n"
+        "    state = PartialState()\n"
+        "    assert state.num_processes == 2, state.num_processes\n"
+        "    state.print('FORK WORLD OK')\n"
+        "\n"
+        "from accelerate_tpu.launchers import debug_launcher\n"
+        "debug_launcher(train)\n"
+    )
+    import sys
+
+    result = execute_subprocess([sys.executable, str(script)], env=_clean_env())
+    assert "FORK WORLD OK" in result.stdout
+
+
+def test_launch_propagates_failure(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("raise SystemExit(3)\n")
+    cmd = get_launch_command(num_processes=1) + [str(bad)]
+    try:
+        execute_subprocess(cmd, env=_clean_env())
+    except RuntimeError as e:
+        assert "code 3" in str(e)
+    else:
+        raise AssertionError("launch should have propagated the non-zero exit")
