@@ -141,21 +141,24 @@ def _spawn_local_workers(cmd, args, config) -> int:
 def launch_command(args) -> None:
     config = _merge_args_into_config(args, load_config_or_default(args.config_file))
     _validate(config)
-    if args.multi_host and args.machine_rank is None:
+    if args.multi_host and args.machine_rank is None and args.config_file is None:
         raise ValueError("--multi_host needs --machine_rank (this host's rank)")
     cmd, env = prepare_simple_launcher_cmd_env(args, config)
 
-    # Pod metadata only fills topology the user left unspecified — explicit
-    # flags always win (flag > file > default precedence).
-    explicit_topology = (
-        args.num_processes is not None or args.machine_rank is not None
-        or args.main_process_ip is not None or args.multi_host
+    # Multi-host if requested by flag OR described by the merged config: a
+    # stored main_process_ip means this invocation is one worker of N hosts
+    # (the config-file analog of the reference's machine_rank YAML fields).
+    multi_host = (
+        args.multi_host or args.machine_rank is not None or config.main_process_ip is not None
     )
+    # Pod metadata only fills topology the user left unspecified — explicit
+    # flags/config always win (flag > file > default precedence).
+    explicit_topology = args.num_processes is not None or multi_host
     pod_env = None if explicit_topology else prepare_tpu_pod_env(args, config)
     if pod_env is not None:
         # On a TPU pod: this host is one worker; topology came from metadata.
         env = pod_env
-    elif args.multi_host or args.machine_rank is not None:
+    elif multi_host:
         if config.main_process_ip is None:
             raise ValueError("multi-host launch needs --main_process_ip")
         if config.main_process_port is None:

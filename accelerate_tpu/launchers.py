@@ -80,13 +80,28 @@ def notebook_launcher(
         p = ctx.Process(target=PrepareForLaunch(function, env, pid), args=args)
         p.start()
         procs.append(p)
-    for pid, p in enumerate(procs):
-        p.join()
-        if p.exitcode != 0:
-            for other in procs:
-                if other.is_alive():
+    # Poll ALL workers so a crash in worker k>0 surfaces immediately instead
+    # of blocking in join() on worker 0 through its distributed-init timeout
+    # (same pattern as commands/launch.py _spawn_local_workers).
+    import time
+
+    live = dict(enumerate(procs))
+    failed: Optional[tuple[int, int]] = None
+    while live:
+        for pid in list(live):
+            p = live[pid]
+            if p.is_alive():
+                continue
+            p.join()
+            del live[pid]
+            if p.exitcode != 0 and failed is None:
+                failed = (pid, p.exitcode)
+                for other in live.values():
                     other.terminate()
-            raise RuntimeError(f"process {pid} exited with code {p.exitcode}")
+        if live:
+            time.sleep(0.2)
+    if failed is not None:
+        raise RuntimeError(f"process {failed[0]} exited with code {failed[1]}")
 
 
 def debug_launcher(function: Callable, args: tuple = (), num_processes: int = 2) -> Any:

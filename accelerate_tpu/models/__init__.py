@@ -7,4 +7,10 @@ from .llama import (
     flops_per_token,
     make_llama_loss_fn,
 )
+from .mixtral import (
+    MixtralConfig,
+    MixtralForCausalLM,
+    count_active_params,
+    make_mixtral_loss_fn,
+)
 from .resnet import ResNet, ResNetConfig, make_resnet_loss_fn

@@ -203,9 +203,15 @@ class LlamaBlock(nn.Module):
 
 
 class LlamaForCausalLM(nn.Module):
-    """Decoder LM head model.  ``__call__(input_ids) -> logits``."""
+    """Decoder LM head model.  ``__call__(input_ids) -> logits``.
+
+    ``block_cls`` is the per-layer module — subclasses swap it to reuse the
+    embed/decode/head skeleton (e.g. MixtralForCausalLM's sparse-MoE block).
+    """
 
     config: LlamaConfig
+
+    block_cls = LlamaBlock  # class attribute, not a dataclass field
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None):
@@ -216,9 +222,9 @@ class LlamaForCausalLM(nn.Module):
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed_tokens"
         )
         x = embed(input_ids)
-        block = LlamaBlock
+        block = type(self).block_cls
         if cfg.remat:
-            block = nn.remat(LlamaBlock, policy=jax.checkpoint_policies.nothing_saveable)
+            block = nn.remat(block, policy=jax.checkpoint_policies.nothing_saveable)
         for i in range(cfg.num_hidden_layers):
             x = block(cfg, name=f"layers_{i}")(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)

@@ -1,0 +1,186 @@
+"""Mixtral-class sparse-MoE decoder — the MoE model family (SURVEY §2.4 P10).
+
+Reference capability: MoE models train under sharding without materializing
+all experts per device (DeepSpeed MoE leaf-module marking, reference
+accelerator.py:2258-2259; Megatron ``num_experts``/GLM4-MoE parsing,
+reference dataclasses.py:2941).  Here the experts live in stacked weight
+tensors ``[E, d, f]`` whose leading dim shards over the ``ep`` mesh axis
+(parallel/expert_parallel.MOE_EP_RULES); token dispatch is the GShard dense
+einsum, so under GSPMD the all_to_alls are compiler-inserted and the MXU sees
+large batched matmuls.
+
+Attention, RoPE, norms, and the causal-LM head are shared with the Llama
+family (models/llama.py) — a Mixtral block is a Llama block whose MLP is
+replaced by the sparse MoE layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.expert_parallel import (
+    expert_capacity,
+    moe_combine,
+    moe_dispatch,
+    top_k_routing,
+)
+from .llama import LlamaAttention, LlamaConfig, LlamaForCausalLM, RMSNorm, causal_lm_loss
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.02
+    router_z_loss_coef: float = 1e-3
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, num_local_experts=4,
+            num_experts_per_tok=2,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw):
+        defaults = dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_local_experts=8, num_experts_per_tok=2,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class MixtralSparseMoE(nn.Module):
+    """Top-k routed expert MLP (SwiGLU experts, GShard einsum dispatch)."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, t, d = x.shape
+        e, f = cfg.num_local_experts, cfg.intermediate_size
+        tokens = x.reshape(b * t, d)
+
+        router_logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="router"
+        )(tokens.astype(jnp.float32))
+        capacity = expert_capacity(b * t, e, cfg.num_experts_per_tok, cfg.capacity_factor)
+        routing = top_k_routing(router_logits, cfg.num_experts_per_tok, capacity)
+        # Surface router losses to the loss fn via flax's sow channel
+        # (the functional analog of the reference's .aux_loss attributes).
+        self.sow("intermediates", "router_aux_loss", routing.aux_loss)
+        self.sow("intermediates", "router_z_loss", routing.z_loss)
+
+        grouped = moe_dispatch(tokens, routing).astype(cfg.dtype)  # [E, C, D]
+        out = MixtralExperts(cfg, name="experts")(grouped)
+        y = moe_combine(out, routing)  # [S, D]
+        return y.reshape(b, t, d).astype(cfg.dtype)
+
+
+class MixtralExperts(nn.Module):
+    """Stacked SwiGLU experts: weights [E, d, f] / [E, f, d], expert dim
+    sharded over ``ep`` (MOE_EP_RULES)."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, grouped):
+        cfg = self.config
+        e, d, f = cfg.num_local_experts, cfg.hidden_size, cfg.intermediate_size
+        init = nn.initializers.lecun_normal()
+        w_gate = self.param("gate_proj", init, (e, d, f), jnp.float32)
+        w_up = self.param("up_proj", init, (e, d, f), jnp.float32)
+        w_down = self.param("down_proj", init, (e, f, d), jnp.float32)
+        gate = jnp.einsum("ecd,edf->ecf", grouped, w_gate.astype(cfg.dtype))
+        up = jnp.einsum("ecd,edf->ecf", grouped, w_up.astype(cfg.dtype))
+        return jnp.einsum("ecf,efd->ecd", nn.silu(gate) * up, w_down.astype(cfg.dtype))
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.config
+        h = x + LlamaAttention(cfg, name="self_attn")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x), positions, segment_ids
+        )
+        out = h + MixtralSparseMoE(cfg, name="block_sparse_moe")(
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h)
+        )
+        return out
+
+
+class MixtralForCausalLM(LlamaForCausalLM):
+    """MoE decoder LM — the Llama decoder skeleton with the sparse-MoE block.
+    ``__call__(input_ids) -> logits``; router losses are sown into the
+    ``intermediates`` collection."""
+
+    config: MixtralConfig
+
+    block_cls = MixtralBlock
+
+
+def make_mixtral_loss_fn(model: MixtralForCausalLM):
+    """Causal-LM loss + router aux/z losses collected from the sow channel."""
+    cfg = model.config
+
+    def loss_fn(params, batch):
+        logits, mods = model.apply(
+            params, batch["input_ids"], segment_ids=batch.get("segment_ids"),
+            mutable=["intermediates"],
+        )
+        loss = causal_lm_loss(logits, batch["labels"])
+        inter = mods.get("intermediates", {})
+        aux = [v for k, v in _iter_sown(inter) if k == "router_aux_loss"]
+        zl = [v for k, v in _iter_sown(inter) if k == "router_z_loss"]
+        if aux:
+            loss = loss + cfg.router_aux_loss_coef * jnp.mean(jnp.stack(aux))
+        if zl:
+            loss = loss + cfg.router_z_loss_coef * jnp.mean(jnp.stack(zl))
+        return loss
+
+    return loss_fn
+
+
+def _iter_sown(tree, key=None):
+    """Yield (leaf_key, value) for every sown scalar in a nested dict."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _iter_sown(v, k)
+    elif isinstance(tree, (tuple, list)):
+        for v in tree:
+            yield key, v
+    else:
+        yield key, tree
+
+
+def count_active_params(cfg: MixtralConfig) -> int:
+    """Params touched per token (top-k experts) — the MFU-relevant count."""
+    dense = (
+        cfg.vocab_size * cfg.hidden_size * (1 if cfg.tie_word_embeddings else 2)
+        + cfg.num_hidden_layers * (
+            cfg.hidden_size * cfg.head_dim * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads)
+            + cfg.num_attention_heads * cfg.head_dim * cfg.hidden_size
+            + 2 * cfg.hidden_size
+            + cfg.hidden_size * cfg.num_local_experts  # router
+        )
+        + cfg.hidden_size
+    )
+    expert = cfg.num_hidden_layers * cfg.num_experts_per_tok * 3 * cfg.hidden_size * cfg.intermediate_size
+    return dense + expert

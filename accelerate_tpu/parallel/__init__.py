@@ -1,1 +1,1 @@
-from . import collectives
+from . import collectives, expert_parallel

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
@@ -185,7 +185,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = 
             rotate_method=rotate_method, zigzag=zigzag,
         )
         return shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
         )(q, k, v)
 
     return attn

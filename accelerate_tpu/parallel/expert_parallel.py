@@ -1,0 +1,187 @@
+"""Expert parallelism (MoE) over the ``ep`` mesh axis.
+
+TPU-native design for SURVEY §2.4 P10.  The reference has no first-class EP —
+MoE support there is DeepSpeed ZeRO-3 leaf-module marking
+(``deepspeed_plugin.set_moe_leaf_modules``, reference accelerator.py:2258-2259,
+``transformer_moe_cls_names`` dataclasses.py:1199-1205) plus Megatron
+``num_experts`` plumbing (reference utils/megatron_lm.py).  Capability parity
+= "MoE models train under sharding without materializing all experts per
+device", which on TPU is an ``ep`` mesh axis plus token dispatch.
+
+Two complementary mechanisms, both MXU-friendly:
+
+1. **GSPMD einsum dispatch** (GShard-style): routing produces dense
+   ``dispatch``/``combine`` tensors ``[tokens, experts, capacity]``; expert
+   compute is a batched einsum with the expert dim sharded over ``ep`` —
+   XLA's partitioner inserts the all_to_alls.  This is the default path used
+   by :class:`~accelerate_tpu.models.mixtral.MixtralForCausalLM`.
+2. **Explicit shard_map dispatch** (:func:`expert_parallel_apply`): manual
+   ``all_to_all`` that re-shards grouped tokens from capacity-sharded to
+   expert-sharded, for expert bodies that cannot be expressed as one einsum
+   (the "ragged all-to-all" capability named in SURVEY §2.4 P10).
+
+Routing follows Switch/Mixtral: top-k softmax gating with a load-balancing
+auxiliary loss and an optional router z-loss.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+class RoutingResult(NamedTuple):
+    """Dense dispatch/combine tensors plus router diagnostics.
+
+    dispatch: [S, E, C] bool — token s goes to expert e at capacity slot c.
+    combine:  [S, E, C] f32  — gating weight for the dispatched slot.
+    aux_loss: scalar — Switch load-balancing loss (1.0 when perfectly uniform).
+    z_loss:   scalar — router logit magnitude regularizer.
+    """
+
+    dispatch: jax.Array
+    combine: jax.Array
+    aux_loss: jax.Array
+    z_loss: jax.Array
+
+
+def expert_capacity(num_tokens: int, num_experts: int, top_k: int, capacity_factor: float) -> int:
+    """Per-expert token capacity C = ceil(S * k / E * factor), padded to a
+    multiple of 8 so the [E, C, D] expert batches tile onto the MXU."""
+    raw = int(np.ceil(num_tokens * top_k / num_experts * capacity_factor))
+    return max(8, int(np.ceil(raw / 8)) * 8)
+
+
+def top_k_routing(
+    router_logits: jax.Array,
+    top_k: int,
+    capacity: int,
+    *,
+    normalize_weights: bool = True,
+) -> RoutingResult:
+    """Capacity-constrained top-k routing (Switch Transformer §2.2 semantics,
+    Mixtral-style top-k weight normalization).
+
+    router_logits: [S, E].  Tokens beyond an expert's capacity are dropped
+    (their combine weight is zero → residual connection passes them through).
+    """
+    s, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # [S, E]
+    gate_vals, gate_idx = lax.top_k(probs, top_k)  # [S, K]
+    if normalize_weights:
+        gate_vals = gate_vals / jnp.maximum(jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # One-hot expert assignment per k-slot: [K, S, E].  Priority is slot-major
+    # (all tokens' 1st choices before any 2nd choices — Switch behavior).
+    assign = jax.nn.one_hot(gate_idx.T, e, dtype=jnp.int32)  # [K, S, E]
+    flat_assign = assign.reshape(top_k * s, e)
+    # Position of each (slot, token) within its expert's queue.
+    position = jnp.cumsum(flat_assign, axis=0) - flat_assign  # [K*S, E]
+    position = jnp.sum(position * flat_assign, axis=-1).reshape(top_k, s)  # [K, S]
+    kept = position < capacity
+
+    # dispatch[s, e, c]: OR over k-slots of (token s → expert e at slot c)
+    pos_oh = jax.nn.one_hot(jnp.where(kept, position, capacity), capacity, dtype=jnp.float32)
+    dispatch_k = assign.astype(jnp.float32)[..., None] * pos_oh[:, :, None, :]  # [K, S, E, C]
+    dispatch = jnp.sum(dispatch_k, axis=0)  # [S, E, C]
+    combine = jnp.sum(dispatch_k * gate_vals.T[:, :, None, None], axis=0)  # [S, E, C]
+
+    # Switch load-balancing loss: E * sum_e f_e * p_e where f_e is the
+    # fraction of tokens whose FIRST choice is e and p_e the mean router prob.
+    first_choice = jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32)
+    f = jnp.mean(first_choice, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(f * p)
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(router_logits.astype(jnp.float32), axis=-1)))
+
+    return RoutingResult(dispatch > 0, combine, aux_loss, z_loss)
+
+
+def moe_dispatch(x: jax.Array, routing: RoutingResult) -> jax.Array:
+    """Gather tokens into per-expert batches: [S, D] → [E, C, D].
+
+    With expert-dim outputs sharded over ``ep`` this einsum IS the all_to_all
+    (GSPMD inserts it)."""
+    return jnp.einsum("sec,sd->ecd", routing.dispatch.astype(x.dtype), x)
+
+
+def moe_combine(expert_out: jax.Array, routing: RoutingResult) -> jax.Array:
+    """Weighted scatter back: [E, C, D] → [S, D]."""
+    return jnp.einsum("sec,ecd->sd", routing.combine.astype(expert_out.dtype), expert_out)
+
+
+# ---------------------------------------------------------------------------
+# Explicit shard_map dispatch (ragged all-to-all capability)
+# ---------------------------------------------------------------------------
+
+
+def _ep_body(x_grouped, axis_name: str, expert_fn: Callable):
+    """shard_map body.  Local block: [E, C/ep, D] (capacity-sharded).
+
+    all_to_all #1 re-shards experts→local, capacities→global:
+    [E, C/ep, D] → [E/ep, C, D]; apply the local experts; all_to_all #2
+    restores the original layout.  ``expert_fn(local_idx, batch)`` computes
+    one expert's forward, vmapped over the local expert dim by the caller.
+    """
+    local = lax.all_to_all(x_grouped, axis_name, split_axis=0, concat_axis=1, tiled=True)
+    ep_rank = lax.axis_index(axis_name)
+    e_local = local.shape[0]
+    out = expert_fn(ep_rank * e_local + jnp.arange(e_local), local)
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0, tiled=True)
+
+
+def expert_parallel_apply(
+    mesh: Mesh,
+    expert_fn: Callable,
+    x_grouped: jax.Array,
+    *,
+    axis_name: str = "ep",
+):
+    """Apply per-expert compute to grouped tokens with explicit all_to_all.
+
+    x_grouped: GLOBAL [E, C, D], capacity dim sharded over ``axis_name``.
+    expert_fn: ``(global_expert_indices [E/ep], batch [E/ep, C, D]) → [E/ep, C, D]``.
+    Returns [E, C, D] with the input's sharding.
+
+    Use when the expert body is not expressible as a single einsum over a
+    sharded expert dim (e.g. per-expert quantized weights, ragged kernels).
+    """
+    if mesh.shape.get(axis_name, 1) == 1:
+        e = x_grouped.shape[0]
+        return expert_fn(jnp.arange(e), x_grouped)
+    spec = P(None, axis_name, None)
+    fn = jax.shard_map(
+        functools.partial(_ep_body, axis_name=axis_name, expert_fn=expert_fn),
+        mesh=mesh,
+        in_specs=(spec,),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(x_grouped)
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules for expert parameters
+# ---------------------------------------------------------------------------
+
+# Expert weight tensors carry a leading num_experts dim → shard it over "ep";
+# the contraction dims follow the usual Megatron column/row TP layout.
+MOE_EP_RULES: list[tuple[str, P]] = [
+    (r"experts/(gate_proj|up_proj)$", P("ep", None, "tp")),
+    (r"experts/down_proj$", P("ep", "tp", None)),
+    (r"router/kernel$", P()),  # router stays replicated — it is tiny
+]
+
+
+def get_moe_rules():
+    """EP+TP rule table for MoE transformer blocks (prepend to the dense
+    TRANSFORMER_TP_RULES so expert patterns win)."""
+    from .sharding import TRANSFORMER_TP_RULES
+
+    return MOE_EP_RULES + TRANSFORMER_TP_RULES

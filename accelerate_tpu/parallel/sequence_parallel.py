@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -70,7 +70,7 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
         body = functools.partial(ulysses_attention_sharded, axis_name=axis_name, causal=causal,
                                  inner_attn=inner_attn)
         return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                         check_rep=False)(q, k, v)
+                         check_vma=False)(q, k, v)
 
     return attn
 

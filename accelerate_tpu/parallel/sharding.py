@@ -205,6 +205,10 @@ def get_tp_rules(plan: str = "auto"):
     """Rule table lookup (models may register their own)."""
     if plan in ("auto", "transformer"):
         return TRANSFORMER_TP_RULES
+    if plan in ("moe", "mixtral"):
+        from .expert_parallel import get_moe_rules
+
+        return get_moe_rules()
     if plan in ("none", None):
         return []
     raise ValueError(f"unknown tp plan {plan!r}")
