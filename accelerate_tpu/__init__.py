@@ -52,3 +52,7 @@ try:
     from .launchers import debug_launcher, notebook_launcher
 except ImportError:  # pragma: no cover
     pass
+try:
+    from .parallel.pipeline_parallel import PipelinedModel, prepare_pipeline
+except ImportError:  # pragma: no cover
+    pass

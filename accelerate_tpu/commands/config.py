@@ -39,7 +39,11 @@ class LaunchConfig:
     config_version: int = CONFIG_VERSION
     # -- process topology (one process per host on TPU) --------------------
     num_processes: int = 1
-    machine_rank: int = 0
+    # num_machines decides local-spawn vs multi-host (reference ClusterConfig
+    # num_machines); machine_rank stays None until a host identifies itself —
+    # a silent default of 0 would make every host rank 0.
+    num_machines: int = 1
+    machine_rank: Optional[int] = None
     main_process_ip: Optional[str] = None
     main_process_port: Optional[int] = None
     # -- execution ---------------------------------------------------------
@@ -54,6 +58,7 @@ class LaunchConfig:
     sp_size: int = 1
     tp_size: int = 1
     ep_size: int = 1
+    pp_size: int = 1
     # -- FSDP/ZeRO sharding knobs (FSDP_* transport) -----------------------
     use_fsdp: bool = False
     fsdp_sharding_strategy: str = "FULL_SHARD"
@@ -80,6 +85,17 @@ class LaunchConfig:
         # Forward-compat: stash unknown keys into env passthrough untouched.
         if unknown:
             cfg.env.update({k: str(v) for k, v in unknown.items()})
+        # Migration guard: configs written before num_machines existed used a
+        # stored main_process_ip to mean "multi-host".  Loading one under the
+        # new semantics would silently spawn locally with duplicate ranks —
+        # make the user re-state their topology instead.
+        if raw.get("main_process_ip") and "num_machines" not in raw:
+            raise ValueError(
+                f"{path} predates the num_machines field: it stores a "
+                "main_process_ip but no host count.  Re-run `accelerate-tpu "
+                "config` (or add `num_machines: N` to the file) to state "
+                "whether this is a multi-host job."
+            )
         return cfg
 
 
@@ -114,8 +130,12 @@ def interactive_config() -> LaunchConfig:
     print("accelerate-tpu configuration (enter to accept defaults)")
     cfg.num_processes = _ask("How many processes (= TPU hosts)?", 1, int)
     if cfg.num_processes > 1:
-        cfg.main_process_ip = _ask("Coordinator (process-0) IP?", "127.0.0.1")
-        cfg.main_process_port = _ask("Coordinator port?", 29500, int)
+        cfg.num_machines = _ask(
+            "How many machines (1 = spawn all processes on this host)?", 1, int
+        )
+        if cfg.num_machines > 1:
+            cfg.main_process_ip = _ask("Coordinator (process-0) IP?", "127.0.0.1")
+            cfg.main_process_port = _ask("Coordinator port?", 29500, int)
     cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)?", "bf16")
     cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
     cfg.use_fsdp = _ask("Shard parameters/optimizer state (FSDP/ZeRO-3)?", True, bool)
@@ -123,6 +143,7 @@ def interactive_config() -> LaunchConfig:
     cfg.cp_size = _ask("Context-parallel size (ring attention)?", 1, int)
     cfg.sp_size = _ask("Sequence-parallel size (Ulysses)?", 1, int)
     cfg.ep_size = _ask("Expert-parallel size (MoE)?", 1, int)
+    cfg.pp_size = _ask("Pipeline-parallel size?", 1, int)
     cfg.dp_shard_size = -1 if cfg.use_fsdp else 1
     return cfg
 

@@ -33,7 +33,7 @@ from ..utils.launch import (
     prepare_tpu_pod_env,
 )
 
-_PARALLEL_FLAGS = ("dp_replicate_size", "dp_shard_size", "cp_size", "sp_size", "tp_size", "ep_size")
+from ..parallelism_config import AXIS_SIZE_FIELDS as _PARALLEL_FLAGS
 
 
 def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
@@ -46,6 +46,8 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--config_file", default=None, help="YAML config to launch with.")
     # topology
     parser.add_argument("--num_processes", type=int, default=None, help="Total processes (= TPU hosts).")
+    parser.add_argument("--num_machines", type=int, default=None,
+                        help="Hosts in the job; >1 means this invocation is one worker of N.")
     parser.add_argument("--machine_rank", type=int, default=None, help="Rank of this host (multi-host mode).")
     parser.add_argument("--main_process_ip", default=None, help="Coordinator (rank-0 host) IP.")
     parser.add_argument("--main_process_port", type=int, default=None, help="Coordinator port.")
@@ -81,7 +83,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
 def _merge_args_into_config(args, config: LaunchConfig) -> LaunchConfig:
     """CLI flag > YAML file > default (reference launch.py:1196)."""
     direct = (
-        "num_processes", "machine_rank", "main_process_ip", "main_process_port",
+        "num_processes", "num_machines", "machine_rank", "main_process_ip", "main_process_port",
         "mixed_precision", "gradient_accumulation_steps",
         "use_fsdp", "fsdp_sharding_strategy", "fsdp_offload_params",
         "fsdp_activation_checkpointing", *_PARALLEL_FLAGS,
@@ -106,6 +108,23 @@ def _validate(config: LaunchConfig):
             raise ValueError(f"{f} must be >= 1 (only dp_shard_size may be -1), got {v}")
     if config.num_processes < 1:
         raise ValueError("num_processes must be >= 1")
+    if config.num_machines < 1:
+        raise ValueError("num_machines must be >= 1")
+    if config.num_machines > 1 and config.num_machines != config.num_processes:
+        # One process per host is the TPU topology; a mismatch would leave
+        # jax.distributed.initialize waiting forever for workers that are
+        # never started on any host.
+        raise ValueError(
+            f"multi-host launch runs one process per host: num_machines "
+            f"({config.num_machines}) must equal num_processes ({config.num_processes})"
+        )
+    if config.machine_rank is not None and not (
+        0 <= config.machine_rank < config.num_processes
+    ):
+        raise ValueError(
+            f"machine_rank {config.machine_rank} out of range for "
+            f"num_processes {config.num_processes}"
+        )
 
 
 def _spawn_local_workers(cmd, args, config) -> int:
@@ -141,15 +160,14 @@ def _spawn_local_workers(cmd, args, config) -> int:
 def launch_command(args) -> None:
     config = _merge_args_into_config(args, load_config_or_default(args.config_file))
     _validate(config)
-    if args.multi_host and args.machine_rank is None and args.config_file is None:
-        raise ValueError("--multi_host needs --machine_rank (this host's rank)")
     cmd, env = prepare_simple_launcher_cmd_env(args, config)
 
-    # Multi-host if requested by flag OR described by the merged config: a
-    # stored main_process_ip means this invocation is one worker of N hosts
-    # (the config-file analog of the reference's machine_rank YAML fields).
+    # Multi-host if requested by flag/rank OR described by the merged config
+    # (num_machines > 1, the reference ClusterConfig field).  A stored
+    # main_process_ip alone does NOT imply multi-host: local multi-process
+    # configs may carry a coordinator address for the spawned workers.
     multi_host = (
-        args.multi_host or args.machine_rank is not None or config.main_process_ip is not None
+        args.multi_host or args.machine_rank is not None or config.num_machines > 1
     )
     # Pod metadata only fills topology the user left unspecified — explicit
     # flags/config always win (flag > file > default precedence).
@@ -159,6 +177,10 @@ def launch_command(args) -> None:
         # On a TPU pod: this host is one worker; topology came from metadata.
         env = pod_env
     elif multi_host:
+        if config.machine_rank is None:
+            # No silent rank-0 default: two hosts both claiming rank 0
+            # deadlock the collective init with no actionable error.
+            raise ValueError("multi-host launch needs --machine_rank (this host's rank)")
         if config.main_process_ip is None:
             raise ValueError("multi-host launch needs --main_process_ip")
         if config.main_process_port is None:

@@ -1,1 +1,1 @@
-from . import collectives, expert_parallel
+from . import collectives, expert_parallel, pipeline_parallel

@@ -28,9 +28,18 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec
 
 # Canonical axis order — mirrors reference parallelism_config.py:267 with the
-# TPU-native addition of an expert-parallel axis (reference has no first-class
-# EP; SURVEY §2.4 P10 calls for one).
-MESH_AXIS_ORDER = ("dp_replicate", "dp_shard", "cp", "sp", "tp", "ep")
+# TPU-native additions of an expert-parallel axis (reference has no first-class
+# EP; SURVEY §2.4 P10 calls for one) and a pipeline axis (reference PP is
+# inference-only via PiPPy, inference.py:126, or Megatron pp_degree).  ``pp``
+# sits next to ``dp_replicate`` at the outside: stage hand-offs are infrequent
+# point-to-point transfers, so like replicate traffic they can ride DCN while
+# dp_shard/cp/sp/tp stay on ICI.
+MESH_AXIS_ORDER = ("dp_replicate", "pp", "dp_shard", "cp", "sp", "tp", "ep")
+
+# The per-axis size fields / env vars are derived from the axis list so a new
+# axis cannot silently miss one of the transport surfaces (launcher flags,
+# PARALLELISM_CONFIG_* env, from_env/to_env).
+AXIS_SIZE_FIELDS = tuple(f"{name}_size" for name in MESH_AXIS_ORDER)
 
 
 @dataclass
@@ -49,6 +58,7 @@ class ParallelismConfig:
     sp_size: int = 1
     tp_size: int = 1
     ep_size: int = 1
+    pp_size: int = 1
 
     # Advanced: override the device list (testing / explicit topology)
     devices: Optional[Sequence] = field(default=None, repr=False, compare=False)
@@ -58,29 +68,15 @@ class ParallelismConfig:
         """Re-hydrate from ``PARALLELISM_CONFIG_*`` env vars, the launcher's
         transport channel (reference parallelism_config.py:274-289)."""
 
-        def _get(name, default="1"):
-            return int(os.environ.get(f"PARALLELISM_CONFIG_{name}", default))
-
-        return cls(
-            dp_replicate_size=_get("DP_REPLICATE_SIZE"),
-            dp_shard_size=_get("DP_SHARD_SIZE"),
-            cp_size=_get("CP_SIZE"),
-            sp_size=_get("SP_SIZE"),
-            tp_size=_get("TP_SIZE"),
-            ep_size=_get("EP_SIZE"),
-        )
+        return cls(**{
+            field: int(os.environ.get(f"PARALLELISM_CONFIG_{field.upper()}", "1"))
+            for field in AXIS_SIZE_FIELDS
+        })
 
     def to_env(self) -> dict[str, str]:
         return {
-            f"PARALLELISM_CONFIG_{name.upper()}": str(getattr(self, name))
-            for name in (
-                "dp_replicate_size",
-                "dp_shard_size",
-                "cp_size",
-                "sp_size",
-                "tp_size",
-                "ep_size",
-            )
+            f"PARALLELISM_CONFIG_{field.upper()}": str(getattr(self, field))
+            for field in AXIS_SIZE_FIELDS
         }
 
     # -- size accessors ----------------------------------------------------
@@ -93,6 +89,7 @@ class ParallelismConfig:
             "sp": self.sp_size,
             "tp": self.tp_size,
             "ep": self.ep_size,
+            "pp": self.pp_size,
         }
 
     @property
@@ -104,10 +101,11 @@ class ParallelismConfig:
 
     @property
     def non_data_parallel_size(self) -> int:
-        """reference parallelism_config.py — cp*sp*tp*ep: the factor by
+        """reference parallelism_config.py — cp*sp*tp*ep*pp: the factor by
         which dataloader ranks are collapsed so non-DP ranks see identical
-        batches (reference data_loader.py:1109-1145)."""
-        return self.cp_size * self.sp_size * self.tp_size * self.ep_size
+        batches (reference data_loader.py:1109-1145; all pipeline stages of
+        one replica consume the same batch)."""
+        return self.cp_size * self.sp_size * self.tp_size * self.ep_size * self.pp_size
 
     @property
     def data_parallel_size(self) -> int:
@@ -168,7 +166,8 @@ class ParallelismConfig:
             raise ValueError("cp_size and sp_size cannot both be > 1 (pick ring CP or Ulysses SP)")
         if self.dp_shard_size == -1:
             rest = (
-                self.dp_replicate_size * self.cp_size * self.sp_size * self.tp_size * self.ep_size
+                self.dp_replicate_size * self.cp_size * self.sp_size * self.tp_size
+                * self.ep_size * self.pp_size
             )
             if num_devices % rest != 0:
                 raise ValueError(
@@ -184,7 +183,7 @@ class ParallelismConfig:
     def build_device_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
         """Build the N-D :class:`Mesh` (reference build_device_mesh :211).
 
-        Always materializes *all six* axes (size-1 axes are free) so partition
+        Always materializes *all seven* axes (size-1 axes are free) so partition
         specs can reference any axis name regardless of config — XLA treats
         size-1 mesh dims as no-ops.  ``dp_replicate`` is outermost so
         multi-slice replication maps to DCN.

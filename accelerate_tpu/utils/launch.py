@@ -54,8 +54,10 @@ def _base_env(args, config) -> dict[str, str]:
         env["FSDP_ACTIVATION_CHECKPOINTING"] = str(config.fsdp_activation_checkpointing).lower()
     # Parallelism axes — PARALLELISM_CONFIG_* transport
     # (reference parallelism_config.py:274-289 / utils/launch.py:397).
-    for name in ("dp_replicate", "dp_shard", "cp", "sp", "tp", "ep"):
-        env[f"PARALLELISM_CONFIG_{name.upper()}_SIZE"] = str(getattr(config, f"{name}_size"))
+    from ..parallelism_config import AXIS_SIZE_FIELDS
+
+    for field in AXIS_SIZE_FIELDS:
+        env[f"PARALLELISM_CONFIG_{field.upper()}"] = str(getattr(config, field))
     return env
 
 

@@ -65,6 +65,53 @@ def test_multi_host_requires_rank_and_port():
                                       "--num_processes", "2", "script.py"]))
 
 
+def test_local_spawn_despite_stored_coordinator_ip(tmp_path, monkeypatch):
+    """A local multi-process config that carries a coordinator address (as the
+    questionnaire used to store) must still spawn workers locally."""
+    from accelerate_tpu.commands import launch as launch_mod
+
+    cfg_path = tmp_path / "local.yaml"
+    LaunchConfig(num_processes=4, main_process_ip="127.0.0.1", main_process_port=29500).save(cfg_path)
+    called = {}
+    def fake_spawn(cmd, args, config):
+        called["n"] = config.num_processes
+        return 0
+
+    monkeypatch.setattr(launch_mod, "_spawn_local_workers", fake_spawn)
+    with pytest.raises(SystemExit) as exc:
+        launch_mod.launch_command(_parse_launch(["--config_file", str(cfg_path), "script.py"]))
+    assert exc.value.code == 0
+    assert called["n"] == 4
+
+
+def test_multi_host_config_without_rank_raises(tmp_path):
+    """num_machines>1 from a config file must not silently default every host
+    to machine_rank 0."""
+    from accelerate_tpu.commands.launch import launch_command
+
+    cfg_path = tmp_path / "cluster.yaml"
+    LaunchConfig(num_processes=2, num_machines=2, main_process_ip="10.0.0.1",
+                 main_process_port=29500).save(cfg_path)
+    with pytest.raises(ValueError, match="machine_rank"):
+        launch_command(_parse_launch(["--config_file", str(cfg_path), "script.py"]))
+
+
+def test_validate_rejects_topology_mismatch():
+    with pytest.raises(ValueError, match="num_machines"):
+        _validate(LaunchConfig(num_processes=4, num_machines=2))
+    with pytest.raises(ValueError, match="machine_rank"):
+        _validate(LaunchConfig(num_processes=2, num_machines=2, machine_rank=5))
+
+
+def test_pre_num_machines_config_rejected(tmp_path):
+    """Old-style multi-host YAML (ip stored, no num_machines key) must not be
+    silently reinterpreted as a local spawn."""
+    cfg_path = tmp_path / "old.yaml"
+    cfg_path.write_text("num_processes: 2\nmain_process_ip: 10.0.0.1\nmain_process_port: 29500\n")
+    with pytest.raises(ValueError, match="num_machines"):
+        LaunchConfig.load(cfg_path)
+
+
 def test_explicit_topology_beats_pod_metadata(monkeypatch):
     """Explicit flags must win over pod metadata (flag > file > default)."""
     from accelerate_tpu.commands import launch as launch_mod
@@ -118,7 +165,16 @@ def test_env_transport_simple():
     assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
     assert env["PARALLELISM_CONFIG_TP_SIZE"] == "2"
     assert env["ACCELERATE_USE_FSDP"] == "true"
+    # every axis crosses the process boundary, including the pp axis
+    assert env["PARALLELISM_CONFIG_PP_SIZE"] == "1"
     assert env["FSDP_SHARDING_STRATEGY"] == "FULL_SHARD"
+
+
+def test_env_transport_pp_size():
+    args = _parse_launch(["--pp_size", "2", "script.py"])
+    config = _merge_args_into_config(args, LaunchConfig())
+    _, env = prepare_simple_launcher_cmd_env(args, config)
+    assert env["PARALLELISM_CONFIG_PP_SIZE"] == "2"
 
 
 def test_env_transport_multiprocess():
