@@ -56,3 +56,11 @@ try:
     from .parallel.pipeline_parallel import PipelinedModel, prepare_pipeline
 except ImportError:  # pragma: no cover
     pass
+try:
+    from .local_sgd import LocalSGD
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .utils.other import extract_model_from_parallel
+except ImportError:  # pragma: no cover
+    pass

@@ -747,7 +747,12 @@ class Accelerator:
         return ops.pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
 
     def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
-        return model  # nothing wraps models under GSPMD
+        """Sharded training never wraps models under GSPMD; the one wrapping
+        container is the pipeline-parallel PipelinedModel (reference
+        extract_model_from_parallel utils/other.py:218)."""
+        from .utils.other import extract_model_from_parallel
+
+        return extract_model_from_parallel(model, keep_fp32_wrapper)
 
     def unscale_gradients(self, optimizer=None):
         return None  # unscaling happens inside the jitted step

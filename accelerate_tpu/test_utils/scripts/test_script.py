@@ -99,11 +99,32 @@ def training_check():
     Accelerator().print(f"training parity OK (loss {final_loss:.4f})")
 
 
+def local_sgd_check():
+    """Ranks holding divergent params converge to the cross-process mean at
+    the sync cadence (reference local_sgd.py P13)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu import LocalSGD, PartialState
+
+    state = PartialState()
+    with LocalSGD(local_sgd_steps=2) as sgd:
+        params = {"w": jnp.full((3,), float(state.process_index))}
+        params = sgd.step(params)  # step 1: no sync
+        if state.num_processes > 1:
+            np.testing.assert_allclose(np.asarray(params["w"]), state.process_index)
+        params = sgd.step(params)  # step 2: sync -> mean of ranks
+        if state.num_processes > 1:
+            expected = (state.num_processes - 1) / 2.0
+            np.testing.assert_allclose(np.asarray(params["w"]), expected)
+    state.print("local sgd OK")
+
+
 def main():
     check_process_state()
     check_env_transport()
     check_collectives()
     training_check()
+    local_sgd_check()
     from accelerate_tpu import PartialState
 
     PartialState().print("ALL CHECKS PASSED")

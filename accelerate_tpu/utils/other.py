@@ -1,0 +1,123 @@
+"""Grab-bag utilities (reference ``utils/other.py``, 564 LoC).
+
+TPU-native analogs of the pieces that survive the torch→JAX redesign:
+
+- :func:`extract_model_from_parallel` (reference :218) — unwrap framework
+  wrappers back to the user's model.
+- :func:`save` / :func:`load` (reference :354/:404) — pytree serialization
+  to disk, main-process-gated.
+- :func:`compile_regions` / :func:`aot_compile` (reference ``compile_regions``
+  :102 — regional ``torch.compile`` of repeated blocks to cut compile time)
+  — the XLA analog is ahead-of-time lowering: jit already caches per-shape,
+  so the win is *when* compilation happens, not how often.
+- :func:`check_os_kernel` (reference :501) — warn on Linux kernels with the
+  MKL/OMP fork bug class.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import platform
+import time
+from typing import Any, Callable, Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def extract_model_from_parallel(model: Any, keep_fp32_wrapper: bool = True) -> Any:
+    """Unwrap framework wrappers and return the underlying user model
+    (reference utils/other.py:218 — DDP/FSDP/DeepSpeed/compiled unwrap).
+
+    The TPU build has exactly one wrapping container: a pipeline-parallel
+    :class:`~accelerate_tpu.parallel.pipeline_parallel.PipelinedModel`.
+    Sharded training never wraps the model (GSPMD shards arrays, not
+    modules), so everything else passes through unchanged.
+    """
+    from ..parallel.pipeline_parallel import PipelinedModel
+
+    if isinstance(model, PipelinedModel):
+        return model.model
+    return model
+
+
+def save(obj: Any, path: os.PathLike | str, safe_serialization: bool = True) -> None:
+    """Serialize a pytree of arrays to ``path``, only on the main process
+    (reference utils/other.py:354).  Uses flax msgpack bytes — a
+    self-describing, framework-portable container."""
+    from flax import serialization
+
+    from ..state import PartialState
+
+    if not PartialState().is_main_process:
+        return
+    data = serialization.to_bytes(jax.device_get(obj))
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def load(path: os.PathLike | str, target: Optional[Any] = None) -> Any:
+    """Inverse of :func:`save` (reference utils/other.py:404).  With
+    ``target`` (an example pytree) the result keeps its exact structure and
+    dtypes; without it, msgpack's generic dict-of-arrays comes back."""
+    from flax import serialization
+
+    with open(path, "rb") as f:
+        data = f.read()
+    if target is not None:
+        return serialization.from_bytes(target, data)
+    return serialization.msgpack_restore(data)
+
+
+def aot_compile(fn: Callable, *example_args, **example_kwargs):
+    """Ahead-of-time compile ``fn`` for the example arguments.
+
+    Returns ``(compiled, seconds)``.  ``compiled`` is an executable
+    accepting arrays matching the example shapes/dtypes/shardings — calling
+    it never triggers tracing or compilation again.
+    """
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    t0 = time.perf_counter()
+    compiled = jitted.lower(*example_args, **example_kwargs).compile()
+    return compiled, time.perf_counter() - t0
+
+
+def compile_regions(step_fns: dict[str, Callable] | Callable, *example_args):
+    """Regional pre-compilation (reference ``compile_regions``
+    utils/other.py:102).
+
+    The torch version compiles each *repeated block* separately so compile
+    cost is paid once per block class instead of once per call site.  Under
+    XLA, jit's trace cache already deduplicates identical block programs;
+    what remains worth controlling is paying compilation up front.  Pass one
+    function or a dict of named functions plus example args; each is
+    AOT-compiled and returned in the same shape, with compile seconds logged.
+    """
+    if callable(step_fns):
+        compiled, dt = aot_compile(step_fns, *example_args)
+        logger.info("compile_regions: compiled in %.2fs", dt)
+        return compiled
+    out = {}
+    for name, fn in step_fns.items():
+        out[name], dt = aot_compile(fn, *example_args)
+        logger.info("compile_regions[%s]: compiled in %.2fs", name, dt)
+    return out
+
+
+def check_os_kernel() -> None:
+    """Warn about Linux kernels below 5.5 (reference utils/other.py:501 —
+    a known source of hangs with heavy host threading)."""
+    info = platform.uname()
+    if info.system != "Linux":
+        return
+    try:
+        release = tuple(int(p) for p in info.release.split(".")[:2])
+    except ValueError:
+        return
+    if release < (5, 5):
+        logger.warning(
+            "Detected Linux kernel %s < 5.5; host-side data loading may hang "
+            "under heavy threading. Consider upgrading.", info.release
+        )
