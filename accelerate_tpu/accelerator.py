@@ -580,10 +580,13 @@ class Accelerator:
                 rng, use_rng = jax.random.split(state.rng)
 
                 def microbatch(carry, mb):
-                    grads_acc, loss_acc = carry
-                    loss, _aux, grads = compute_grads(state.params, mb, use_rng, state.loss_scale)
+                    grads_acc, loss_acc, _prev_aux = carry
+                    loss, aux, grads = compute_grads(state.params, mb, use_rng, state.loss_scale)
                     grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-                    return (grads_acc, loss_acc + loss), None
+                    # aux rides the carry (overwritten each microbatch) so only
+                    # one copy is live — stacking it as scan output would cost
+                    # accum_steps× the aux memory.
+                    return (grads_acc, loss_acc + loss, aux), None
 
                 def reshape(x):
                     if np.ndim(x) == 0:
@@ -597,17 +600,31 @@ class Accelerator:
 
                 micro = jax.tree_util.tree_map(reshape, batch)
                 zeros = _tree_zeros_like(state.params)
-                (grads, loss_sum), _ = jax.lax.scan(microbatch, (zeros, jnp.float32(0.0)), micro)
+                if has_aux:
+                    first_mb = jax.tree_util.tree_map(lambda x: x[0] if np.ndim(x) else x, micro)
+                    aux0 = jax.eval_shape(
+                        lambda p, mb: loss_fn(*((p, mb, use_rng) if wants_rng else (p, mb)))[1],
+                        policy.cast_to_compute(state.params), first_mb,
+                    )
+                    aux0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), aux0)
+                else:
+                    aux0 = None
+                (grads, loss_sum, aux), _ = jax.lax.scan(
+                    microbatch, (zeros, jnp.float32(0.0), aux0), micro
+                )
                 grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
                 loss = loss_sum / accum_steps
                 new_state, metrics = apply_update(state.replace(rng=rng), grads, loss)
+                if has_aux:
+                    # last microbatch's aux (e.g. final batch-norm stats)
+                    metrics["aux"] = aux
                 return new_state, metrics
 
         elif mode == "across_steps" and accum_steps > 1:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)
-                loss, _aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
+                loss, aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
                 grad_accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
                 accum_step = state.accum_step + 1
                 is_boundary = accum_step >= accum_steps
@@ -631,14 +648,18 @@ class Accelerator:
                     "grad_norm": global_norm(grads),
                     "synced": is_boundary,
                 }
+                if has_aux:
+                    metrics["aux"] = aux
                 return new_state, metrics
 
         else:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)
-                loss, _aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
+                loss, aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
                 new_state, metrics = apply_update(state.replace(rng=rng), grads, loss)
+                if has_aux:
+                    metrics["aux"] = aux
                 return new_state, metrics
 
         jitted = jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())

@@ -36,32 +36,63 @@ def extract_model_from_parallel(model: Any, keep_fp32_wrapper: bool = True) -> A
     Sharded training never wraps the model (GSPMD shards arrays, not
     modules), so everything else passes through unchanged.
     """
-    from ..parallel.pipeline_parallel import PipelinedModel
+    try:
+        from ..parallel.pipeline_parallel import PipelinedModel
+    except ImportError:  # partial build without the pipeline module
+        return model
 
     if isinstance(model, PipelinedModel):
         return model.model
     return model
 
 
+def _flatten_for_safetensors(obj):
+    import numpy as np
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(obj)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(
+            str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))) for p in path
+        )
+        out[key] = np.asarray(leaf)
+    return out
+
+
 def save(obj: Any, path: os.PathLike | str, safe_serialization: bool = True) -> None:
     """Serialize a pytree of arrays to ``path``, only on the main process
-    (reference utils/other.py:354).  Uses flax msgpack bytes — a
-    self-describing, framework-portable container."""
-    from flax import serialization
+    (reference utils/other.py:354).
 
+    ``safe_serialization=True`` writes safetensors (flat ``a/b/c`` keys, the
+    reference's safe format); ``False`` writes flax msgpack bytes, which
+    round-trip arbitrary pytree structure without a target."""
     from ..state import PartialState
 
     if not PartialState().is_main_process:
         return
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        save_file(_flatten_for_safetensors(jax.device_get(obj)), str(path))
+        return
+    from flax import serialization
+
     data = serialization.to_bytes(jax.device_get(obj))
     with open(path, "wb") as f:
         f.write(data)
 
 
 def load(path: os.PathLike | str, target: Optional[Any] = None) -> Any:
-    """Inverse of :func:`save` (reference utils/other.py:404).  With
-    ``target`` (an example pytree) the result keeps its exact structure and
-    dtypes; without it, msgpack's generic dict-of-arrays comes back."""
+    """Inverse of :func:`save` (reference utils/other.py:404).  Sniffs the
+    format (safetensors vs msgpack).  With ``target`` (an example pytree) a
+    msgpack load keeps its exact structure and dtypes; a safetensors load
+    returns the flat ``{path: array}`` dict."""
+    try:
+        from safetensors.numpy import load_file
+
+        return load_file(str(path))
+    except Exception:
+        pass
     from flax import serialization
 
     with open(path, "rb") as f:

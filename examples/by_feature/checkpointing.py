@@ -1,0 +1,56 @@
+"""Checkpoint/resume mid-training (reference examples/by_feature/checkpointing.py).
+
+Shows ``save_state``/``load_state`` with automatic checkpoint naming and
+retention, plus ``skip_first_batches`` for mid-epoch resume (SURVEY §2.8).
+"""
+
+import argparse
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, ProjectConfiguration
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main(args):
+    with tempfile.TemporaryDirectory() as project_dir:
+        acc = Accelerator(
+            project_config=ProjectConfiguration(
+                project_dir=project_dir, automatic_checkpoint_naming=True, total_limit=2
+            )
+        )
+        dl = acc.prepare(make_regression_loader(batch_size=16))
+        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.1)))
+        step = acc.prepare_train_step(regression_loss_fn)
+
+        # train 1.5 epochs, checkpointing after the first
+        for batch in dl:
+            state, metrics = step(state, batch)
+        acc.save_state(train_state=state)
+        mid_loss = float(metrics["loss"])
+
+        for i, batch in enumerate(dl):
+            state, metrics = step(state, batch)
+            if i == 1:
+                break
+
+        # resume: restore the checkpoint, fast-forward the 2 consumed batches
+        state = acc.load_state(train_state=state)
+        resumed = acc.skip_first_batches(dl, num_batches=2)
+        for batch in resumed:
+            state, metrics = step(state, batch)
+        acc.print(f"resumed fine: loss {mid_loss:.4f} -> {float(metrics['loss']):.4f}")
+        assert np.isfinite(float(metrics["loss"]))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    main(parser.parse_args())

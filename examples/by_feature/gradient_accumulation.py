@@ -1,0 +1,35 @@
+"""Gradient accumulation (reference examples/by_feature/gradient_accumulation.py).
+
+``gradient_accumulation_steps=N`` with the default ``in_step`` mode splits
+each global batch into N microbatches inside the jitted step (a ``lax.scan``)
+— the pure-functional analog of ``with accelerator.accumulate(model)``.
+"""
+
+import argparse
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main(args):
+    acc = Accelerator(gradient_accumulation_steps=args.accum_steps)
+    dl = acc.prepare(make_regression_loader(batch_size=16 * args.accum_steps))
+    state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+    step = acc.prepare_train_step(regression_loss_fn)
+
+    for epoch in range(2):
+        for batch in dl:
+            state, metrics = step(state, batch)
+        acc.print(f"epoch {epoch}: loss {float(metrics['loss']):.5f} (sync={acc.sync_gradients})")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--accum_steps", type=int, default=4)
+    main(parser.parse_args())

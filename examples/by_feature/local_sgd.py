@@ -1,0 +1,38 @@
+"""Local SGD (reference examples/by_feature/local_sgd.py).
+
+Each process trains independently; parameters are averaged across processes
+every ``local_sgd_steps`` optimizer steps — fewer collectives per step at the
+cost of slightly stale replicas (SURVEY §2.4 P13).
+"""
+
+import argparse
+
+import optax
+
+from accelerate_tpu import Accelerator, LocalSGD
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main(args):
+    acc = Accelerator()
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+    step = acc.prepare_train_step(regression_loss_fn)
+
+    with LocalSGD(accelerator=acc, local_sgd_steps=args.local_sgd_steps) as local_sgd:
+        for epoch in range(2):
+            for batch in dl:
+                state, metrics = step(state, batch)
+                state = local_sgd.step(state)
+        state = local_sgd.sync(state)
+    acc.print(f"final loss {float(metrics['loss']):.5f} (world={acc.num_processes})")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--local_sgd_steps", type=int, default=4)
+    main(parser.parse_args())

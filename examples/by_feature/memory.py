@@ -1,0 +1,47 @@
+"""OOM-adaptive batch sizing (reference examples/by_feature/memory.py).
+
+``find_executable_batch_size`` retries the wrapped function with a halved
+batch size whenever it raises an out-of-memory error (reference
+utils/memory.py:115).
+"""
+
+import argparse
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+from accelerate_tpu.utils.memory import find_executable_batch_size
+
+
+def main(args):
+    acc = Accelerator()
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def train(batch_size):
+        attempts.append(batch_size)
+        # Simulate an OOM above a capacity threshold so the halving is
+        # observable on any host; real OOMs (RESOURCE_EXHAUSTED) are caught
+        # the same way.
+        if batch_size > 32:
+            raise MemoryError(f"simulated OOM at batch size {batch_size}")
+        dl = acc.prepare(make_regression_loader(batch_size=batch_size))
+        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+        step = acc.prepare_train_step(regression_loss_fn)
+        for batch in dl:
+            state, metrics = step(state, batch)
+        return float(metrics["loss"])
+
+    loss = train()
+    acc.print(f"attempted batch sizes {attempts}; final loss {loss:.5f}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--starting_batch_size", type=int, default=128)
+    main(parser.parse_args())

@@ -1,0 +1,39 @@
+"""Profiling a training loop (reference examples/by_feature/profiler.py).
+
+``accelerator.profile`` wraps ``jax.profiler.trace`` — the trace directory
+gets an xplane/TensorBoard-compatible profile of every step inside the
+context (reference ProfileKwargs -> torch.profiler, SURVEY §2.9).
+"""
+
+import argparse
+import tempfile
+from pathlib import Path
+
+import optax
+
+from accelerate_tpu import Accelerator, ProfileKwargs
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main(args):
+    with tempfile.TemporaryDirectory() as trace_dir:
+        acc = Accelerator(kwargs_handlers=[ProfileKwargs(output_trace_dir=trace_dir)])
+        dl = acc.prepare(make_regression_loader(batch_size=16))
+        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+        step = acc.prepare_train_step(regression_loss_fn)
+
+        with acc.profile():
+            for batch in dl:
+                state, metrics = step(state, batch)
+
+        produced = list(Path(trace_dir).rglob("*"))
+        acc.print(f"profile wrote {len(produced)} artifacts to {trace_dir}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    main(parser.parse_args())

@@ -1,0 +1,48 @@
+"""Experiment tracking (reference examples/by_feature/tracking.py).
+
+``log_with="jsonl"`` uses the built-in dependency-free tracker; swap for
+"tensorboard"/"wandb"/"mlflow"/... (tracking.py backends) when available.
+"""
+
+import argparse
+import json
+import tempfile
+from pathlib import Path
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main(args):
+    with tempfile.TemporaryDirectory() as logdir:
+        acc = Accelerator(log_with="jsonl", project_dir=logdir)
+        acc.init_trackers("tracking_example", config={"lr": 0.05})
+        dl = acc.prepare(make_regression_loader(batch_size=16))
+        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+        step = acc.prepare_train_step(regression_loss_fn)
+
+        global_step = 0
+        for epoch in range(2):
+            for batch in dl:
+                state, metrics = step(state, batch)
+                acc.log({"loss": float(metrics["loss"])}, step=global_step)
+                global_step += 1
+        acc.end_training()
+
+        records = [
+            json.loads(line)
+            for f in Path(logdir).rglob("*.jsonl")
+            for line in f.read_text().splitlines()
+        ]
+        acc.print(f"logged {len(records)} records; final loss {records[-1]['loss']:.5f}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    main(parser.parse_args())

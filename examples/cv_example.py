@@ -1,0 +1,79 @@
+"""ResNet image-classification training (reference examples/cv_example.py).
+
+Synthetic images (class-dependent channel shift + noise).  Demonstrates the
+``has_aux`` train-step contract: batch-norm statistics flow back through
+``metrics["aux"]`` and are folded into the train state each step.
+
+Run::
+
+    python examples/cv_example.py
+    accelerate-tpu launch examples/cv_example.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import ResNet, ResNetConfig, make_resnet_loss_fn
+from accelerate_tpu.utils.random import set_seed
+
+
+def make_loader(n, num_classes, batch_size, seed, image_size=32):
+    import torch
+    import torch.utils.data as tud
+
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=(n,)).astype(np.int32)
+    shift = (labels[:, None, None, None].astype(np.float32) / num_classes) * 2 - 1
+    images = (rng.normal(0, 0.3, size=(n, image_size, image_size, 3)).astype(np.float32) + shift)
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"image": torch.from_numpy(images[i]), "label": int(labels[i])}
+
+    g = torch.Generator()
+    g.manual_seed(seed)
+    return tud.DataLoader(_DS(), batch_size=batch_size, shuffle=True, generator=g, drop_last=True)
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+
+    cfg = ResNetConfig.tiny()
+    model = ResNet(cfg)
+    loader = accelerator.prepare(make_loader(512, cfg.num_classes, args.batch_size, args.seed))
+
+    variables = model.init(jax.random.key(args.seed), jnp.zeros((1, 32, 32, 3)))
+    state = accelerator.create_train_state(dict(variables), optax.adam(args.lr))
+    # loss returns (loss, new_batch_stats): has_aux threads the stats out
+    step = accelerator.prepare_train_step(make_resnet_loss_fn(model), has_aux=True)
+
+    for epoch in range(args.num_epochs):
+        for batch in loader:
+            state, metrics = step(state, batch)
+            # fold the updated batch-norm statistics back into the state
+            state = state.replace(params={**state.params, "batch_stats": metrics["aux"]})
+        accelerator.print(f"epoch {epoch}: loss {float(metrics['loss']):.4f}")
+    return float(metrics["loss"])
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

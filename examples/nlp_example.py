@@ -1,0 +1,110 @@
+"""BERT-class sequence-classification training — the flagship example
+(reference examples/nlp_example.py: BERT on GLUE/MRPC; SURVEY §7 step 3
+end-to-end target #1).
+
+The training loop is the 5-line adoption contract: build the Accelerator,
+``prepare`` the dataloader/optimizer, compile the train step, iterate.  Data
+is synthetic (this environment has no network): sentence pairs where the
+label says whether the pair shares a "signal" token — linearly separable from
+token presence, so the tiny BERT converges in a couple of epochs.
+
+Run::
+
+    python examples/nlp_example.py                        # current platform
+    accelerate-tpu launch examples/nlp_example.py         # via launcher
+    accelerate-tpu launch --num_processes 2 --cpu \
+        --num_cpu_devices 2 examples/nlp_example.py       # 2-process CPU
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification, make_bert_loss_fn
+from accelerate_tpu.utils.random import set_seed
+
+SIGNAL_TOKEN = 7
+
+
+def make_dataset(n: int, seq_len: int, vocab: int, seed: int):
+    """Classification toy data: label 1 iff SIGNAL_TOKEN appears (planted at
+    a few random positions so attention can find it from anywhere)."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(8, vocab, size=(n, seq_len)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    for row in np.nonzero(labels == 1)[0]:
+        pos = rng.choice(seq_len, size=3, replace=False)
+        ids[row, pos] = SIGNAL_TOKEN
+    return ids, labels
+
+
+def make_loader(ids, labels, batch_size, shuffle, seed=0):
+    import torch
+    import torch.utils.data as tud
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return len(labels)
+
+        def __getitem__(self, i):
+            return {"input_ids": torch.from_numpy(ids[i]), "labels": int(labels[i])}
+
+    g = torch.Generator()
+    g.manual_seed(seed)
+    return tud.DataLoader(_DS(), batch_size=batch_size, shuffle=shuffle, generator=g, drop_last=True)
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(mixed_precision=args.mixed_precision)
+
+    cfg = BertConfig.tiny(vocab_size=128)
+    model = BertForSequenceClassification(cfg)
+
+    ids, labels = make_dataset(1024, seq_len=32, vocab=cfg.vocab_size, seed=args.seed)
+    eval_ids, eval_labels = make_dataset(128, seq_len=32, vocab=cfg.vocab_size, seed=args.seed + 1)
+    train_dl = accelerator.prepare(make_loader(ids, labels, args.batch_size, shuffle=True))
+    eval_dl = accelerator.prepare(make_loader(eval_ids, eval_labels, args.batch_size, shuffle=False))
+
+    sample = jnp.zeros((2, 32), jnp.int32)
+    params = model.init(jax.random.key(args.seed), sample)
+    state = accelerator.create_train_state(
+        params, optax.adamw(args.lr), apply_fn=model.apply
+    )
+    train_step = accelerator.prepare_train_step(make_bert_loss_fn(model), max_grad_norm=1.0)
+    eval_step = accelerator.prepare_eval_step(
+        lambda p, batch: jnp.argmax(model.apply(p, batch["input_ids"]), -1)
+    )
+
+    for epoch in range(args.num_epochs):
+        for batch in train_dl:
+            state, metrics = train_step(state, batch)
+        correct = total = 0
+        for batch in eval_dl:
+            preds = eval_step(state.params, batch)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += len(np.asarray(refs))
+        accelerator.print(
+            f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
+            f"accuracy {correct / max(total, 1):.3f}"
+        )
+    return correct / max(total, 1)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=42)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

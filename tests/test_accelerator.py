@@ -271,3 +271,34 @@ def test_set_and_check_trigger():
     acc.set_trigger()
     assert acc.check_trigger()
     assert not acc.check_trigger()  # reset after firing
+
+
+def test_train_step_has_aux_simple():
+    """Aux from the loss (e.g. batch-norm stats) reaches metrics['aux']."""
+    acc = Accelerator()
+    state = acc.create_train_state(regression_init_params(), optax.sgd(0.1))
+
+    def loss_fn(params, batch):
+        pred = params["a"] * batch["x"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2), {"pred_mean": jnp.mean(pred)}
+
+    step = acc.prepare_train_step(loss_fn, has_aux=True)
+    batch = {"x": jnp.ones(8), "y": jnp.full(8, 5.0)}
+    state, metrics = step(state, batch)
+    assert "aux" in metrics and np.isfinite(float(metrics["aux"]["pred_mean"]))
+
+
+def test_train_step_has_aux_with_accumulation():
+    """Aux rides the microbatch scan carry: last microbatch's aux returned."""
+    acc = Accelerator(gradient_accumulation_steps=4)
+    state = acc.create_train_state(regression_init_params(), optax.sgd(0.1))
+
+    def loss_fn(params, batch):
+        pred = params["a"] * batch["x"] + params["b"]
+        # aux identifies the microbatch so the test can assert "last wins"
+        return jnp.mean((pred - batch["y"]) ** 2), {"x_first": batch["x"][0]}
+
+    step = acc.prepare_train_step(loss_fn, has_aux=True)
+    x = jnp.arange(16.0)  # microbatches of 4: last starts at 12
+    state, metrics = step(state, {"x": x, "y": jnp.zeros(16)})
+    assert float(metrics["aux"]["x_first"]) == 12.0

@@ -99,16 +99,26 @@ def test_unwrap_model_delegates_to_extract():
     assert acc.unwrap_model("plain") == "plain"
 
 
-def test_save_load_roundtrip(tmp_path):
+def test_save_load_roundtrip_msgpack(tmp_path):
     tree = {"a": jnp.arange(6.0).reshape(2, 3), "b": {"c": jnp.ones((4,), jnp.bfloat16)}}
     path = tmp_path / "tree.msgpack"
-    save(tree, path)
+    save(tree, path, safe_serialization=False)
     restored = load(path, target=tree)
     assert restored["b"]["c"].dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(restored["a"]), np.asarray(tree["a"]))
     # structural load without target
     raw = load(path)
     assert "a" in raw and "b" in raw
+
+
+def test_save_load_roundtrip_safetensors(tmp_path):
+    tree = {"a": jnp.arange(6.0).reshape(2, 3), "b": {"c": jnp.ones((4,), jnp.bfloat16)}}
+    path = tmp_path / "tree.safetensors"
+    save(tree, path)  # safe_serialization=True default
+    flat = load(path)
+    assert set(flat) == {"a", "b/c"}
+    np.testing.assert_allclose(flat["a"], np.asarray(tree["a"]))
+    assert flat["b/c"].dtype == np.dtype("bfloat16") or str(flat["b/c"].dtype) == "bfloat16"
 
 
 def test_extract_model_passthrough_and_unwrap():
