@@ -64,3 +64,12 @@ try:
     from .utils.other import extract_model_from_parallel
 except ImportError:  # pragma: no cover
     pass
+try:
+    from .utils.quantization import (
+        QuantizationConfig,
+        load_and_quantize_model,
+        quantize_params,
+        quantized_apply,
+    )
+except ImportError:  # pragma: no cover
+    pass

@@ -172,6 +172,12 @@ class Accelerator:
             )
         self.gradient_state = GradientState(gradient_accumulation_plugin=gradient_accumulation_plugin)
 
+        if parallelism_config is not None:
+            # Validate + build the mesh eagerly: a mis-sized config must fail
+            # at construction, not at first .mesh access (reference
+            # _validate_accelerator parallelism_config.py:355).
+            self.state.mesh
+
         self.fsdp_plugin = fsdp_plugin
         self.tp_config = tp_config
         self.cp_config = cp_config
@@ -410,6 +416,7 @@ class Accelerator:
             mesh=self.mesh,
             batch_spec=self._default_batch_spec(),
             parallelism_config=self.parallelism_config,
+            prefetch_size=dlc.prefetch_size,
         )
         self._dataloaders.append(prepared)
         return prepared

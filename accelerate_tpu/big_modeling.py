@@ -38,7 +38,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .logging import get_logger
-from .utils.imports import is_safetensors_available
 
 logger = get_logger(__name__)
 
@@ -328,11 +327,11 @@ def _iter_checkpoint_tensors(checkpoint_path: Union[str, os.PathLike]):
         files = [p]
     for f in files:
         if f.suffix == ".safetensors":
-            from safetensors import safe_open
+            from .utils.serialization import LazySafetensorsFile
 
-            with safe_open(str(f), framework="numpy") as sf:
-                for name in sf.keys():
-                    yield name, sf.get_tensor(name)
+            sf = LazySafetensorsFile(str(f))
+            for name in sf.keys():
+                yield name, sf.get(name)
         elif f.suffix == ".npz":
             data = np.load(f)
             for name in data.files:

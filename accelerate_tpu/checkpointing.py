@@ -36,7 +36,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from .utils.imports import is_safetensors_available, is_torch_available
+from .utils.imports import is_torch_available
 
 MODEL_NAME = "model"
 TRAIN_STATE_DIR = "train_state"
@@ -320,18 +320,18 @@ def save_model(accelerator, train_state_or_params, save_directory: str,
         return []
 
     written = []
-    if safe_serialization and is_safetensors_available():
-        from safetensors.numpy import save_file
+    if safe_serialization:
+        from .utils.serialization import save_safetensors
 
         if len(shards) == 1:
             path = save_dir / "model.safetensors"
-            save_file({k: np.ascontiguousarray(v) for k, v in shards[0].items()}, str(path))
+            save_safetensors(str(path), shards[0])
             written.append(str(path))
         else:
             index = {"metadata": {"total_size": sum(sizes)}, "weight_map": {}}
             for i, shard in enumerate(shards):
                 name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
-                save_file({k: np.ascontiguousarray(v) for k, v in shard.items()}, str(save_dir / name))
+                save_safetensors(str(save_dir / name), shard)
                 for k in shard:
                     index["weight_map"][k] = name
                 written.append(str(save_dir / name))
@@ -350,15 +350,15 @@ def load_model_params(save_directory: str):
     flat: dict[str, np.ndarray] = {}
     index_file = save_dir / "model.safetensors.index.json"
     if index_file.exists():
-        from safetensors.numpy import load_file
+        from .utils.serialization import load_safetensors
 
         index = json.loads(index_file.read_text())
         for name in sorted(set(index["weight_map"].values())):
-            flat.update(load_file(str(save_dir / name)))
+            flat.update(load_safetensors(str(save_dir / name)))
     elif (save_dir / "model.safetensors").exists():
-        from safetensors.numpy import load_file
+        from .utils.serialization import load_safetensors
 
-        flat = load_file(str(save_dir / "model.safetensors"))
+        flat = load_safetensors(str(save_dir / "model.safetensors"))
     elif (save_dir / "model.npz").exists():
         flat = dict(np.load(save_dir / "model.npz"))
     else:
@@ -380,11 +380,11 @@ def merge_weights(checkpoint_dir: str, output_dir: str, safe_serialization: bool
     }
     out = Path(output_dir)
     out.mkdir(parents=True, exist_ok=True)
-    if safe_serialization and is_safetensors_available():
-        from safetensors.numpy import save_file
+    if safe_serialization:
+        from .utils.serialization import save_safetensors
 
         path = out / "model.safetensors"
-        save_file({k: np.ascontiguousarray(v) for k, v in arrays.items()}, str(path))
+        save_safetensors(str(path), arrays)
     else:
         path = out / "model.npz"
         np.savez(path, **arrays)

@@ -69,6 +69,152 @@ def _to_numpy(batch):
     return recursively_apply(_conv, batch, test_type=_is_leaf)
 
 
+def _native_prefetch_available() -> bool:
+    from . import native
+
+    return native.is_available()
+
+
+class _RingPrefetcher:
+    """Background host-staging pipeline over the native staging ring.
+
+    A producer thread pulls batches from the inner iterable, converts them to
+    numpy, and copies the bytes into an aligned slot of the native ring
+    (native/src/ring.cc) — large numpy copies release the GIL, so staging
+    overlaps the main thread's device feeding.  The consumer side rebuilds
+    zero-copy views over the slot, runs the device put, waits for the
+    transfer, and recycles the slot.  This is the in-tree analog of the
+    torch DataLoader worker + ``MpDeviceLoader`` background-transfer pair the
+    reference leans on (reference data_loader.py:654, :567-583).
+
+    Batches that do not fit a slot ride the descriptor queue directly (rare;
+    slot size is derived from the first batch with headroom).
+    """
+
+    _ALIGN = 64
+
+    def __init__(self, inner: Iterable, device_put: Callable, depth: int = 2):
+        import queue as _queue
+
+        from . import native
+
+        self.inner = inner
+        self.device_put = device_put
+        self.depth = max(2, depth)
+        self._native = native
+        self._queue: _queue.Queue = _queue.Queue(maxsize=self.depth + 1)
+        self._ring = None
+        self._closed = False
+        self._thread = None
+
+    # -- producer -----------------------------------------------------------
+
+    def _stage(self, batch) -> tuple:
+        """Copy a numpy batch pytree into a ring slot; returns a descriptor.
+
+        Descriptor kinds: ("ring", treedef, specs) — bytes staged in FIFO
+        slot order; ("raw", treedef, leaves) — oversized batch carried
+        directly; ("err", exc) / None — error / end of stream.
+        """
+        raw_leaves, treedef = jax.tree_util.tree_flatten(batch)
+        leaves = [np.ascontiguousarray(leaf) for leaf in raw_leaves]
+        specs, offset = [], 0
+        for leaf in leaves:
+            specs.append((offset, leaf.dtype, leaf.shape, leaf.nbytes))
+            offset += -(-leaf.nbytes // self._ALIGN) * self._ALIGN
+        if self._ring is None:
+            slot_bytes = max(int(offset * 1.5), 1 << 20)
+            self._ring = self._native.StagingRing(self.depth, slot_bytes)
+        if offset > self._ring.slot_bytes:
+            return ("raw", treedef, leaves)
+        slot = self._ring.acquire()
+        if slot is None:  # closed under us
+            return None
+        for leaf, (off, dtype, shape, nbytes) in zip(leaves, specs):
+            if nbytes:
+                np.copyto(slot[off : off + nbytes].view(dtype).reshape(shape), leaf)
+        self._ring.commit(slot, offset)
+        return ("ring", treedef, specs)
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the consumer is gone."""
+        import queue as _queue
+
+        while not self._closed:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _produce(self):
+        try:
+            for batch in self.inner:
+                if self._closed:
+                    return
+                desc = self._stage(_to_numpy(batch))
+                if desc is None or not self._put(desc):
+                    return
+            self._put(None)
+        except BaseException as e:  # noqa: BLE001 — surface in consumer
+            self._put(("err", e))
+
+    # -- consumer -----------------------------------------------------------
+
+    def __iter__(self):
+        import threading
+
+        self._thread = threading.Thread(target=self._produce, daemon=True, name="at-prefetch")
+        self._thread.start()
+        try:
+            while True:
+                desc = self._queue.get()
+                if desc is None:
+                    return
+                kind = desc[0]
+                if kind == "err":
+                    raise desc[1]
+                if kind == "raw":
+                    _, treedef, leaves = desc
+                    yield self.device_put(jax.tree_util.tree_unflatten(treedef, leaves))
+                    continue
+                _, treedef, specs = desc
+                view = self._ring.pop()
+                if view is None:
+                    return
+                leaves = [
+                    view[off : off + nbytes].view(dtype).reshape(shape)
+                    for off, dtype, shape, nbytes in specs
+                ]
+                if jax.default_backend() == "cpu":
+                    # CPU jax zero-copies aligned host buffers into Arrays —
+                    # those must not alias a recycled slot
+                    leaves = [np.array(leaf) for leaf in leaves]
+                out = self.device_put(jax.tree_util.tree_unflatten(treedef, leaves))
+                # the slot is recycled next acquire — make sure the H2D copy
+                # is finished before handing it back
+                jax.block_until_ready(out)
+                self._ring.release(view)
+                yield out
+        finally:
+            self.close()
+
+    def close(self):
+        self._closed = True
+        if self._ring is not None:
+            self._ring.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # producer stuck (e.g. slow dataset read): leak the ring
+                # rather than free memory the thread may still touch
+                return
+        if self._ring is not None:
+            self._ring.destroy()
+            self._ring = None
+
+
 class SeedableRandomSampler:
     """Deterministic shuffling reseeded per epoch with ``seed + epoch``
     (reference SeedableRandomSampler data_loader.py:73-107)."""
@@ -321,6 +467,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         synchronized_generator=None,
         skip_batches: int = 0,
         put_on_device: bool = True,
+        prefetch_size: int = 0,
         _non_blocking: bool = True,
         _loader_batch_size: Optional[int] = None,
     ):
@@ -332,6 +479,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.synchronized_generator = synchronized_generator
         self.skip_batches = skip_batches
         self.put_on_device = put_on_device
+        self.prefetch_size = prefetch_size
         self.gradient_state = GradientState()
         self.iteration = 0
         self._loader_batch_size = _loader_batch_size
@@ -353,16 +501,28 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.begin()
         if hasattr(self.inner, "set_epoch"):
             self.inner.set_epoch(self.iteration)
+        prefetcher = None
         try:
-            iterator = iter(self.inner)
+            # source yields device-placed batches.  With prefetch_size >= 2
+            # and the native runtime built, a background thread stages batch
+            # bytes through the native ring while we feed the device;
+            # otherwise plain in-line conversion (jax dispatch is async, so
+            # the one-batch lookahead below still overlaps H2D with compute).
+            if self.prefetch_size >= 2 and self.put_on_device and _native_prefetch_available():
+                prefetcher = _RingPrefetcher(
+                    self.inner, self._device_put_batch, self.prefetch_size
+                )
+                source = iter(prefetcher)
+            else:
+                source = (self._device_put_batch(b) for b in iter(self.inner))
             # one-batch lookahead: current batch transfers H2D while the
-            # previous one is being consumed (jax dispatch is async)
+            # previous one is being consumed
             batch_idx = 0
             current = None
             have_current = False
             while True:
                 try:
-                    nxt = next(iterator)
+                    nxt = next(source)
                 except StopIteration:
                     break
                 if have_current:
@@ -371,7 +531,7 @@ class DataLoaderShard(DataLoaderStateMixin):
                         # batches already handed out even mid-iteration
                         self._batches_yielded += 1
                         yield current
-                current = self._device_put_batch(nxt)
+                current = nxt
                 have_current = True
                 batch_idx += 1
             if have_current:
@@ -380,6 +540,8 @@ class DataLoaderShard(DataLoaderStateMixin):
                     self._batches_yielded += 1
                     yield current
         finally:
+            if prefetcher is not None:
+                prefetcher.close()
             self.iteration += 1
             self.end()
 
@@ -548,6 +710,7 @@ def prepare_data_loader(
     mesh: Optional[Mesh] = None,
     batch_spec: Optional[PartitionSpec] = None,
     parallelism_config=None,
+    prefetch_size: int = 0,
 ):
     """Re-wrap a dataloader (torch DataLoader or any batch iterable) for
     per-rank sharding + global-array device placement.
@@ -576,6 +739,13 @@ def prepare_data_loader(
         dispatch_batches = is_iterable and put_on_device
 
     if dispatch_batches:
+        if prefetch_size >= 2:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "prefetch_size is not supported in dispatch mode (rank-0 reads + "
+                "broadcast can't stage ahead through the ring) — ignoring it"
+            )
         return DataLoaderDispatcher(
             dataloader,
             split_batches=split_batches,
@@ -644,6 +814,7 @@ def prepare_data_loader(
         rng_types=rng_types,
         synchronized_generator=synchronized_generator,
         put_on_device=put_on_device,
+        prefetch_size=prefetch_size,
         _non_blocking=non_blocking,
         _loader_batch_size=loader_batch_size,
     )

@@ -14,3 +14,4 @@ from .mixtral import (
     make_mixtral_loss_fn,
 )
 from .resnet import ResNet, ResNetConfig, make_resnet_loss_fn
+from .t5 import T5Config, T5ForConditionalGeneration, make_t5_loss_fn

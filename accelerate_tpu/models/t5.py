@@ -1,0 +1,244 @@
+"""T5-class encoder-decoder for seq2seq training.
+
+Completes the BERT/GPT/T5 model-family trio the reference trains through
+Megatron (reference utils/megatron_lm.py BertTrainStep :432 / GPTTrainStep
+:574 / T5TrainStep :718); here all three share one GSPMD train-step path.
+
+TPU-first notes:
+- RMS layer norm (T5's variance-only norm) reused from the Llama stack.
+- Relative position bias: learned buckets, computed once per stack and shared
+  by every layer (T5 semantics), added to attention scores pre-softmax.
+- Parameter names (``q_proj/k_proj/v_proj/o_proj``, ``wi_gate/wi_up/wo``)
+  line up with the TP rule table (parallel/sharding.py TRANSFORMER_TP_RULES)
+  so tensor parallelism stays pure sharding annotation.
+- bf16 compute / fp32 params via the Accelerator policy, like the other
+  families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import RMSNorm
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64
+    d_ff: int = 2048
+    num_layers: int = 6
+    num_decoder_layers: int = 6
+    num_heads: int = 8
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    layer_norm_epsilon: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, d_model=64, d_kv=16, d_ff=128,
+            num_layers=2, num_decoder_layers=2, num_heads=4,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def t5_base(cls, **kw):
+        defaults = dict(d_model=768, d_ff=3072, num_layers=12, num_decoder_layers=12, num_heads=12)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def relative_position_bucket(
+    relative_position, bidirectional: bool, num_buckets: int, max_distance: int
+):
+    """T5 relative-position bucketing (log-spaced beyond ``max_exact``)."""
+    bucket = 0
+    if bidirectional:
+        num_buckets //= 2
+        bucket += (relative_position > 0).astype(jnp.int32) * num_buckets
+        rel = jnp.abs(relative_position)
+    else:
+        rel = -jnp.minimum(relative_position, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    large = max_exact + (
+        jnp.log(rel.astype(jnp.float32) / max_exact + 1e-6)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return bucket + jnp.where(is_small, rel, large)
+
+
+class RelativePositionBias(nn.Module):
+    """Learned bucketed position bias, one table per stack (T5 shares the
+    layer-0 bias across layers)."""
+
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_len: int, k_len: int):
+        cfg = self.config
+        table = self.param(
+            "rel_embedding", nn.initializers.normal(0.02),
+            (cfg.relative_attention_num_buckets, cfg.num_heads), jnp.float32,
+        )
+        ctx = jnp.arange(q_len)[:, None]
+        mem = jnp.arange(k_len)[None, :]
+        buckets = relative_position_bucket(
+            mem - ctx, self.bidirectional,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        return table[buckets].transpose(2, 0, 1)[None]  # [1, H, Tq, Tk]
+
+
+class T5Attention(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, kv=None, bias=None, causal: bool = False, kv_mask=None):
+        cfg = self.config
+        inner = cfg.num_heads * cfg.d_kv
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        kv = x if kv is None else kv
+        b, tq, _ = x.shape
+        tk = kv.shape[1]
+        q = dense(inner, name="q_proj")(x).reshape(b, tq, cfg.num_heads, cfg.d_kv)
+        k = dense(inner, name="k_proj")(kv).reshape(b, tk, cfg.num_heads, cfg.d_kv)
+        v = dense(inner, name="v_proj")(kv).reshape(b, tk, cfg.num_heads, cfg.d_kv)
+
+        # T5 scales neither q nor scores (the learned bias absorbs scale)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        if bias is not None:
+            scores = scores + bias
+        if causal:
+            scores = jnp.where(
+                jnp.tril(jnp.ones((tq, tk), bool))[None, None], scores, -1e30
+            )
+        if kv_mask is not None:
+            scores = jnp.where(kv_mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, tq, inner)
+        return dense(cfg.d_model, name="o_proj")(out)
+
+
+class T5FeedForward(nn.Module):
+    """Gated-GELU feed-forward (T5 v1.1)."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        gate = nn.gelu(dense(cfg.d_ff, name="wi_gate")(x))
+        up = dense(cfg.d_ff, name="wi_up")(x)
+        return dense(cfg.d_model, name="wo_mlp")(gate * up)
+
+
+class T5EncoderLayer(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias, mask=None):
+        cfg = self.config
+        norm = partial(RMSNorm, cfg.layer_norm_epsilon, cfg.dtype)
+        x = x + T5Attention(cfg, name="self_attn")(norm(name="ln_attn")(x), bias=bias, kv_mask=mask)
+        x = x + T5FeedForward(cfg, name="mlp")(norm(name="ln_mlp")(x))
+        return x
+
+
+class T5DecoderLayer(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc, bias, enc_mask=None):
+        cfg = self.config
+        norm = partial(RMSNorm, cfg.layer_norm_epsilon, cfg.dtype)
+        x = x + T5Attention(cfg, name="self_attn")(
+            norm(name="ln_self")(x), bias=bias, causal=True
+        )
+        x = x + T5Attention(cfg, name="cross_attn")(
+            norm(name="ln_cross")(x), kv=enc, kv_mask=enc_mask
+        )
+        x = x + T5FeedForward(cfg, name="mlp")(norm(name="ln_mlp")(x))
+        return x
+
+
+class T5ForConditionalGeneration(nn.Module):
+    """``__call__(input_ids, decoder_input_ids, attention_mask) -> logits``."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+        cfg = self.config
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name="shared_embedding",
+        )
+
+        # encoder
+        x = embed(input_ids)
+        enc_bias = RelativePositionBias(cfg, bidirectional=True, name="enc_rel_bias")(
+            input_ids.shape[1], input_ids.shape[1]
+        )
+        for i in range(cfg.num_layers):
+            x = T5EncoderLayer(cfg, name=f"enc_layers_{i}")(x, enc_bias, attention_mask)
+        enc = RMSNorm(cfg.layer_norm_epsilon, cfg.dtype, name="enc_norm")(x)
+
+        # decoder
+        y = embed(decoder_input_ids)
+        dec_bias = RelativePositionBias(cfg, bidirectional=False, name="dec_rel_bias")(
+            decoder_input_ids.shape[1], decoder_input_ids.shape[1]
+        )
+        for i in range(cfg.num_decoder_layers):
+            y = T5DecoderLayer(cfg, name=f"dec_layers_{i}")(y, enc, dec_bias, attention_mask)
+        y = RMSNorm(cfg.layer_norm_epsilon, cfg.dtype, name="dec_norm")(y)
+
+        # tied head with T5's rescaling
+        y = y * (cfg.d_model ** -0.5)
+        return embed.attend(y.astype(jnp.float32))
+
+
+def shift_right(labels, decoder_start_token_id: int = 0, pad_token_id: int = 0):
+    """Teacher-forcing decoder inputs: labels shifted right (transformers
+    ``_shift_right`` semantics; -100 ignore positions become pad)."""
+    labels = jnp.where(labels == -100, pad_token_id, labels)
+    return jnp.concatenate(
+        [jnp.full_like(labels[:, :1], decoder_start_token_id), labels[:, :-1]], axis=1
+    )
+
+
+def seq2seq_loss(logits, labels, ignore_index: int = -100):
+    mask = labels != ignore_index
+    safe = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def make_t5_loss_fn(model: T5ForConditionalGeneration):
+    def loss_fn(params, batch):
+        decoder_input_ids = batch.get("decoder_input_ids")
+        if decoder_input_ids is None:
+            decoder_input_ids = shift_right(batch["labels"])
+        logits = model.apply(
+            params, batch["input_ids"], decoder_input_ids,
+            attention_mask=batch.get("attention_mask"),
+        )
+        return seq2seq_loss(logits, batch["labels"])
+
+    return loss_fn

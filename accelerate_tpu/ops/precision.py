@@ -230,3 +230,53 @@ def fp8_dot(
     new_w_meta = w_meta.updated(amax_w, E4M3_MAX)
     out = _dot(x, w, new_x_meta.scale, new_w_meta.scale)
     return out, (new_x_meta, new_w_meta)
+
+
+# ---------------------------------------------------------------------------
+# layerwise casting (reference attach_layerwise_casting_hooks
+# big_modeling.py:654: per-module storage dtype vs compute dtype)
+# ---------------------------------------------------------------------------
+
+
+def layerwise_casting(
+    params,
+    storage_dtype=jnp.float8_e4m3fn,
+    compute_dtype=jnp.bfloat16,
+    skip_patterns: tuple = ("norm", "embed", "bias", "scale"),
+):
+    """Shrink parameter storage per-leaf while keeping compute precision.
+
+    The reference walks modules attaching pre/post-forward casting hooks; on
+    TPU the same capability is a pytree map: matching floating leaves are
+    stored in ``storage_dtype`` (e.g. fp8 — half the HBM footprint of bf16)
+    and :func:`layerwise_cast_apply` upcasts them to ``compute_dtype``
+    *inside* jit, where XLA fuses the cast into the consuming op.
+
+    Returns ``(cast_params, apply_wrapper)``.
+    """
+    import re
+
+    from ..parallel.sharding import path_str
+
+    def _store(path, leaf):
+        if not (hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            return leaf
+        if any(re.search(p, path_str(path).lower()) for p in skip_patterns):
+            return leaf
+        return leaf.astype(storage_dtype)
+
+    cast_params = jax.tree_util.tree_map_with_path(_store, params)
+
+    def apply_wrapper(apply_fn):
+        def wrapped(p, *args, **kwargs):
+            upcast = jax.tree_util.tree_map(
+                lambda x: x.astype(compute_dtype)
+                if hasattr(x, "dtype") and x.dtype == jnp.dtype(storage_dtype)
+                else x,
+                p,
+            )
+            return apply_fn(upcast, *args, **kwargs)
+
+        return wrapped
+
+    return cast_params, apply_wrapper

@@ -195,7 +195,7 @@ TRANSFORMER_TP_RULES: list[tuple[str, PartitionSpec]] = [
     (r"(embed_tokens|embedding|wte|word_embeddings)/embedding$", PartitionSpec("tp", None)),
     (r"(q_proj|k_proj|v_proj|query|key|value|wq|wk|wv|in_proj|qkv)/kernel$", PartitionSpec(None, "tp")),
     (r"(o_proj|out_proj|wo|dense(?!_4h)|attn_out)/kernel$", PartitionSpec("tp", None)),
-    (r"(gate_proj|up_proj|wi|w1|w3|fc1|dense_h_to_4h|c_fc)/kernel$", PartitionSpec(None, "tp")),
+    (r"(gate_proj|up_proj|wi|wi_gate|wi_up|w1|w3|fc1|dense_h_to_4h|c_fc)/kernel$", PartitionSpec(None, "tp")),
     (r"(down_proj|wo_mlp|w2|fc2|dense_4h_to_h|c_proj)/kernel$", PartitionSpec("tp", None)),
     (r"(lm_head|output|score)/kernel$", PartitionSpec(None, "tp")),
 ]

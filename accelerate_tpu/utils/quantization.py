@@ -1,0 +1,270 @@
+"""Weight-only quantized loading — the bitsandbytes-capability analog.
+
+The reference loads 4/8-bit models through bitsandbytes
+(``BnbQuantizationConfig`` reference dataclasses.py:3025,
+``load_and_quantize_model`` utils/bnb.py:~50): weights quantize as checkpoint
+shards stream in, norm/embedding-class modules stay in high precision, and
+matmuls dequantize on the fly.
+
+TPU-native design: quantized weights are first-class **pytree leaves** — a
+:class:`QuantizedTensor` node holding the packed codes + blockwise scales —
+so they flow through ``jit``/sharding like any other param.  Dequantization
+happens *inside* the compiled step (``dequantize`` is jit-traceable; XLA
+fuses the ``codes * scale`` expand into the consuming matmul), which is the
+part that matters on TPU: weight HBM traffic drops 2-4x while the MXU still
+sees bf16 operands.
+
+Schemes:
+- ``int8``  — blockwise absmax: ``w ≈ scale * q`` with ``q ∈ [-127, 127]``.
+- ``nf4``   — 4-bit NormalFloat (QLoRA codebook): blockwise absmax scaling to
+  [-1, 1], nearest-code lookup, two codes packed per byte.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# QLoRA NF4 codebook: the 16 quantiles of a standard normal, normalized to
+# [-1, 1] (public constants from the QLoRA paper).
+NF4_CODE = np.array(
+    [
+        -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+        -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+        0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+        0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+        0.7229568362236023, 1.0,
+    ],
+    np.float32,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizationConfig:
+    """reference BnbQuantizationConfig dataclasses.py:3025 capability surface."""
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    block_size: int = 64
+    compute_dtype: Any = jnp.bfloat16
+    # leaves whose path matches any pattern stay unquantized (reference
+    # keep_in_fp32_modules / skip_modules)
+    skip_patterns: tuple = ("embed", "norm", "bias", "scale", "lm_head")
+    # only quantize matrices at least this big (small leaves aren't worth it)
+    min_size: int = 4096
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("pick one of load_in_8bit / load_in_4bit")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("one of load_in_8bit / load_in_4bit must be set")
+
+    @property
+    def scheme(self) -> str:
+        return "int8" if self.load_in_8bit else "nf4"
+
+    def should_quantize(self, path: str, arr) -> bool:
+        # attribute checks only — never np.asarray here (that would force a
+        # full D2H transfer per leaf just to inspect metadata)
+        if getattr(arr, "ndim", 0) < 2 or getattr(arr, "size", 0) < self.min_size:
+            return False
+        try:
+            if not jnp.issubdtype(arr.dtype, jnp.floating):
+                return False
+        except (TypeError, AttributeError):
+            return False
+        low = path.lower()
+        return not any(re.search(p, low) for p in self.skip_patterns)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """Packed codes + blockwise scales; a jit-traversable pytree node.
+
+    ``shape``/``dtype`` mimic the dequantized array so sharding planners can
+    treat it like the original weight.
+    """
+
+    def __init__(self, data, scale, shape, dtype, scheme: str, block_size: int):
+        self.data = data          # int8 [n_blocks, block] or uint8 packed nf4
+        self.scale = scale        # f32 [n_blocks, 1]
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.scheme = scheme
+        self.block_size = block_size
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    def tree_flatten(self):
+        return (self.data, self.scale), (self.shape, self.dtype, self.scheme, self.block_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, scale = children
+        return cls(data, scale, *aux)
+
+    def __repr__(self):
+        return f"QuantizedTensor({self.scheme}, shape={self.shape}, block={self.block_size})"
+
+
+def is_quantized(x) -> bool:
+    return isinstance(x, QuantizedTensor)
+
+
+# ---------------------------------------------------------------------------
+# quantize (host-side, numpy) — runs while checkpoint shards stream in
+# ---------------------------------------------------------------------------
+
+
+def _blockify(arr: np.ndarray, block: int) -> tuple[np.ndarray, int]:
+    flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+    pad = -len(flat) % block
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    return flat.reshape(-1, block), pad
+
+
+def quantize(arr, config: QuantizationConfig) -> QuantizedTensor:
+    np_arr = np.asarray(jax.device_get(arr) if isinstance(arr, jax.Array) else arr)
+    orig_dtype = np_arr.dtype
+    blocks, _ = _blockify(np_arr.astype(np.float32), config.block_size)
+    absmax = np.abs(blocks).max(axis=1, keepdims=True)
+    absmax = np.where(absmax == 0, 1.0, absmax)
+    if config.scheme == "int8":
+        scale = absmax / 127.0
+        q = np.clip(np.round(blocks / scale), -127, 127).astype(np.int8)
+        return QuantizedTensor(q, scale.astype(np.float32), np_arr.shape, orig_dtype,
+                               "int8", config.block_size)
+    # nf4: scale to [-1,1], nearest codebook entry, pack two per byte
+    norm = blocks / absmax
+    codes = np.abs(norm[..., None] - NF4_CODE).argmin(axis=-1).astype(np.uint8)
+    lo, hi = codes[:, 0::2], codes[:, 1::2]
+    packed = (hi << 4 | lo).astype(np.uint8)
+    return QuantizedTensor(packed, absmax.astype(np.float32), np_arr.shape, orig_dtype,
+                           "nf4", config.block_size)
+
+
+# ---------------------------------------------------------------------------
+# dequantize (jit-traceable) — fused by XLA into the consuming matmul
+# ---------------------------------------------------------------------------
+
+
+def dequantize(qt: QuantizedTensor, dtype=None):
+    if not is_quantized(qt):
+        return qt
+    out_dtype = dtype or qt.dtype
+    n = int(np.prod(qt.shape)) if qt.shape else 1
+    if qt.scheme == "int8":
+        vals = qt.data.astype(jnp.float32) * qt.scale
+    else:  # nf4
+        code = jnp.asarray(NF4_CODE)
+        lo = code[(qt.data & 0x0F).astype(jnp.int32)]
+        hi = code[(qt.data >> 4).astype(jnp.int32)]
+        # interleave back: block positions 0,2,4... were lo, 1,3,5... hi
+        vals = jnp.stack([lo, hi], axis=-1).reshape(qt.data.shape[0], -1) * qt.scale
+    return vals.reshape(-1)[:n].reshape(qt.shape).astype(out_dtype)
+
+
+def dequantize_tree(params, dtype=None):
+    """Dequantize every :class:`QuantizedTensor` leaf (inside jit this is
+    where XLA fuses the expansion into consumers)."""
+    return jax.tree_util.tree_map(
+        lambda x: dequantize(x, dtype) if is_quantized(x) else x,
+        params,
+        is_leaf=is_quantized,
+    )
+
+
+def quantized_apply(apply_fn: Callable, dtype=None) -> Callable:
+    """Wrap a model ``apply`` so quantized param trees dequantize in-step:
+    ``model.apply`` → ``quantized_apply(model.apply)`` is the whole
+    integration (the linear-module-swap dance of the reference's bnb path
+    collapses to a pytree map under jit)."""
+
+    def wrapped(params, *args, **kwargs):
+        return apply_fn(dequantize_tree(params, dtype), *args, **kwargs)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# tree-level quantization + streaming loader
+# ---------------------------------------------------------------------------
+
+
+def quantize_params(params, config: QuantizationConfig):
+    """Quantize eligible leaves of a param pytree (reference
+    load_and_quantize_model's module walk, as a pytree map)."""
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in leaves:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        if config.should_quantize(key, leaf):
+            out.append(quantize(leaf, config))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def quantized_nbytes(params) -> int:
+    """Total bytes of a (possibly quantized) param tree — the memory-footprint
+    estimate surfaced by ``accelerate estimate-memory``."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params, is_leaf=is_quantized):
+        if is_quantized(leaf):
+            total += leaf.data.size * leaf.data.dtype.itemsize + leaf.scale.size * 4
+        elif hasattr(leaf, "nbytes"):
+            total += leaf.nbytes
+        else:
+            total += np.asarray(leaf).nbytes
+    return total
+
+
+def load_and_quantize_model(
+    abstract_params,
+    checkpoint_path,
+    config: QuantizationConfig,
+    mesh=None,
+    param_spec_fn=None,
+):
+    """Stream a checkpoint and quantize eligible weights as they arrive
+    (reference load_and_quantize_model utils/bnb.py): unquantized leaves are
+    device_put (optionally sharded via ``param_spec_fn(path) ->
+    PartitionSpec`` over ``mesh``), quantized leaves stay as
+    :class:`QuantizedTensor` nodes with their codes on device.
+    """
+    from ..big_modeling import load_checkpoint_in_model
+
+    params, _ = load_checkpoint_in_model(abstract_params, checkpoint_path)
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in leaves:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        if config.should_quantize(key, leaf):
+            qt = quantize(leaf, config)
+            qt = QuantizedTensor(
+                jax.device_put(qt.data), jax.device_put(qt.scale),
+                qt.shape, qt.dtype, qt.scheme, qt.block_size,
+            )
+            out.append(qt)
+        else:
+            if mesh is not None and param_spec_fn is not None:
+                from jax.sharding import NamedSharding
+
+                leaf = jax.device_put(leaf, NamedSharding(mesh, param_spec_fn(key)))
+            else:
+                leaf = jax.device_put(leaf)
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -55,9 +55,7 @@ def test_dp_sharded_matches_baseline():
 
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
-    acc2 = Accelerator(parallelism_config=ParallelismConfig())  # all axes 1 -> but needs 8 devices
-    # use default mesh (dp over all devices is the natural default) — compare
-    # against a manual optax loop instead for a device-free baseline
+    # baseline: a manual optax loop (device-free single-logic run)
     params = regression_init_params()
     tx = optax.sgd(0.1)
     opt_state = tx.init(params)

@@ -130,3 +130,102 @@ def test_flops_per_token_positive():
     f = flops_per_token(cfg, 4096)
     # 6*6.7e9 ~ 4e10 plus attention term
     assert 3.5e10 < f < 6e10
+
+
+# ---------------------------------------------------------------------------
+# T5 encoder-decoder (reference Megatron T5TrainStep megatron_lm.py:718)
+# ---------------------------------------------------------------------------
+
+
+def test_t5_forward_shapes():
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    enc_ids = jnp.ones((2, 12), jnp.int32)
+    dec_ids = jnp.ones((2, 8), jnp.int32)
+    params = model.init(jax.random.key(0), enc_ids, dec_ids)
+    logits = model.apply(params, enc_ids, dec_ids)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+
+
+def test_t5_decoder_is_causal():
+    """Changing a future decoder token must not change earlier logits."""
+    import numpy as np
+
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+    cfg = T5Config.tiny(dtype=jnp.float32)
+    model = T5ForConditionalGeneration(cfg)
+    enc = jnp.ones((1, 8), jnp.int32)
+    dec = jnp.arange(8, dtype=jnp.int32)[None] % cfg.vocab_size
+    params = model.init(jax.random.key(0), enc, dec)
+    base = model.apply(params, enc, dec)
+    dec2 = dec.at[0, -1].set((int(dec[0, -1]) + 1) % cfg.vocab_size)
+    pert = model.apply(params, enc, dec2)
+    np.testing.assert_allclose(np.asarray(base[0, :-1]), np.asarray(pert[0, :-1]), atol=1e-5)
+
+
+def test_t5_encoder_mask_blocks_attention():
+    import numpy as np
+
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+    cfg = T5Config.tiny(dtype=jnp.float32)
+    model = T5ForConditionalGeneration(cfg)
+    enc = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    dec = jnp.ones((1, 4), jnp.int32)
+    params = model.init(jax.random.key(0), enc, dec)
+    mask = jnp.asarray([[True, True, False, False]])
+    masked = model.apply(params, enc, dec, attention_mask=mask)
+    # tokens behind the mask must not influence the output
+    enc2 = enc.at[0, 2].set(99)
+    masked2 = model.apply(params, enc2, dec, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(masked), np.asarray(masked2), atol=1e-5)
+
+
+def test_t5_training_converges_sharded():
+    """Seq2seq copy task improves under dp_shard x tp sharding."""
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration, make_t5_loss_fn
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=4, tp_size=2),
+        mixed_precision="bf16",
+    )
+    rng = np.random.default_rng(0)
+    src = jnp.asarray(rng.integers(2, cfg.vocab_size, (8, 12)), jnp.int32)
+    batch = {"input_ids": src, "labels": src}  # copy task
+
+    params = model.init(jax.random.key(0), src[:, :4], src[:, :4])
+    state = acc.create_train_state(params, optax.adamw(3e-3), apply_fn=model.apply)
+    step = acc.prepare_train_step(make_t5_loss_fn(model), max_grad_norm=1.0)
+
+    first = None
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        first = first or float(metrics["loss"])
+    assert float(metrics["loss"]) < first, (first, float(metrics["loss"]))
+
+
+def test_t5_ffn_kernels_are_tensor_parallel_sharded():
+    """Regression: wi_gate/wi_up must match the TP rule table so the d_model x
+    d_ff FFN matrices actually shard over tp (not silently replicate)."""
+    from accelerate_tpu import ParallelismConfig
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+    from accelerate_tpu.parallel.sharding import TRANSFORMER_TP_RULES, make_sharding_plan
+
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    enc = jnp.ones((1, 4), jnp.int32)
+    abstract = jax.eval_shape(lambda: model.init(jax.random.key(0), enc, enc))
+    pcfg = ParallelismConfig(dp_shard_size=4, tp_size=2)
+    plan = make_sharding_plan(abstract, pcfg.build_device_mesh(), pcfg, tp_rules=TRANSFORMER_TP_RULES)
+    mlp = plan["params"]["enc_layers_0"]["mlp"]
+    assert mlp["wi_gate"]["kernel"].spec[-1] == "tp", mlp["wi_gate"]["kernel"].spec
+    assert mlp["wi_up"]["kernel"].spec[-1] == "tp", mlp["wi_up"]["kernel"].spec
+    assert mlp["wo_mlp"]["kernel"].spec[0] == "tp", mlp["wo_mlp"]["kernel"].spec
