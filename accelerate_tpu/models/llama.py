@@ -43,7 +43,17 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     attn_implementation: str = "native"  # native | flash | ring
     remat: bool = False
+    # remat granularity when remat=True: "full" recomputes everything
+    # (minimum memory), "dots" saves matmul outputs (recompute only the cheap
+    # elementwise ops — more memory, less recompute)
+    remat_policy: str = "full"
     dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -202,6 +212,24 @@ class LlamaBlock(nn.Module):
         return out
 
 
+class LMHead(nn.Module):
+    """Vocab projection with params at ``lm_head/kernel`` (TP rule + ckpt
+    path), computed in ``dtype`` with fp32 accumulation."""
+
+    vocab_size: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.vocab_size), jnp.float32
+        )
+        return jax.lax.dot_general(
+            x, w.astype(self.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
 class LlamaForCausalLM(nn.Module):
     """Decoder LM head model.  ``__call__(input_ids) -> logits``.
 
@@ -214,7 +242,7 @@ class LlamaForCausalLM(nn.Module):
     block_cls = LlamaBlock  # class attribute, not a dataclass field
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, segment_ids=None):
+    def __call__(self, input_ids, positions=None, segment_ids=None, output_hidden: bool = False):
         cfg = self.config
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
@@ -224,36 +252,79 @@ class LlamaForCausalLM(nn.Module):
         x = embed(input_ids)
         block = type(self).block_cls
         if cfg.remat:
-            block = nn.remat(block, policy=jax.checkpoint_policies.nothing_saveable)
+            policy = {
+                "full": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[cfg.remat_policy]
+            block = nn.remat(block, policy=policy)
         for i in range(cfg.num_hidden_layers):
             x = block(cfg, name=f"layers_{i}")(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+        if output_hidden:
+            # pre-head states for the fused linear+CE loss path (the vocab
+            # projection happens inside the loss, chunked over the vocab)
+            return x
+        # Head matmul in compute dtype with fp32 accumulation: an fp32 matmul
+        # runs at a fraction of MXU rate, and with vocab-sized output this is
+        # ~10% of the model's FLOPs — bf16 operands + preferred_element_type
+        # keeps fp32 logits at native MXU speed.
         if cfg.tie_word_embeddings:
-            logits = embed.attend(x.astype(jnp.float32))
-        else:
-            logits = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32, param_dtype=jnp.float32, name="lm_head"
-            )(x.astype(jnp.float32))
-        return logits
+            head_w = embed.embedding.astype(cfg.dtype)  # [V, H]
+            contract = (((x.ndim - 1,), (1,)), ((), ()))
+            return jax.lax.dot_general(x, head_w, contract, preferred_element_type=jnp.float32)
+        return LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x)
 
 
 def causal_lm_loss(logits, labels, ignore_index: int = -100):
-    """Shifted next-token cross-entropy (matches transformers CausalLM loss)."""
+    """Shifted next-token cross-entropy (matches transformers CausalLM loss).
+
+    Formulated as ``logsumexp - label_logit`` so the [B, T, V] log-softmax
+    tensor is never materialized (one reduction pass over the vocab axis
+    instead of a full fp32 logp array — vocab-sized HBM traffic halved).
+    """
     logits = logits[:, :-1].astype(jnp.float32)
     labels = labels[:, 1:]
     mask = labels != ignore_index
     safe_labels = jnp.where(mask, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    token_ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    return -jnp.sum(token_ll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logit
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
 
 
-def make_llama_loss_fn(model: LlamaForCausalLM):
-    def loss_fn(params, batch):
-        logits = model.apply(params, batch["input_ids"], segment_ids=batch.get("segment_ids"))
-        return causal_lm_loss(logits, batch["labels"])
+def make_llama_loss_fn(model: LlamaForCausalLM, fused_vocab_chunks: Optional[int] = None):
+    """Loss factory.  With ``fused_vocab_chunks`` set, the vocab projection
+    moves inside a chunked fused linear+CE (ops/fused_xent.py) so the
+    [B, T, V] logits tensor is never materialized — the activation-memory
+    headroom this frees typically pays for a cheaper remat policy."""
+    if fused_vocab_chunks is None:
+        def loss_fn(params, batch):
+            logits = model.apply(params, batch["input_ids"], segment_ids=batch.get("segment_ids"))
+            return causal_lm_loss(logits, batch["labels"])
 
-    return loss_fn
+        return loss_fn
+
+    from ..ops.fused_xent import fused_causal_lm_loss
+
+    cfg = model.config
+
+    def fused_loss_fn(params, batch):
+        hidden = model.apply(
+            params, batch["input_ids"], segment_ids=batch.get("segment_ids"), output_hidden=True
+        )
+        inner = params.get("params", params)
+        if cfg.tie_word_embeddings:
+            weight = inner["embed_tokens"]["embedding"].astype(cfg.dtype)  # [V, H]
+            vocab_major = True
+        else:
+            weight = inner["lm_head"]["kernel"].astype(cfg.dtype)  # [H, V]
+            vocab_major = False
+        return fused_causal_lm_loss(
+            hidden, weight, batch["labels"], vocab_major=vocab_major,
+            num_chunks=fused_vocab_chunks,
+        )
+
+    return fused_loss_fn
 
 
 def count_params(params) -> int:

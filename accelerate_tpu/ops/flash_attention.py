@@ -9,10 +9,11 @@ O(T²), and every matmul lands on the MXU at 128-aligned tiles.
 Causal masking skips fully-masked KV blocks (upper-triangular blocks cost
 zero compute — the grid still visits them but predication makes them free).
 
-Backward: recompute-based custom VJP — the forward kernel saves only (out,
-logsumexp); the backward recomputes attention blockwise via XLA (fused by the
-compiler, fp32 softmax).  This is the standard TPU trade: HBM traffic is the
-bottleneck, recompute is cheap on the MXU.
+Backward: fused Pallas kernels (dq + dk/dv), recompute-based — the forward
+saves (q, k, v, out, logsumexp); each backward tile rebuilds its probability
+block from (q, k, lse) and accumulates gradients in VMEM scratch, so the
+[T, T] tensors of the naive backward never touch HBM.  Split into two kernels
+(dq accumulates over kv, dk/dv over q) instead of atomics — the TPU idiom.
 
 Falls back to interpret mode off-TPU so the same tests run on the CPU mesh.
 reference parity: the engines' flash kernels (torch sdpa/TE fused attn) the
@@ -47,7 +48,37 @@ def _on_tpu() -> bool:
         return False
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, sm_scale, block_q, block_k, seq_len):
+def _zero_oob_rows(x, start: int, limit: int):
+    """Zero-fill tile rows past ``limit`` — padded rows of a non-divisible
+    last block read garbage (NaN in interpret mode), and 0 * NaN = NaN would
+    leak through the accumulating dots even at zero probability."""
+    rows = start + jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    return jnp.where(rows < limit, x, jnp.zeros_like(x))
+
+
+def _masked_scores(q, k, sm_scale, q_start, k_start, t_len, s_len, causal,
+                   block_q, block_k):
+    """Scaled q@kᵀ tile with causal + out-of-bounds masking.
+
+    Shared by the forward and both backward kernels so the masking convention
+    cannot drift between them.  Returns (scores, valid): padded rows/cols of
+    the last (non-divisible) blocks and upper-triangular entries get
+    DEFAULT_MASK_VALUE; ``valid`` is the boolean tile for callers that must
+    hard-zero probabilities (the backward, where lse of padded rows is
+    garbage).
+    """
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale  # [block_q, block_k]
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    valid = (rows < t_len) & (cols < s_len)
+    if causal:
+        valid = valid & (rows >= cols)
+    return jnp.where(valid, scores, DEFAULT_MASK_VALUE), valid
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, sm_scale, block_q, block_k, t_len, s_len):
     """Grid: (batch*heads, q_blocks, kv_blocks); kv dim is innermost/serial."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -67,15 +98,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_
     @pl.when(should_compute)
     def _compute():
         q = q_ref[0]  # [block_q, d]
-        k = k_ref[0]  # [block_k, d]
-        v = v_ref[0]
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [block_q, block_k]
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            scores = jnp.where(rows >= cols, scores, DEFAULT_MASK_VALUE)
+        k = _zero_oob_rows(k_ref[0], k_start, s_len)  # [block_k, d]
+        v = _zero_oob_rows(v_ref[0], k_start, s_len)
+        scores, _ = _masked_scores(
+            q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k
+        )
 
         m_prev = m_scratch[:]  # [block_q, 1]
         m_cur = jnp.max(scores, axis=1, keepdims=True)
@@ -106,7 +133,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     grid = (bh, pl.cdiv(t, block_q), pl.cdiv(s, block_k))
 
     kernel = functools.partial(
-        _attn_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k, seq_len=s
+        _attn_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+        t_len=t, s_len=s,
     )
     scratch_shapes = []
     if _HAS_PLTPU:
@@ -146,15 +174,167 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     return out, lse[:, 0, :]
 
 
-def _reference_attention(q, k, v, causal, sm_scale):
-    """[BH, T, D] XLA attention used for the recompute backward."""
-    scores = jnp.einsum("btd,bsd->bts", q, k).astype(jnp.float32) * sm_scale
-    if causal:
-        t, s = scores.shape[-2:]
-        mask = jnp.tril(jnp.ones((t, s), bool), k=s - t)
-        scores = jnp.where(mask[None], scores, DEFAULT_MASK_VALUE)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bts,bsd->btd", probs, v)
+def _bwd_tile(q, k, v, g, lse, delta, sm_scale, q_start, k_start, t_len, s_len,
+              causal, block_q, block_k):
+    """(p, ds) for one backward tile — the recompute shared by dq and dk/dv.
+
+    p is hard-zeroed on invalid entries (padded rows read garbage lse/delta,
+    so masking via scores alone is not enough); ds = p * (dp - delta) * scale.
+    """
+    s, valid = _masked_scores(
+        q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k
+    )
+    p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = jnp.where(valid, p * (dp - delta) * sm_scale, 0.0)
+    return p, ds
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scratch,
+               *, causal, sm_scale, block_q, block_k, t_len, s_len):
+    """Grid: (batch*heads, q_blocks, kv_blocks); kv innermost/serial.
+
+    Blockwise flash backward for dq: recompute the probability tile from
+    (q, k, lse), form ds = p * (dp - delta), accumulate ds @ k.  Memory stays
+    O(block²) in VMEM — the [T, T] tensors of the naive backward never exist.
+    """
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    should_compute = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(should_compute)
+    def _compute():
+        q = q_ref[0]
+        k = _zero_oob_rows(k_ref[0], k_start, s_len)
+        v = _zero_oob_rows(v_ref[0], k_start, s_len)
+        g = _zero_oob_rows(g_ref[0], q_start, t_len)
+        lse = lse_ref[0, 0][:, None]      # [block_q, 1]
+        delta = delta_ref[0, 0][:, None]  # [block_q, 1]
+        _, ds = _bwd_tile(
+            q, k, v, g, lse, delta, sm_scale,
+            q_start, k_start, t_len, s_len, causal, block_q, block_k,
+        )
+        dq_scratch[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_scratch, dv_scratch, *, causal, sm_scale, block_q, block_k,
+                t_len, s_len):
+    """Grid: (batch*heads, kv_blocks, q_blocks); q innermost/serial.
+
+    Same tile recompute as :func:`_dq_kernel`, accumulated along q:
+    dv += pᵀ @ g and dk += dsᵀ @ q — separate kernel per accumulation
+    direction instead of atomics (the TPU idiom)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    should_compute = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(should_compute)
+    def _compute():
+        q = _zero_oob_rows(q_ref[0], q_start, t_len)
+        g = _zero_oob_rows(g_ref[0], q_start, t_len)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        p, ds = _bwd_tile(
+            q, k_ref[0], v_ref[0], g, lse, delta, sm_scale,
+            q_start, k_start, t_len, s_len, causal, block_q, block_k,
+        )
+        dv_scratch[:] += jax.lax.dot_general(
+            p.astype(q.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scratch[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
+    """Fused blockwise backward: (dq, dk, dv), each [BH, T, D]."""
+    bh, t, d = q.shape
+    s_len = k.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, s_len)
+
+    # delta_i = g_i . out_i — one cheap fused XLA pass, carried as [BH, 1, T]
+    # (same tiling-friendly layout as lse)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]
+    lse3 = lse[:, None, :]
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            t_len=t, s_len=s_len,
+        ),
+        grid=(bh, pl.cdiv(t, block_q), pl.cdiv(s_len, block_k)),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v, g, lse3, delta)
+
+    # swap grid roles: (bh, kv_blocks, q_blocks), q serial
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            t_len=t, s_len=s_len,
+        ),
+        grid=(bh, pl.cdiv(s_len, block_k), pl.cdiv(t, block_q)),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_len, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(q, k, v, g, lse3, delta)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -165,17 +345,12 @@ def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-
-    def f(q, k, v):
-        return _reference_attention(q, k, v, causal, sm_scale)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -190,7 +365,7 @@ def flash_attention(
     segment_ids=None,
     sm_scale: Optional[float] = None,
     block_q: int = 512,
-    block_k: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ):
     """Drop-in replacement for :func:`models.llama.native_attention`.

@@ -52,7 +52,7 @@ def main():
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
             max_position_embeddings=2048, attn_implementation="flash",
-            remat=True, dtype=jnp.bfloat16,
+            remat=True, remat_policy="dots", dtype=jnp.bfloat16,
         )
         batch, seq, iters = 8, 2048, 10
     else:  # CPU smoke mode
@@ -69,7 +69,12 @@ def main():
     ids = jnp.ones((batch, seq), jnp.int32)
     params = model.init(jax.random.key(0), ids[:, :8])
     state = acc.create_train_state(params, optax.adamw(3e-4), apply_fn=model.apply)
-    step = acc.prepare_train_step(make_llama_loss_fn(model), max_grad_norm=1.0)
+    # fused linear+CE keeps the [B,T,V] logits out of HBM, which is what lets
+    # the cheaper "dots" remat policy fit on a 16G chip
+    step = acc.prepare_train_step(
+        make_llama_loss_fn(model, fused_vocab_chunks=8 if on_tpu else None),
+        max_grad_norm=1.0,
+    )
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)

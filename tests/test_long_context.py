@@ -45,10 +45,35 @@ def test_flash_matches_native_interpret():
 
 
 def test_flash_grads_match_native():
+    """dq AND dk/dv (both backward kernels) against the native reference."""
     q, k, v = _qkv()
-    f = lambda q: jnp.sum(flash_attention(q, k, v, causal=True, block_q=8, block_k=8, interpret=True) ** 2)
-    g = lambda q: jnp.sum(native_attention(q, k, v, causal=True) ** 2)
-    np.testing.assert_allclose(np.asarray(jax.grad(f)(q)), np.asarray(jax.grad(g)(q)), atol=5e-5)
+    f = lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=True, block_q=8, block_k=8, interpret=True) ** 2)
+    g = lambda q, k, v: jnp.sum(native_attention(q, k, v, causal=True) ** 2)
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}")
+
+
+def test_flash_non_divisible_seq_len():
+    """Sequence lengths not divisible by the block size must still be exact
+    (padded tile rows/cols are masked, not garbage): fwd + both bwd kernels."""
+    rng = np.random.default_rng(3)
+    B, T, H, D = 1, 12, 2, 8  # T=12 with block 8 -> padded second block
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    for causal in (True, False):
+        ref = native_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    f = lambda q, k, v: jnp.sum(flash_attention(q, k, v, causal=True, block_q=8, block_k=8, interpret=True) ** 2)
+    g = lambda q, k, v: jnp.sum(native_attention(q, k, v, causal=True) ** 2)
+    gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gf, gn):
+        assert np.all(np.isfinite(np.asarray(a))), f"d{name} has NaN/inf"
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}")
 
 
 def test_flash_gqa():
