@@ -57,15 +57,15 @@ def _zero_oob_rows(x, start: int, limit: int):
 
 
 def _masked_scores(q, k, sm_scale, q_start, k_start, t_len, s_len, causal,
-                   block_q, block_k):
-    """Scaled q@kᵀ tile with causal + out-of-bounds masking.
+                   block_q, block_k, seg_q=None, seg_k=None):
+    """Scaled q@kᵀ tile with causal + segment + out-of-bounds masking.
 
     Shared by the forward and both backward kernels so the masking convention
     cannot drift between them.  Returns (scores, valid): padded rows/cols of
-    the last (non-divisible) blocks and upper-triangular entries get
-    DEFAULT_MASK_VALUE; ``valid`` is the boolean tile for callers that must
-    hard-zero probabilities (the backward, where lse of padded rows is
-    garbage).
+    the last (non-divisible) blocks, cross-segment pairs (packed sequences),
+    and upper-triangular entries get DEFAULT_MASK_VALUE; ``valid`` is the
+    boolean tile for callers that must hard-zero probabilities (the backward,
+    where lse of padded rows is garbage).
     """
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -75,10 +75,12 @@ def _masked_scores(q, k, sm_scale, q_start, k_start, t_len, s_len, causal,
     valid = (rows < t_len) & (cols < s_len)
     if causal:
         valid = valid & (rows >= cols)
+    if seg_q is not None:
+        valid = valid & (seg_q[:, None] == seg_k[None, :])
     return jnp.where(valid, scores, DEFAULT_MASK_VALUE), valid
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, sm_scale, block_q, block_k, t_len, s_len):
+def _attn_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, sm_scale, block_q, block_k, t_len, s_len, segmented):
     """Grid: (batch*heads, q_blocks, kv_blocks); kv dim is innermost/serial."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -100,8 +102,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_
         q = q_ref[0]  # [block_q, d]
         k = _zero_oob_rows(k_ref[0], k_start, s_len)  # [block_k, d]
         v = _zero_oob_rows(v_ref[0], k_start, s_len)
+        seg_q = seg_q_ref[0, 0] if segmented else None
+        seg_k = seg_kv_ref[0, 0] if segmented else None
         scores, _ = _masked_scores(
-            q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k
+            q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k,
+            seg_q, seg_k,
         )
 
         m_prev = m_scratch[:]  # [block_q, 1]
@@ -124,17 +129,23 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_
         lse_ref[0, 0] = (m_scratch[:] + jnp.log(safe_l))[:, 0]
 
 
-def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: int, interpret: bool):
-    """q/k/v: [BH, T, D] → (out [BH, T, D], lse [BH, T])."""
+def _flash_fwd(q, k, v, seg_q, seg_kv, causal: bool, sm_scale: float,
+               block_q: int, block_k: int, segmented: bool, interpret: bool):
+    """q: [B*H, T, D]; k/v: [B*Hkv, S, D] (GQA: no head repeat — the kv
+    BlockSpec maps each q head to its group's kv head); seg_q/seg_kv:
+    [B, 1, T]/[B, 1, S] int32.  Returns (out [B*H, T, D], lse [B*H, T])."""
     bh, t, d = q.shape
     s = k.shape[1]
+    n_batch = seg_q.shape[0]
+    n_heads = bh // n_batch
+    n_rep = bh // k.shape[0]
     block_q = min(block_q, t)
     block_k = min(block_k, s)
     grid = (bh, pl.cdiv(t, block_q), pl.cdiv(s, block_k))
 
     kernel = functools.partial(
         _attn_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-        t_len=t, s_len=s,
+        t_len=t, s_len=s, segmented=segmented,
     )
     scratch_shapes = []
     if _HAS_PLTPU:
@@ -149,13 +160,18 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
     else:  # pragma: no cover
         raise RuntimeError("pallas tpu backend unavailable")
 
+    def kv_map(b, i, j):  # q head b -> its GQA group's kv head
+        return (b // n_rep, j, 0)
+
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // n_heads, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // n_heads, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -170,19 +186,20 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float, block_q: int, block_k: in
         scratch_shapes=scratch_shapes,
         compiler_params=compiler_params,
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, seg_q, seg_kv)
     return out, lse[:, 0, :]
 
 
 def _bwd_tile(q, k, v, g, lse, delta, sm_scale, q_start, k_start, t_len, s_len,
-              causal, block_q, block_k):
+              causal, block_q, block_k, seg_q=None, seg_k=None):
     """(p, ds) for one backward tile — the recompute shared by dq and dk/dv.
 
     p is hard-zeroed on invalid entries (padded rows read garbage lse/delta,
     so masking via scores alone is not enough); ds = p * (dp - delta) * scale.
     """
     s, valid = _masked_scores(
-        q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k
+        q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k,
+        seg_q, seg_k,
     )
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(
@@ -192,8 +209,9 @@ def _bwd_tile(q, k, v, g, lse, delta, sm_scale, q_start, k_start, t_len, s_len,
     return p, ds
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scratch,
-               *, causal, sm_scale, block_q, block_k, t_len, s_len):
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_kv_ref,
+               dq_ref, dq_scratch,
+               *, causal, sm_scale, block_q, block_k, t_len, s_len, segmented):
     """Grid: (batch*heads, q_blocks, kv_blocks); kv innermost/serial.
 
     Blockwise flash backward for dq: recompute the probability tile from
@@ -222,6 +240,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scratc
         _, ds = _bwd_tile(
             q, k, v, g, lse, delta, sm_scale,
             q_start, k_start, t_len, s_len, causal, block_q, block_k,
+            seg_q_ref[0, 0] if segmented else None,
+            seg_kv_ref[0, 0] if segmented else None,
         )
         dq_scratch[:] += jax.lax.dot_general(
             ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
@@ -233,18 +253,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, dq_scratc
         dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_kv_ref,
+                dk_ref, dv_ref,
                 dk_scratch, dv_scratch, *, causal, sm_scale, block_q, block_k,
-                t_len, s_len):
-    """Grid: (batch*heads, kv_blocks, q_blocks); q innermost/serial.
+                t_len, s_len, q_blocks, segmented):
+    """Grid: (batch*kv_heads, kv_blocks, group*q_blocks); innermost/serial dim
+    walks every (GQA group member, q block) pair.
 
-    Same tile recompute as :func:`_dq_kernel`, accumulated along q:
-    dv += pᵀ @ g and dk += dsᵀ @ q — separate kernel per accumulation
-    direction instead of atomics (the TPU idiom)."""
+    Same tile recompute as :func:`_dq_kernel`, accumulated along q — and,
+    under GQA, across the group's q heads (dk/dv sum over the group here
+    instead of a post-hoc reduction over repeated heads): dv += pᵀ @ g and
+    dk += dsᵀ @ q — separate kernel per accumulation direction instead of
+    atomics (the TPU idiom)."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    gi = pl.program_id(2)
+    qi = gi % q_blocks  # q-block index within the current group member
 
-    @pl.when(qi == 0)
+    @pl.when(gi == 0)
     def _init():
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
         dv_scratch[:] = jnp.zeros_like(dv_scratch)
@@ -262,6 +287,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         p, ds = _bwd_tile(
             q, k_ref[0], v_ref[0], g, lse, delta, sm_scale,
             q_start, k_start, t_len, s_len, causal, block_q, block_k,
+            seg_q_ref[0, 0] if segmented else None,
+            seg_kv_ref[0, 0] if segmented else None,
         )
         dv_scratch[:] += jax.lax.dot_general(
             p.astype(q.dtype), g, (((0,), (0,)), ((), ())),
@@ -272,60 +299,79 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == pl.num_programs(2) - 1)
+    @pl.when(gi == pl.num_programs(2) - 1)
     def _finalize():
         dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret):
-    """Fused blockwise backward: (dq, dk, dv), each [BH, T, D]."""
+def _flash_bwd(q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q,
+               block_k, segmented, interpret):
+    """Fused blockwise backward: dq [B*H, T, D], dk/dv [B*Hkv, S, D]."""
     bh, t, d = q.shape
-    s_len = k.shape[1]
+    bhkv, s_len, _ = k.shape
+    n_batch = seg_q.shape[0]
+    n_heads = bh // n_batch
+    n_rep = bh // bhkv
     block_q = min(block_q, t)
     block_k = min(block_k, s_len)
+    q_blocks = pl.cdiv(t, block_q)
 
     # delta_i = g_i . out_i — one cheap fused XLA pass, carried as [BH, 1, T]
     # (same tiling-friendly layout as lse)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]
     lse3 = lse[:, None, :]
 
-    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
     compiler_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 
+    # dq grid: (q heads, q_blocks, kv_blocks) — kv specs map to the group head
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // n_rep, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))
+    seg_q_spec = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // n_heads, 0, i))
+    seg_kv_spec = pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // n_heads, 0, j))
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            t_len=t, s_len=s_len,
+            t_len=t, s_len=s_len, segmented=segmented,
         ),
-        grid=(bh, pl.cdiv(t, block_q), pl.cdiv(s_len, block_k)),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        grid=(bh, q_blocks, pl.cdiv(s_len, block_k)),
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec, seg_q_spec, seg_kv_spec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=compiler_params,
         interpret=interpret,
-    )(q, k, v, g, lse3, delta)
+    )(q, k, v, g, lse3, delta, seg_q, seg_kv)
 
-    # swap grid roles: (bh, kv_blocks, q_blocks), q serial
-    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    # dk/dv grid: (kv heads, kv_blocks, group*q_blocks) — the serial dim walks
+    # every (group member, q block) pair so GQA head-sums happen in-scratch
+    hkv = bhkv // n_batch  # kv heads per batch element
+
+    def q_map(b, j, i):  # kv head b, serial step i -> q-head row + q block
+        return ((b // hkv) * n_heads + (b % hkv) * n_rep + i // q_blocks, i % q_blocks, 0)
+
+    def row_map(b, j, i):
+        return ((b // hkv) * n_heads + (b % hkv) * n_rep + i // q_blocks, 0, i % q_blocks)
+
+    qspec2 = pl.BlockSpec((1, block_q, d), q_map)
     kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    rowspec2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i))
+    rowspec2 = pl.BlockSpec((1, 1, block_q), row_map)
+    seg_q_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b // hkv, 0, i % q_blocks))
+    seg_kv_spec2 = pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b // hkv, 0, j))
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            t_len=t, s_len=s_len,
+            t_len=t, s_len=s_len, q_blocks=q_blocks, segmented=segmented,
         ),
-        grid=(bh, pl.cdiv(s_len, block_k), pl.cdiv(t, block_q)),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2],
+        grid=(bhkv, pl.cdiv(s_len, block_k), n_rep * q_blocks),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2, seg_q_spec2, seg_kv_spec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s_len, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s_len, d), v.dtype),
+            jax.ShapeDtypeStruct((bhkv, s_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bhkv, s_len, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -333,24 +379,32 @@ def _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpr
         ],
         compiler_params=compiler_params,
         interpret=interpret,
-    )(q, k, v, g, lse3, delta)
+    )(q, k, v, g, lse3, delta, seg_q, seg_kv)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret):
+    out, _ = _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret):
+    out, lse = _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret)
+    return out, (q, k, v, seg_q, seg_kv, out, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal, sm_scale, block_q, block_k, interpret)
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, segmented, interpret, res, g):
+    q, k, v, seg_q, seg_kv, out, lse = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q, block_k,
+        segmented, interpret,
+    )
+    return (
+        dq, dk, dv,
+        np.zeros(seg_q.shape, jax.dtypes.float0),
+        np.zeros(seg_kv.shape, jax.dtypes.float0),
+    )
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -370,28 +424,36 @@ def flash_attention(
 ):
     """Drop-in replacement for :func:`models.llama.native_attention`.
 
-    q: [B, T, H, D]; k/v: [B, S, Hkv, D] (GQA handled by repeat).
-    segment_ids unsupported in the fused kernel (falls back to native).
+    q: [B, T, H, D]; k/v: [B, S, Hkv, D].  GQA runs without repeating K/V —
+    the kernel's BlockSpecs map each q head to its group's kv head, and dk/dv
+    accumulate the group sum in VMEM scratch.  ``segment_ids`` [B, T] masks
+    cross-segment attention in-kernel (packed sequences at flash speed;
+    requires self-attention shapes, T == S).
     """
-    if segment_ids is not None:
-        from ..models.llama import native_attention
-
-        return native_attention(q, k, v, causal=causal, segment_ids=segment_ids)
-
     b, t, h, d = q.shape
     s, hkv = k.shape[1], k.shape[2]
-    if hkv != h:
-        rep = h // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    if h % hkv != 0:
+        raise ValueError(f"num q heads {h} not divisible by kv heads {hkv}")
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
     if interpret is None:
         interpret = not _on_tpu()
 
-    # [B, T, H, D] -> [B*H, T, D]
-    def to_bhd(x, length):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, length, d)
+    segmented = segment_ids is not None
+    if segmented:
+        if s != t:
+            raise ValueError("segment_ids requires self-attention (T == S)")
+        seg = jnp.asarray(segment_ids, jnp.int32)[:, None, :]  # [B, 1, T]
+        seg_q = seg_kv = seg
+    else:
+        seg_q = jnp.zeros((b, 1, t), jnp.int32)
+        seg_kv = jnp.zeros((b, 1, s), jnp.int32)
 
-    out = _flash(to_bhd(q, t), to_bhd(k, s), to_bhd(v, s), causal, sm_scale, block_q, block_k, interpret)
+    def to_bhd(x, heads, length):  # [B, L, H, D] -> [B*H, L, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * heads, length, d)
+
+    out = _flash(
+        to_bhd(q, h, t), to_bhd(k, hkv, s), to_bhd(v, hkv, s), seg_q, seg_kv,
+        causal, sm_scale, block_q, block_k, segmented, interpret,
+    )
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
