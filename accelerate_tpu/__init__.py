@@ -65,6 +65,17 @@ try:
 except ImportError:  # pragma: no cover
     pass
 try:
+    from .hooks import (
+        AlignDevicesHook,
+        ModelHook,
+        SequentialHook,
+        add_hook_to_apply,
+        attach_align_device_hook,
+        remove_hook_from_apply,
+    )
+except ImportError:  # pragma: no cover
+    pass
+try:
     from .utils.quantization import (
         QuantizationConfig,
         load_and_quantize_model,

@@ -1,0 +1,219 @@
+"""Functional hooks engine — the module-hook lifecycle, TPU-native.
+
+The reference monkey-patches ``nn.Module.forward`` to interpose behavior
+(reference hooks.py: ``ModelHook`` :43, ``SequentialHook`` :101,
+``add_hook_to_module`` :132, ``AlignDevicesHook`` :227 with ``pre_forward``
+:331, ``attach_align_device_hook_on_blocks`` :559, ``CpuOffload`` :693,
+``LayerwiseCastingHook`` :757).  In JAX there is no mutable module object to
+patch; the same capability is function composition: a hook transforms
+``(params, args, kwargs)`` before the wrapped ``apply_fn`` runs and the
+output after.  Everything here stays jit-compatible as long as individual
+hooks are (device placement hooks intentionally run OUTSIDE jit — they exist
+to move host-resident weights, which is a host-side concern).
+
+``add_hook_to_apply(apply_fn, hook)`` is the ``add_hook_to_module`` analog,
+returning a new callable with ``_at_hook`` metadata so hooks can be
+inspected, replaced (latest wins, like ``append=False``), or removed
+(``remove_hook_from_apply``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "ModelHook",
+    "SequentialHook",
+    "add_hook_to_apply",
+    "remove_hook_from_apply",
+    "AlignDevicesHook",
+    "CpuOffloadHook",
+    "LayerwiseCastingHook",
+    "attach_align_device_hook",
+]
+
+
+class ModelHook:
+    """Lifecycle object interposed around an ``apply_fn`` call
+    (reference ModelHook hooks.py:43-98)."""
+
+    def init_hook(self, apply_fn: Callable) -> Callable:
+        """Called once at attach time; may return a replacement apply_fn."""
+        return apply_fn
+
+    def pre_forward(self, params, *args, **kwargs):
+        """Transform inputs; returns (params, args, kwargs)."""
+        return params, args, kwargs
+
+    def post_forward(self, params, output):
+        """Transform the output."""
+        return output
+
+    def detach_hook(self, apply_fn: Callable) -> Callable:
+        """Called at removal; may undo init_hook effects."""
+        return apply_fn
+
+
+class SequentialHook(ModelHook):
+    """Compose hooks in order (reference SequentialHook hooks.py:101)."""
+
+    def __init__(self, *hooks: ModelHook):
+        self.hooks = list(hooks)
+
+    def init_hook(self, apply_fn):
+        for h in self.hooks:
+            apply_fn = h.init_hook(apply_fn)
+        return apply_fn
+
+    def pre_forward(self, params, *args, **kwargs):
+        for h in self.hooks:
+            params, args, kwargs = h.pre_forward(params, *args, **kwargs)
+        return params, args, kwargs
+
+    def post_forward(self, params, output):
+        for h in reversed(self.hooks):
+            output = h.post_forward(params, output)
+        return output
+
+    def detach_hook(self, apply_fn):
+        for h in reversed(self.hooks):
+            apply_fn = h.detach_hook(apply_fn)
+        return apply_fn
+
+
+def add_hook_to_apply(apply_fn: Callable, hook: ModelHook, append: bool = False) -> Callable:
+    """Wrap ``apply_fn(params, *args, **kwargs)`` with a hook (reference
+    add_hook_to_module hooks.py:132).  ``append=True`` chains onto an
+    existing hook instead of replacing it."""
+    if append and getattr(apply_fn, "_at_hook", None) is not None:
+        hook = SequentialHook(apply_fn._at_hook, hook)
+        apply_fn = apply_fn._at_original
+    elif getattr(apply_fn, "_at_hook", None) is not None:
+        apply_fn = apply_fn._at_original  # replace (reference :141-147)
+
+    inner = hook.init_hook(apply_fn)
+
+    def wrapped(params, *args, **kwargs):
+        params, args, kwargs = hook.pre_forward(params, *args, **kwargs)
+        output = inner(params, *args, **kwargs)
+        return hook.post_forward(params, output)
+
+    wrapped._at_hook = hook
+    wrapped._at_original = apply_fn
+    return wrapped
+
+
+def remove_hook_from_apply(apply_fn: Callable) -> Callable:
+    """Inverse of :func:`add_hook_to_apply` (reference remove_hook_from_module
+    hooks.py:189)."""
+    hook = getattr(apply_fn, "_at_hook", None)
+    if hook is None:
+        return apply_fn
+    return hook.detach_hook(apply_fn._at_original)
+
+
+class AlignDevicesHook(ModelHook):
+    """Ship host/disk-resident param leaves to device just-in-time and drop
+    the device copies after the call (reference AlignDevicesHook
+    hooks.py:227: execution_device + offload mode).
+
+    ``io_buffer`` True routes disk reads through the native IO engine's
+    parallel pread when the leaf is an :class:`~numpy.memmap` (OffloadStore
+    .dat files).
+    """
+
+    def __init__(self, execution_device=None, offload: bool = True, io_buffer: bool = True):
+        self.execution_device = execution_device
+        # offload=False: fetch once and keep the device copies (weights fit;
+        # the hook only exists to place them).  True: re-fetch per call and
+        # let the copies die after (weights larger than device memory).
+        self.offload = offload
+        self.io_buffer = io_buffer
+        self._cached = None
+
+    def _fetch(self, x):
+        if isinstance(x, np.memmap) and self.io_buffer:
+            from . import native
+
+            out = np.empty(x.shape, x.dtype)
+            try:
+                native.read_file(x.filename, nbytes=out.nbytes, offset=x.offset, out=out)
+            except (OSError, AttributeError):
+                out = np.asarray(x)
+            return jax.device_put(out, self.execution_device)
+        if isinstance(x, np.ndarray):
+            return jax.device_put(x, self.execution_device)
+        return x
+
+    def pre_forward(self, params, *args, **kwargs):
+        if not self.offload:
+            if self._cached is None:
+                self._cached = jax.tree_util.tree_map(self._fetch, params)
+            return self._cached, args, kwargs
+        return jax.tree_util.tree_map(self._fetch, params), args, kwargs
+
+    def post_forward(self, params, output):
+        # offload=True: device copies of offloaded leaves die with the
+        # pre_forward tree — nothing to do beyond letting them go out of scope
+        return output
+
+    def detach_hook(self, apply_fn):
+        self._cached = None
+        return apply_fn
+
+
+class CpuOffloadHook(ModelHook):
+    """Keep params on host between calls; device-put on use (reference
+    CpuOffload hooks.py:693)."""
+
+    def __init__(self, execution_device=None):
+        self.execution_device = execution_device
+
+    def init_hook(self, apply_fn):
+        self._align = AlignDevicesHook(self.execution_device)
+        return apply_fn
+
+    def pre_forward(self, params, *args, **kwargs):
+        host_params = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, params
+        )
+        return self._align.pre_forward(host_params, *args, **kwargs)
+
+
+class LayerwiseCastingHook(ModelHook):
+    """Upcast storage-dtype params to compute dtype in-call (reference
+    LayerwiseCastingHook hooks.py:757; pairs with
+    ops.precision.layerwise_casting which handles the storage side)."""
+
+    def __init__(self, storage_dtype=jnp.float8_e4m3fn, compute_dtype=jnp.bfloat16):
+        self.storage_dtype = jnp.dtype(storage_dtype)
+        self.compute_dtype = compute_dtype
+
+    def pre_forward(self, params, *args, **kwargs):
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(self.compute_dtype)
+            if hasattr(x, "dtype") and x.dtype == self.storage_dtype
+            else x,
+            params,
+        )
+        return params, args, kwargs
+
+
+def attach_align_device_hook(
+    apply_fn: Callable,
+    execution_device=None,
+    offload: bool = True,
+    extra_hooks: Optional[Sequence[ModelHook]] = None,
+) -> Callable:
+    """One-call AlignDevicesHook attachment (reference
+    attach_align_device_hook_on_blocks hooks.py:559) — compose with any
+    ``extra_hooks`` in order."""
+    hooks: list[ModelHook] = [AlignDevicesHook(execution_device, offload=offload)]
+    if extra_hooks:
+        hooks.extend(extra_hooks)
+    hook: ModelHook = hooks[0] if len(hooks) == 1 else SequentialHook(*hooks)
+    return add_hook_to_apply(apply_fn, hook)

@@ -57,15 +57,19 @@ def _zero_oob_rows(x, start: int, limit: int):
 
 
 def _masked_scores(q, k, sm_scale, q_start, k_start, t_len, s_len, causal,
-                   block_q, block_k, seg_q=None, seg_k=None):
+                   block_q, block_k, seg_q=None, seg_k=None, pos_q=None, pos_k=None):
     """Scaled q@kᵀ tile with causal + segment + out-of-bounds masking.
 
     Shared by the forward and both backward kernels so the masking convention
     cannot drift between them.  Returns (scores, valid): padded rows/cols of
     the last (non-divisible) blocks, cross-segment pairs (packed sequences),
-    and upper-triangular entries get DEFAULT_MASK_VALUE; ``valid`` is the
+    and causally-forbidden entries get DEFAULT_MASK_VALUE; ``valid`` is the
     boolean tile for callers that must hard-zero probabilities (the backward,
     where lse of padded rows is garbage).
+
+    With ``pos_q/pos_k`` (explicit global token positions — the ring-CP
+    zigzag layout), the causal comparison uses positions instead of local
+    tile indices.
     """
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -74,13 +78,16 @@ def _masked_scores(q, k, sm_scale, q_start, k_start, t_len, s_len, causal,
     cols = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     valid = (rows < t_len) & (cols < s_len)
     if causal:
-        valid = valid & (rows >= cols)
+        if pos_q is not None:
+            valid = valid & (pos_q[:, None] >= pos_k[None, :])
+        else:
+            valid = valid & (rows >= cols)
     if seg_q is not None:
         valid = valid & (seg_q[:, None] == seg_k[None, :])
     return jnp.where(valid, scores, DEFAULT_MASK_VALUE), valid
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, sm_scale, block_q, block_k, t_len, s_len, segmented):
+def _attn_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, pos_q_ref, pos_kv_ref, o_ref, lse_ref, m_scratch, l_scratch, acc_scratch, *, causal, sm_scale, block_q, block_k, t_len, s_len, segmented, positioned):
     """Grid: (batch*heads, q_blocks, kv_blocks); kv dim is innermost/serial."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -94,8 +101,9 @@ def _attn_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref, lse_ref, m_s
     q_start = qi * block_q
     k_start = ki * block_k
 
-    # causal: skip blocks entirely above the diagonal
-    should_compute = (not causal) or (q_start + block_q - 1 >= k_start)
+    # causal: skip blocks entirely above the diagonal (with explicit
+    # positions the diagonal is data-dependent, so no block skipping)
+    should_compute = (not causal) or positioned or (q_start + block_q - 1 >= k_start)
 
     @pl.when(should_compute)
     def _compute():
@@ -104,9 +112,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref, lse_ref, m_s
         v = _zero_oob_rows(v_ref[0], k_start, s_len)
         seg_q = seg_q_ref[0, 0] if segmented else None
         seg_k = seg_kv_ref[0, 0] if segmented else None
+        pos_q = pos_q_ref[0, 0] if positioned else None
+        pos_k = pos_kv_ref[0, 0] if positioned else None
         scores, _ = _masked_scores(
             q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k,
-            seg_q, seg_k,
+            seg_q, seg_k, pos_q, pos_k,
         )
 
         m_prev = m_scratch[:]  # [block_q, 1]
@@ -129,10 +139,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, seg_q_ref, seg_kv_ref, o_ref, lse_ref, m_s
         lse_ref[0, 0] = (m_scratch[:] + jnp.log(safe_l))[:, 0]
 
 
-def _flash_fwd(q, k, v, seg_q, seg_kv, causal: bool, sm_scale: float,
-               block_q: int, block_k: int, segmented: bool, interpret: bool):
+def _flash_fwd(q, k, v, seg_q, seg_kv, pos_q, pos_kv, causal: bool, sm_scale: float,
+               block_q: int, block_k: int, segmented: bool, positioned: bool,
+               interpret: bool):
     """q: [B*H, T, D]; k/v: [B*Hkv, S, D] (GQA: no head repeat — the kv
-    BlockSpec maps each q head to its group's kv head); seg_q/seg_kv:
+    BlockSpec maps each q head to its group's kv head); seg/pos:
     [B, 1, T]/[B, 1, S] int32.  Returns (out [B*H, T, D], lse [B*H, T])."""
     bh, t, d = q.shape
     s = k.shape[1]
@@ -145,7 +156,7 @@ def _flash_fwd(q, k, v, seg_q, seg_kv, causal: bool, sm_scale: float,
 
     kernel = functools.partial(
         _attn_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-        t_len=t, s_len=s, segmented=segmented,
+        t_len=t, s_len=s, segmented=segmented, positioned=positioned,
     )
     scratch_shapes = []
     if _HAS_PLTPU:
@@ -163,6 +174,8 @@ def _flash_fwd(q, k, v, seg_q, seg_kv, causal: bool, sm_scale: float,
     def kv_map(b, i, j):  # q head b -> its GQA group's kv head
         return (b // n_rep, j, 0)
 
+    row_q = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // n_heads, 0, i))
+    row_kv = pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // n_heads, 0, j))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -170,8 +183,7 @@ def _flash_fwd(q, k, v, seg_q, seg_kv, causal: bool, sm_scale: float,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_k, d), kv_map),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // n_heads, 0, i)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // n_heads, 0, j)),
+            row_q, row_kv, row_q, row_kv,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -186,12 +198,12 @@ def _flash_fwd(q, k, v, seg_q, seg_kv, causal: bool, sm_scale: float,
         scratch_shapes=scratch_shapes,
         compiler_params=compiler_params,
         interpret=interpret,
-    )(q, k, v, seg_q, seg_kv)
+    )(q, k, v, seg_q, seg_kv, pos_q, pos_kv)
     return out, lse[:, 0, :]
 
 
 def _bwd_tile(q, k, v, g, lse, delta, sm_scale, q_start, k_start, t_len, s_len,
-              causal, block_q, block_k, seg_q=None, seg_k=None):
+              causal, block_q, block_k, seg_q=None, seg_k=None, pos_q=None, pos_k=None):
     """(p, ds) for one backward tile — the recompute shared by dq and dk/dv.
 
     p is hard-zeroed on invalid entries (padded rows read garbage lse/delta,
@@ -199,7 +211,7 @@ def _bwd_tile(q, k, v, g, lse, delta, sm_scale, q_start, k_start, t_len, s_len,
     """
     s, valid = _masked_scores(
         q, k, sm_scale, q_start, k_start, t_len, s_len, causal, block_q, block_k,
-        seg_q, seg_k,
+        seg_q, seg_k, pos_q, pos_k,
     )
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(
@@ -210,8 +222,8 @@ def _bwd_tile(q, k, v, g, lse, delta, sm_scale, q_start, k_start, t_len, s_len,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_kv_ref,
-               dq_ref, dq_scratch,
-               *, causal, sm_scale, block_q, block_k, t_len, s_len, segmented):
+               pos_q_ref, pos_kv_ref, dq_ref, dq_scratch,
+               *, causal, sm_scale, block_q, block_k, t_len, s_len, segmented, positioned):
     """Grid: (batch*heads, q_blocks, kv_blocks); kv innermost/serial.
 
     Blockwise flash backward for dq: recompute the probability tile from
@@ -227,7 +239,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_kv
 
     q_start = qi * block_q
     k_start = ki * block_k
-    should_compute = (not causal) or (q_start + block_q - 1 >= k_start)
+    should_compute = (not causal) or positioned or (q_start + block_q - 1 >= k_start)
 
     @pl.when(should_compute)
     def _compute():
@@ -242,6 +254,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_kv
             q_start, k_start, t_len, s_len, causal, block_q, block_k,
             seg_q_ref[0, 0] if segmented else None,
             seg_kv_ref[0, 0] if segmented else None,
+            pos_q_ref[0, 0] if positioned else None,
+            pos_kv_ref[0, 0] if positioned else None,
         )
         dq_scratch[:] += jax.lax.dot_general(
             ds.astype(q.dtype), k, (((1,), (0,)), ((), ())),
@@ -254,9 +268,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_kv
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_kv_ref,
-                dk_ref, dv_ref,
+                pos_q_ref, pos_kv_ref, dk_ref, dv_ref,
                 dk_scratch, dv_scratch, *, causal, sm_scale, block_q, block_k,
-                t_len, s_len, q_blocks, segmented):
+                t_len, s_len, q_blocks, segmented, positioned):
     """Grid: (batch*kv_heads, kv_blocks, group*q_blocks); innermost/serial dim
     walks every (GQA group member, q block) pair.
 
@@ -276,7 +290,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_k
 
     q_start = qi * block_q
     k_start = ki * block_k
-    should_compute = (not causal) or (q_start + block_q - 1 >= k_start)
+    should_compute = (not causal) or positioned or (q_start + block_q - 1 >= k_start)
 
     @pl.when(should_compute)
     def _compute():
@@ -289,6 +303,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_k
             q_start, k_start, t_len, s_len, causal, block_q, block_k,
             seg_q_ref[0, 0] if segmented else None,
             seg_kv_ref[0, 0] if segmented else None,
+            pos_q_ref[0, 0] if positioned else None,
+            pos_kv_ref[0, 0] if positioned else None,
         )
         dv_scratch[:] += jax.lax.dot_general(
             p.astype(q.dtype), g, (((0,), (0,)), ((), ())),
@@ -305,9 +321,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, seg_q_ref, seg_k
         dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q,
-               block_k, segmented, interpret):
-    """Fused blockwise backward: dq [B*H, T, D], dk/dv [B*Hkv, S, D]."""
+def _flash_bwd(q, k, v, seg_q, seg_kv, pos_q, pos_kv, out, lse, g, g_lse, causal,
+               sm_scale, block_q, block_k, segmented, positioned, interpret):
+    """Fused blockwise backward: dq [B*H, T, D], dk/dv [B*Hkv, S, D].
+
+    ``g_lse`` is the cotangent of the lse output (nonzero when callers
+    combine partial attentions by logsumexp — ring CP): its score-gradient
+    contribution is ``p * g_lse``, which folds into the existing
+    ``ds = p * (dp - delta)`` as ``delta - g_lse``.
+    """
     bh, t, d = q.shape
     bhkv, s_len, _ = k.shape
     n_batch = seg_q.shape[0]
@@ -319,7 +341,10 @@ def _flash_bwd(q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q,
 
     # delta_i = g_i . out_i — one cheap fused XLA pass, carried as [BH, 1, T]
     # (same tiling-friendly layout as lse)
-    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)[:, None, :]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
+    delta = delta[:, None, :]
     lse3 = lse[:, None, :]
 
     compiler_params = pltpu.CompilerParams(
@@ -335,16 +360,17 @@ def _flash_bwd(q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q,
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            t_len=t, s_len=s_len, segmented=segmented,
+            t_len=t, s_len=s_len, segmented=segmented, positioned=positioned,
         ),
         grid=(bh, q_blocks, pl.cdiv(s_len, block_k)),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec, seg_q_spec, seg_kv_spec],
+        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec, seg_q_spec, seg_kv_spec,
+                  seg_q_spec, seg_kv_spec],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=compiler_params,
         interpret=interpret,
-    )(q, k, v, g, lse3, delta, seg_q, seg_kv)
+    )(q, k, v, g, lse3, delta, seg_q, seg_kv, pos_q, pos_kv)
 
     # dk/dv grid: (kv heads, kv_blocks, group*q_blocks) — the serial dim walks
     # every (group member, q block) pair so GQA head-sums happen in-scratch
@@ -364,10 +390,11 @@ def _flash_bwd(q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, causal=causal, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            t_len=t, s_len=s_len, q_blocks=q_blocks, segmented=segmented,
+            t_len=t, s_len=s_len, q_blocks=q_blocks, segmented=segmented, positioned=positioned,
         ),
         grid=(bhkv, pl.cdiv(s_len, block_k), n_rep * q_blocks),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2, seg_q_spec2, seg_kv_spec2],
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2, seg_q_spec2, seg_kv_spec2,
+                  seg_q_spec2, seg_kv_spec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
             jax.ShapeDtypeStruct((bhkv, s_len, d), k.dtype),
@@ -379,32 +406,36 @@ def _flash_bwd(q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q,
         ],
         compiler_params=compiler_params,
         interpret=interpret,
-    )(q, k, v, g, lse3, delta, seg_q, seg_kv)
+    )(q, k, v, g, lse3, delta, seg_q, seg_kv, pos_q, pos_kv)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret):
-    out, _ = _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret)
-    return out
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, seg_q, seg_kv, pos_q, pos_kv, causal, sm_scale, block_q,
+           block_k, segmented, positioned, interpret):
+    """(out, lse) with a fully differentiable lse — ring CP's logsumexp
+    combine backpropagates through both outputs."""
+    return _flash_fwd(q, k, v, seg_q, seg_kv, pos_q, pos_kv, causal, sm_scale,
+                      block_q, block_k, segmented, positioned, interpret)
 
 
-def _flash_vjp_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret):
-    out, lse = _flash_fwd(q, k, v, seg_q, seg_kv, causal, sm_scale, block_q, block_k, segmented, interpret)
-    return out, (q, k, v, seg_q, seg_kv, out, lse)
+def _flash_vjp_fwd(q, k, v, seg_q, seg_kv, pos_q, pos_kv, causal, sm_scale,
+                   block_q, block_k, segmented, positioned, interpret):
+    out, lse = _flash_fwd(q, k, v, seg_q, seg_kv, pos_q, pos_kv, causal, sm_scale,
+                          block_q, block_k, segmented, positioned, interpret)
+    return (out, lse), (q, k, v, seg_q, seg_kv, pos_q, pos_kv, out, lse)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, segmented, interpret, res, g):
-    q, k, v, seg_q, seg_kv, out, lse = res
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, segmented, positioned,
+                   interpret, res, gbar):
+    q, k, v, seg_q, seg_kv, pos_q, pos_kv, out, lse = res
+    g, g_lse = gbar
     dq, dk, dv = _flash_bwd(
-        q, k, v, seg_q, seg_kv, out, lse, g, causal, sm_scale, block_q, block_k,
-        segmented, interpret,
+        q, k, v, seg_q, seg_kv, pos_q, pos_kv, out, lse, g, g_lse, causal,
+        sm_scale, block_q, block_k, segmented, positioned, interpret,
     )
-    return (
-        dq, dk, dv,
-        np.zeros(seg_q.shape, jax.dtypes.float0),
-        np.zeros(seg_kv.shape, jax.dtypes.float0),
-    )
+    zero_int = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return (dq, dk, dv, zero_int(seg_q), zero_int(seg_kv), zero_int(pos_q), zero_int(pos_kv))
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -417,18 +448,29 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_ids=None,
+    positions=None,
+    kv_positions=None,
     sm_scale: Optional[float] = None,
     block_q: int = 512,
     block_k: int = 1024,
+    return_lse: bool = False,
     interpret: Optional[bool] = None,
 ):
     """Drop-in replacement for :func:`models.llama.native_attention`.
 
     q: [B, T, H, D]; k/v: [B, S, Hkv, D].  GQA runs without repeating K/V —
     the kernel's BlockSpecs map each q head to its group's kv head, and dk/dv
-    accumulate the group sum in VMEM scratch.  ``segment_ids`` [B, T] masks
-    cross-segment attention in-kernel (packed sequences at flash speed;
-    requires self-attention shapes, T == S).
+    accumulate the group sum in VMEM scratch.
+
+    ``segment_ids`` [B, T] masks cross-segment attention in-kernel (packed
+    sequences at flash speed; requires self-attention shapes, T == S).
+
+    ``positions``/``kv_positions`` [B, T]/[B, S] give explicit global token
+    positions for the causal mask — the ring-CP path, where each shard holds
+    non-contiguous (zigzag) slices of the global sequence.
+
+    ``return_lse`` additionally returns the per-token logsumexp [B, T, H]
+    (differentiable) so partial attentions can be combined blockwise.
     """
     b, t, h, d = q.shape
     s, hkv = k.shape[1], k.shape[2]
@@ -449,11 +491,29 @@ def flash_attention(
         seg_q = jnp.zeros((b, 1, t), jnp.int32)
         seg_kv = jnp.zeros((b, 1, s), jnp.int32)
 
+    positioned = positions is not None
+    if positioned:
+        pos_q = jnp.asarray(positions, jnp.int32)[:, None, :]
+        pos_kv = jnp.asarray(
+            positions if kv_positions is None else kv_positions, jnp.int32
+        )[:, None, :]
+        if pos_q.shape[-1] != t:
+            raise ValueError("positions length must match the query sequence")
+        if pos_kv.shape[-1] != s:
+            raise ValueError("kv_positions length must match the KV sequence")
+    else:
+        pos_q = jnp.zeros((b, 1, t), jnp.int32)
+        pos_kv = jnp.zeros((b, 1, s), jnp.int32)
+
     def to_bhd(x, heads, length):  # [B, L, H, D] -> [B*H, L, D]
         return x.transpose(0, 2, 1, 3).reshape(b * heads, length, d)
 
-    out = _flash(
+    out, lse = _flash(
         to_bhd(q, h, t), to_bhd(k, hkv, s), to_bhd(v, hkv, s), seg_q, seg_kv,
-        causal, sm_scale, block_q, block_k, segmented, interpret,
+        pos_q, pos_kv, causal, sm_scale, block_q, block_k, segmented, positioned,
+        interpret,
     )
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    if return_lse:
+        return out, lse.reshape(b, h, t).transpose(0, 2, 1)
+    return out

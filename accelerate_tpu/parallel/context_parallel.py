@@ -94,15 +94,33 @@ def _zigzag_positions(t_local: int, t_global: int, cp_rank, cp: int):
     return jnp.concatenate([first + jnp.arange(chunk), second + jnp.arange(chunk)])
 
 
+def _combine_lse(a, b):
+    """Combine two (out, lse) partial attentions (out [B,T,H,D], lse
+    [B,T,H]) — the flash-kernel-block path; fully differentiable."""
+    out_a, lse_a = a
+    out_b, lse_b = b
+    m = jnp.maximum(lse_a, lse_b)
+    wa = jnp.exp(lse_a - m)
+    wb = jnp.exp(lse_b - m)
+    den = jnp.maximum(wa + wb, 1e-30)
+    out = out_a * (wa / den)[..., None] + out_b * (wb / den)[..., None]
+    return out, m + jnp.log(den)
+
+
 def ring_attention_sharded(
     q, k, v, *, axis_name: str = "cp", causal: bool = True, sm_scale: Optional[float] = None,
-    rotate_method: str = "alltoall", zigzag: bool = True,
+    rotate_method: str = "alltoall", zigzag: bool = True, use_flash: Optional[bool] = None,
 ):
     """The shard_map body: q/k/v are LOCAL shards [B, T/cp, H, D].
 
     With ``alltoall`` KV rotates ``cp`` times around the ring (ppermute);
     with ``allgather`` KV is gathered once and attention is a single local
     block.  Causal masks are built from global zigzag positions.
+
+    ``use_flash`` (default: on TPU) computes each (q-shard, kv-shard) block
+    with the Pallas flash kernel — global zigzag positions feed the kernel's
+    position-based causal mask, and blocks combine via the kernel's
+    differentiable logsumexp output.  Off-TPU the XLA blockwise path runs.
     """
     cp = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
@@ -110,60 +128,96 @@ def ring_attention_sharded(
     t_global = t_local * cp
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
+    if use_flash is None:
+        from ..ops.flash_attention import _on_tpu
+
+        use_flash = _on_tpu()
 
     if zigzag and causal:
         q_pos = _zigzag_positions(t_local, t_global, rank, cp)
     else:
         q_pos = rank * t_local + jnp.arange(t_local)
 
+    def pos_for(kv_rank):
+        if zigzag and causal:
+            return _zigzag_positions(t_local, t_global, kv_rank, cp)
+        return kv_rank * t_local + jnp.arange(t_local)
+
     def mask_for(kv_rank):
         if not causal:
             return None
-        if zigzag:
-            k_pos = _zigzag_positions(t_local, t_global, kv_rank, cp)
-        else:
-            k_pos = kv_rank * t_local + jnp.arange(t_local)
-        return q_pos[:, None] >= k_pos[None, :]
+        return q_pos[:, None] >= pos_for(kv_rank)[None, :]
+
+    if use_flash:
+        from ..ops.flash_attention import flash_attention
+
+        pos_q_b = jnp.broadcast_to(q_pos, (b, t_local))
+
+        def attend(kv_pos, k_blk, v_blk):
+            out, lse = flash_attention(
+                q, k_blk, v_blk, causal=causal, sm_scale=sm_scale,
+                positions=pos_q_b if causal else None,
+                kv_positions=jnp.broadcast_to(kv_pos, (b, t_local)) if causal else None,
+                return_lse=True,
+            )
+            return out.astype(jnp.float32), lse
+
+        zero = (
+            jnp.zeros((b, t_local, h, d), jnp.float32),
+            jnp.full((b, t_local, h), NEG_INF, jnp.float32),
+        )
+        combine = _combine_lse
+    else:
+        zero = (
+            jnp.zeros((b, t_local, h, d), jnp.float32),
+            jnp.zeros((b, t_local, h, 1), jnp.float32),
+            jnp.full((b, t_local, h, 1), NEG_INF, jnp.float32),
+        )
+        combine = _combine
 
     if rotate_method == "allgather":
         k_all = lax.all_gather(k, axis_name, axis=0, tiled=False)  # [cp, B, T/cp, H, D]
         v_all = lax.all_gather(v, axis_name, axis=0, tiled=False)
-        acc = None
+        acc = zero
         for kv_rank in range(cp):
-            part = _block_attend(q, k_all[kv_rank], v_all[kv_rank], mask_for(kv_rank), sm_scale)
-            acc = part if acc is None else _combine(acc, part)
-        num, den, _ = acc
-        return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+            if use_flash:
+                part = attend(pos_for(kv_rank), k_all[kv_rank], v_all[kv_rank])
+            else:
+                part = _block_attend(q, k_all[kv_rank], v_all[kv_rank], mask_for(kv_rank), sm_scale)
+            acc = combine(acc, part)
+    else:
+        # ring: step s sees KV originally from rank (rank - s) mod cp
+        def ring_step(s, carry):
+            k_cur, v_cur, acc = carry
+            kv_rank = (rank - s) % cp
+            if use_flash:
+                part = attend(pos_for(kv_rank), k_cur, v_cur)
+            else:
+                mask = None
+                if causal:
+                    # select the right mask for this step's kv source rank
+                    mask = jnp.stack([mask_for(r) for r in range(cp)])[kv_rank]
+                part = _block_attend(q, k_cur, v_cur, mask, sm_scale)
+            acc = combine(acc, part)
+            perm = [(i, (i + 1) % cp) for i in range(cp)]
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            return (k_nxt, v_nxt, acc)
 
-    # ring: step s sees KV originally from rank (rank - s) mod cp
-    def ring_step(s, carry):
-        k_cur, v_cur, acc = carry
-        kv_rank = (rank - s) % cp
-        masks = [mask_for(r) for r in range(cp)]
-        mask = None
-        if causal:
-            # select the right mask for this step's kv source rank
-            mask = jnp.stack(masks)[kv_rank]
-        part = _block_attend(q, k_cur, v_cur, mask, sm_scale)
-        acc = _combine(acc, part)
-        perm = [(i, (i + 1) % cp) for i in range(cp)]
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (k_nxt, v_nxt, acc)
+        carry = (k, v, zero)
+        for s in range(cp):  # unrolled: cp is small; lets XLA overlap ppermute+compute
+            carry = ring_step(s, carry)
+        acc = carry[2]
 
-    zero_acc = (
-        jnp.zeros((b, t_local, h, d), jnp.float32),
-        jnp.zeros((b, t_local, h, 1), jnp.float32),
-        jnp.full((b, t_local, h, 1), NEG_INF, jnp.float32),
-    )
-    carry = (k, v, zero_acc)
-    for s in range(cp):  # unrolled: cp is small; lets XLA overlap ppermute+compute
-        carry = ring_step(s, carry)
-    _, _, (num, den, _) = carry
+    if use_flash:
+        out, _ = acc
+        return out.astype(q.dtype)
+    num, den, _ = acc
     return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = "alltoall", zigzag: bool = True):
+def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = "alltoall",
+                        zigzag: bool = True, use_flash: Optional[bool] = None):
     """Build the mesh-bound ring attention usable inside a jitted model.
 
     Returns ``attn(q, k, v, causal=True, segment_ids=None)`` operating on
@@ -182,7 +236,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = 
         spec = P(None, axis_name, None, None)
         body = functools.partial(
             ring_attention_sharded, axis_name=axis_name, causal=causal,
-            rotate_method=rotate_method, zigzag=zigzag,
+            rotate_method=rotate_method, zigzag=zigzag, use_flash=use_flash,
         )
         return shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False

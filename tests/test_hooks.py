@@ -1,0 +1,139 @@
+"""Functional hooks engine tests (reference tests/test_hooks.py surface:
+hook lifecycle, sequential composition, attach/remove idempotence, device
+alignment with offloaded weights, layerwise casting)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.hooks import (
+    AlignDevicesHook,
+    CpuOffloadHook,
+    LayerwiseCastingHook,
+    ModelHook,
+    SequentialHook,
+    add_hook_to_apply,
+    attach_align_device_hook,
+    remove_hook_from_apply,
+)
+
+
+def _apply(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def _params():
+    rng = np.random.default_rng(0)
+    return {
+        "w": jnp.asarray(rng.standard_normal((4, 3)), jnp.float32),
+        "b": jnp.zeros(3, jnp.float32),
+    }
+
+
+def test_hook_pre_and_post_forward():
+    calls = []
+
+    class Scale(ModelHook):
+        def pre_forward(self, params, *args, **kwargs):
+            calls.append("pre")
+            return jax.tree.map(lambda p: p * 2, params), args, kwargs
+
+        def post_forward(self, params, output):
+            calls.append("post")
+            return output + 1
+
+    params, x = _params(), jnp.ones((2, 4))
+    wrapped = add_hook_to_apply(_apply, Scale())
+    out = wrapped(params, x)
+    ref = _apply(jax.tree.map(lambda p: p * 2, params), x) + 1
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+    assert calls == ["pre", "post"]
+
+
+def test_sequential_hook_order():
+    order = []
+
+    def mk(tag):
+        class H(ModelHook):
+            def pre_forward(self, params, *args, **kwargs):
+                order.append(f"pre-{tag}")
+                return params, args, kwargs
+
+            def post_forward(self, params, output):
+                order.append(f"post-{tag}")
+                return output
+
+        return H()
+
+    wrapped = add_hook_to_apply(_apply, SequentialHook(mk("a"), mk("b")))
+    wrapped(_params(), jnp.ones((1, 4)))
+    # pre in order, post reversed (reference SequentialHook semantics)
+    assert order == ["pre-a", "pre-b", "post-b", "post-a"]
+
+
+def test_add_replaces_unless_append():
+    class AddOne(ModelHook):
+        def post_forward(self, params, output):
+            return output + 1
+
+    params, x = _params(), jnp.ones((1, 4))
+    base = float(_apply(params, x).sum())
+    once = add_hook_to_apply(_apply, AddOne())
+    replaced = add_hook_to_apply(once, AddOne())  # replace: still +1
+    appended = add_hook_to_apply(once, AddOne(), append=True)  # chain: +2
+    assert float(replaced(params, x).sum()) == pytest.approx(base + 3)   # 3 outputs
+    assert float(appended(params, x).sum()) == pytest.approx(base + 6)
+
+
+def test_remove_hook_restores_original():
+    class AddOne(ModelHook):
+        def post_forward(self, params, output):
+            return output + 1
+
+    wrapped = add_hook_to_apply(_apply, AddOne())
+    restored = remove_hook_from_apply(wrapped)
+    assert restored is _apply
+    assert remove_hook_from_apply(_apply) is _apply  # no-op without hook
+
+
+def test_align_devices_hook_ships_host_params():
+    params = {k: np.asarray(v) for k, v in _params().items()}  # host numpy
+    wrapped = attach_align_device_hook(_apply)
+    out = wrapped(params, jnp.ones((2, 4)))
+    assert isinstance(out, jax.Array)
+    ref = _apply({k: jnp.asarray(v) for k, v in params.items()}, jnp.ones((2, 4)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_align_devices_hook_reads_offload_store(tmp_path):
+    from accelerate_tpu.big_modeling import offload_state_dict
+
+    params = {k: np.asarray(v) for k, v in _params().items()}
+    store = offload_state_dict(str(tmp_path), params)
+    lazy = {k: store.load(k) for k in params}  # np.memmap leaves
+    wrapped = attach_align_device_hook(_apply)
+    out = wrapped(lazy, jnp.ones((2, 4)))
+    ref = _apply({k: jnp.asarray(v) for k, v in params.items()}, jnp.ones((2, 4)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_cpu_offload_hook():
+    wrapped = add_hook_to_apply(_apply, CpuOffloadHook())
+    out = wrapped(_params(), jnp.ones((2, 4)))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_layerwise_casting_hook():
+    from accelerate_tpu.ops.precision import layerwise_casting
+
+    params = {"dense": {"kernel": jnp.asarray(np.random.default_rng(0).standard_normal((4, 3)), jnp.float32) * 0.1}}
+    cast, _ = layerwise_casting(params, jnp.float8_e4m3fn, jnp.float32, skip_patterns=())
+
+    def apply_fn(p, x):
+        return x @ p["dense"]["kernel"]
+
+    wrapped = add_hook_to_apply(apply_fn, LayerwiseCastingHook(jnp.float8_e4m3fn, jnp.float32))
+    out = wrapped(cast, jnp.ones((2, 4)))
+    ref = apply_fn(jax.tree.map(lambda x: x.astype(jnp.float32), cast), jnp.ones((2, 4)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
