@@ -482,7 +482,13 @@ class Accelerator:
         loss_scale = DynamicLossScale() if self.policy.needs_loss_scaling else None
         mode = self.gradient_state.plugin.mode
         accum_needed = self.gradient_state.num_steps > 1 and mode == "across_steps"
-        grad_accum = _tree_zeros_like(params) if accum_needed else None
+        if accum_needed and plan is not None:
+            # accumulation buffers shard exactly like the params (plain
+            # _tree_zeros_like leaves would be uncommitted and later pinned
+            # replicated — a full gradient copy per device under FSDP)
+            grad_accum = jax.jit(_tree_zeros_like, out_shardings=plan)(params)
+        else:
+            grad_accum = _tree_zeros_like(params) if accum_needed else None
         state = TrainState(
             step=jnp.int32(0),
             params=params,
@@ -494,6 +500,26 @@ class Accelerator:
             apply_fn=apply_fn,
             tx=tx,
         )
+        if sharded:
+            # Scalar members (step/rng/loss-scale counters) must live on the
+            # same device set as the mesh-sharded params, or jit rejects the
+            # mixed device sets.  jit-identity (not device_put) so placement
+            # works multi-process, where the mesh spans non-addressable
+            # devices.  Only genuine scalars/keys — never accidentally
+            # replicate a full-size uncommitted array.
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            _place = jax.jit(lambda x: x, out_shardings=replicated)
+
+            def _replicate_scalar(x):
+                if (
+                    isinstance(x, jax.Array)
+                    and not isinstance(x.sharding, NamedSharding)
+                    and (x.ndim == 0 or jnp.issubdtype(x.dtype, jax.dtypes.prng_key))
+                ):
+                    return _place(x)
+                return x
+
+            state = jax.tree_util.tree_map(_replicate_scalar, state)
         self._state_sharding = jax.tree_util.tree_map(
             lambda x: x.sharding if isinstance(x, jax.Array) else None,
             state,
@@ -669,7 +695,30 @@ class Accelerator:
                     metrics["aux"] = aux
                 return new_state, metrics
 
-        jitted = jax.jit(step_fn, donate_argnums=(0,) if donate_state else ())
+        # Pin the returned state to the plan's shardings: without this, GSPMD
+        # propagation may prefer a compute-time layout and reshard the whole
+        # param tree at step entry every step ("involuntary full
+        # rematerialization" under cp/sp + FSDP joint-axis sharding).  Input
+        # shardings come from the committed arrays; constraining the output
+        # pins both ends of the steady-state loop.  self._state_sharding is
+        # read at trace time (not prepare time) so prepare/create ordering
+        # doesn't matter, and a structure mismatch (state from a different
+        # create_train_state) degrades to the unpinned behavior.
+        def pinned_step_fn(state, batch):
+            new_state, metrics = step_fn(state, batch)
+            state_sharding = self._state_sharding
+            if state_sharding is not None:
+                try:
+                    new_state = jax.tree_util.tree_map(
+                        lambda x, s: jax.lax.with_sharding_constraint(x, s)
+                        if isinstance(s, NamedSharding) else x,
+                        new_state, state_sharding,
+                    )
+                except ValueError:
+                    pass
+            return new_state, metrics
+
+        jitted = jax.jit(pinned_step_fn, donate_argnums=(0,) if donate_state else ())
 
         def wrapped(state, batch):
             self.step_count += 1

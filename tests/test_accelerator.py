@@ -300,3 +300,23 @@ def test_train_step_has_aux_with_accumulation():
     x = jnp.arange(16.0)  # microbatches of 4: last starts at 12
     state, metrics = step(state, {"x": x, "y": jnp.zeros(16)})
     assert float(metrics["aux"]["x_first"]) == 12.0
+
+
+def test_grad_accum_buffers_shard_like_params():
+    """across_steps accumulation buffers must inherit FSDP shardings — an
+    uncommitted/replicated grad_accum would be a full gradient copy per
+    device (regression for the scalar-replication pin)."""
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=2, mode="across_steps"),
+    )
+    params = {"w": jnp.zeros((64, 16), jnp.float32), "b": jnp.zeros((64,), jnp.float32)}
+    state = acc.create_train_state(params, optax.sgd(0.1))
+    assert state.grad_accum is not None
+    w_spec = state.params["w"].sharding.spec
+    accum_spec = state.grad_accum["w"].sharding.spec
+    assert accum_spec == w_spec, (accum_spec, w_spec)
+    # scalars replicated on the mesh (not single-device)
+    assert state.step.sharding.spec == jax.sharding.PartitionSpec()
