@@ -129,6 +129,8 @@ def ring_attention_sharded(
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
     if use_flash is None:
+        # fallback when called directly as a shard_map body; make_ring_attention
+        # resolves this from the mesh's own devices instead
         from ..ops.flash_attention import _on_tpu
 
         use_flash = _on_tpu()
@@ -223,6 +225,10 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = 
     Returns ``attn(q, k, v, causal=True, segment_ids=None)`` operating on
     GLOBAL arrays whose sequence dim is sharded over ``axis_name``.
     """
+    if use_flash is None:
+        # decide from the mesh's own devices, not the process default backend
+        # (a CPU debug mesh on a TPU-attached host must take the XLA path)
+        use_flash = mesh.devices.flat[0].platform == "tpu"
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         if segment_ids is not None:

@@ -54,6 +54,14 @@ def ulysses_attention_sharded(q, k, v, *, axis_name: str = "sp", causal: bool = 
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Optional[Callable] = None):
     """Mesh-bound Ulysses attention on GLOBAL arrays (seq dim sharded over
     ``axis_name``)."""
+    if inner_attn is None and mesh.devices.flat[0].platform == "tpu":
+        # post-all_to_all attention is plain full-sequence attention over the
+        # local heads — the Pallas flash kernel applies directly.  Decided
+        # from the mesh's own devices (not the process default backend) so a
+        # CPU debug mesh on a TPU-attached host still gets the native path.
+        from ..ops.flash_attention import flash_attention
+
+        inner_attn = flash_attention
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         if segment_ids is not None:

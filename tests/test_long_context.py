@@ -260,3 +260,18 @@ def test_flash_positions_and_lse():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     ref_lse = jax.nn.logsumexp(s, -1).transpose(0, 2, 1)  # [B, T, H]
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_flash_inner_matches_native(sp_mesh, causal):
+    """Ulysses with the flash kernel as the inner attention (the TPU path)."""
+    from accelerate_tpu.parallel.sequence_parallel import make_ulysses_attention
+
+    q, k, v = _qkv(t=32, h=4)
+    ref = native_attention(q, k, v, causal=causal)
+    inner = lambda q, k, v, causal: flash_attention(q, k, v, causal=causal, block_q=8, block_k=8, interpret=True)
+    attn = make_ulysses_attention(sp_mesh, inner_attn=inner)
+    spec = NamedSharding(sp_mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    out = attn(qs, ks, vs, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
