@@ -84,3 +84,7 @@ try:
     )
 except ImportError:  # pragma: no cover
     pass
+try:
+    from .generation import GenerationConfig, generate, generate_seq2seq, sample_logits
+except ImportError:  # pragma: no cover
+    pass

@@ -868,6 +868,60 @@ class Accelerator:
         yield
 
     @contextlib.contextmanager
+    def maybe_context_parallel(self, buffers=None, buffer_seq_dims=None,
+                               no_restore_buffers=None):
+        """Per-step context-parallel buffer sharding (reference
+        maybe_context_parallel :4076-4140).
+
+        The reference mutates torch tensors in place and restores them on
+        exit; JAX arrays are immutable, so this manager instead **yields the
+        CP-sharded buffers**: each is zigzag-reordered along its sequence dim
+        (load-balanced causal ordering, parallel/context_parallel.py) and
+        device_put with the sequence dim sharded over ``cp``.  Use the
+        yielded list inside the step::
+
+            shift_labels = np.roll(batch["labels"], -1, axis=1)
+            shift_labels[:, -1] = -100  # next-token align BEFORE sharding
+            with accelerator.maybe_context_parallel(
+                buffers=[batch["input_ids"], shift_labels], buffer_seq_dims=[1, 1]
+            ) as (input_ids, labels):
+                state, metrics = step(state, {"input_ids": input_ids, "shift_labels": labels})
+
+        Like the reference, this is a silent no-op (yields the buffers
+        unchanged) when ``cp_size <= 1``, so the same loop runs everywhere.
+        ``no_restore_buffers`` is accepted for signature parity; restoration
+        is moot without mutation.
+
+        As in the reference (context_parallelism.md:113-121), labels must be
+        **pre-shifted** before sharding: after the zigzag reorder "the next
+        position" is no longer the next array index, so in-model label
+        shifting would be wrong.  The model loss factories accept the
+        pre-shifted labels under the ``shift_labels`` batch key.
+        """
+        if buffers is None:
+            yield []
+            return
+        pcfg = self.parallelism_config
+        if pcfg is None or pcfg.cp_size <= 1:
+            yield list(buffers)
+            return
+        from .parallel.context_parallel import zigzag_shard
+
+        cp = pcfg.cp_size
+        seq_dims = buffer_seq_dims or [1] * len(buffers)
+        if len(seq_dims) != len(buffers):
+            raise ValueError("buffer_seq_dims must match buffers in length")
+        sharded = []
+        for buf, dim in zip(buffers, seq_dims):
+            arr = zigzag_shard(buf, cp, axis=dim)
+            spec = [None] * np.asarray(buf).ndim
+            spec[dim] = "cp"
+            sharded.append(
+                jax.device_put(arr, NamedSharding(self.mesh, PartitionSpec(*spec)))
+            )
+        yield sharded
+
+    @contextlib.contextmanager
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
         """jax.profiler trace context (reference profile :4168)."""
         handler = profile_handler or self.profile_kwargs

@@ -2,8 +2,10 @@
 (reference commands/accelerate_cli.py:28, 8 subcommands).
 
 Subcommands: config, env, launch, test, estimate-memory, merge-weights,
-tpu-config.  (The reference's ``to-fsdp2`` config converter has no analog —
-under GSPMD every strategy is already a sharding config of one mechanism.)
+tpu-config, from-accelerate.  (The reference's ``to-fsdp2`` config converter
+maps to ``from-accelerate`` — under GSPMD every strategy is already a
+sharding config of one mechanism, so the conversion worth shipping is from
+the reference's format into ours.)
 """
 
 from __future__ import annotations
@@ -13,6 +15,7 @@ import argparse
 from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
+from .from_accelerate import from_accelerate_command_parser
 from .launch import launch_command_parser
 from .merge import merge_command_parser
 from .test import test_command_parser
@@ -35,6 +38,7 @@ def main():
     estimate_command_parser(subparsers)
     merge_command_parser(subparsers)
     tpu_command_parser(subparsers)
+    from_accelerate_command_parser(subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

@@ -159,11 +159,69 @@ def get_attention_impl(name: str) -> Callable:
     raise ValueError(f"unknown attention implementation {name!r}")
 
 
+# Sentinel position for unwritten / padding cache slots: larger than any real
+# token position, so the causal comparison `kv_pos <= q_pos` excludes them.
+CACHE_PAD_POSITION = np.int32(2**30)
+
+
+def init_cache(config, batch_size: int, max_len: int, dtype=None):
+    """Pre-allocated per-layer KV cache for autoregressive decoding.
+
+    Each layer holds ``k``/``v`` [B, max_len, Hkv, D], per-slot global
+    positions ``pos`` [B, max_len] (``CACHE_PAD_POSITION`` marks dead slots —
+    the liveness mask is positional, so right-padded prompts and post-EOS
+    slots are excluded the same way), and the scalar write ``index``.
+
+    TPU-native analog of the engines' paged/contiguous KV caches the
+    reference delegates generation to (big-model inference,
+    reference big_modeling.py:513 + benchmarks/big_model_inference).
+    """
+    dtype = dtype or config.dtype
+    hkv, d = config.num_key_value_heads, config.head_dim
+    return [
+        {
+            "k": jnp.zeros((batch_size, max_len, hkv, d), dtype),
+            "v": jnp.zeros((batch_size, max_len, hkv, d), dtype),
+            "pos": jnp.full((batch_size, max_len), CACHE_PAD_POSITION, jnp.int32),
+            "index": jnp.zeros((), jnp.int32),
+        }
+        for _ in range(config.num_hidden_layers)
+    ]
+
+
+def cached_attention(q, k_cache, v_cache, kv_positions, q_positions):
+    """Decode-path attention against a pre-allocated KV cache.
+
+    q: [B, T, H, D]; k_cache/v_cache: [B, S, Hkv, D]; kv_positions: [B, S]
+    per-slot global positions (``CACHE_PAD_POSITION`` = dead slot);
+    q_positions: [B, T].  The causal mask ``kv_pos <= q_pos`` doubles as the
+    liveness mask.  Plain XLA einsum — at decode shapes (T=1..few) the op is
+    HBM-bound on the cache read and fuses fine without the flash kernel.
+    """
+    b, t, h, d = q.shape
+    s, hkv = k_cache.shape[1], k_cache.shape[2]
+    if hkv != h:
+        # grouped contraction keeps the cache read at kv-head width (no
+        # materialized H-wide repeat in the decode loop's hot HBM path)
+        g = h // hkv
+        qg = q.reshape(b, t, hkv, g, d)
+        scores = jnp.einsum("bthgd,bshd->bhgts", qg, k_cache).astype(jnp.float32) / np.sqrt(d)
+        mask = kv_positions[:, None, None, None, :] <= q_positions[:, None, None, :, None]
+        probs = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhgts,bshd->bthgd", probs, v_cache)
+        return out.reshape(b, t, h, d)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k_cache).astype(jnp.float32) / np.sqrt(d)
+    mask = kv_positions[:, None, None, :] <= q_positions[:, None, :, None]  # [B,1,T,S]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v_cache)
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None):
         cfg = self.config
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
         q = dense(cfg.num_attention_heads * cfg.head_dim, name="q_proj")(x)
@@ -178,6 +236,22 @@ class LlamaAttention(nn.Module):
         cos, sin = jnp.asarray(cos), jnp.asarray(sin)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
+
+        if cache is not None:
+            # autoregressive path: write this chunk's K/V + positions at the
+            # cache index, attend against the whole cache (the positional
+            # comparison kv_pos <= q_pos masks dead slots and padding)
+            idx = cache["index"]
+            pos_write = positions.astype(jnp.int32)
+            if cache_write_mask is not None:
+                pos_write = jnp.where(cache_write_mask, pos_write, CACHE_PAD_POSITION)
+            k_cache = jax.lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype), (0, idx, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype), (0, idx, 0, 0))
+            pos_cache = jax.lax.dynamic_update_slice(cache["pos"], pos_write, (0, idx))
+            out = cached_attention(q, k_cache, v_cache, pos_cache, positions)
+            new_cache = {"k": k_cache, "v": v_cache, "pos": pos_cache, "index": idx + t}
+            out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
+            return dense(cfg.hidden_size, name="o_proj")(out), new_cache
 
         attn = get_attention_impl(cfg.attn_implementation)
         out = attn(q, k, v, causal=True, segment_ids=segment_ids)
@@ -201,14 +275,20 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None):
         cfg = self.config
-        h = x + LlamaAttention(cfg, name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x), positions, segment_ids
-        )
+        attn_in = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x)
+        attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, segment_ids, cache,
+                                                     cache_write_mask)
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = attn
+        h = x + attn
         out = h + LlamaMLP(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h)
         )
+        if cache is not None:
+            return out, new_cache
         return out
 
 
@@ -242,28 +322,38 @@ class LlamaForCausalLM(nn.Module):
     block_cls = LlamaBlock  # class attribute, not a dataclass field
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, segment_ids=None, output_hidden: bool = False):
+    def __call__(self, input_ids, positions=None, segment_ids=None, output_hidden: bool = False,
+                 cache=None, cache_write_mask=None):
         cfg = self.config
         if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+            base = jnp.arange(input_ids.shape[1])
+            if cache is not None:
+                base = base + cache[0]["index"]
+            positions = jnp.broadcast_to(base, input_ids.shape)
         embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32, name="embed_tokens"
         )
         x = embed(input_ids)
         block = type(self).block_cls
-        if cfg.remat:
+        if cfg.remat and cache is None:
             policy = {
                 "full": jax.checkpoint_policies.nothing_saveable,
                 "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             }[cfg.remat_policy]
             block = nn.remat(block, policy=policy)
+        new_cache = [] if cache is not None else None
         for i in range(cfg.num_hidden_layers):
-            x = block(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+            layer = block(cfg, name=f"layers_{i}")
+            if cache is not None:
+                x, layer_cache = layer(x, positions, segment_ids, cache[i], cache_write_mask)
+                new_cache.append(layer_cache)
+            else:
+                x = layer(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         if output_hidden:
             # pre-head states for the fused linear+CE loss path (the vocab
             # projection happens inside the loss, chunked over the vocab)
-            return x
+            return (x, new_cache) if cache is not None else x
         # Head matmul in compute dtype with fp32 accumulation: an fp32 matmul
         # runs at a fraction of MXU rate, and with vocab-sized output this is
         # ~10% of the model's FLOPs — bf16 operands + preferred_element_type
@@ -271,19 +361,30 @@ class LlamaForCausalLM(nn.Module):
         if cfg.tie_word_embeddings:
             head_w = embed.embedding.astype(cfg.dtype)  # [V, H]
             contract = (((x.ndim - 1,), (1,)), ((), ()))
-            return jax.lax.dot_general(x, head_w, contract, preferred_element_type=jnp.float32)
-        return LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x)
+            logits = jax.lax.dot_general(x, head_w, contract, preferred_element_type=jnp.float32)
+        else:
+            logits = LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x)
+        return (logits, new_cache) if cache is not None else logits
 
 
-def causal_lm_loss(logits, labels, ignore_index: int = -100):
+def causal_lm_loss(logits, labels, ignore_index: int = -100, shifted: bool = False):
     """Shifted next-token cross-entropy (matches transformers CausalLM loss).
 
     Formulated as ``logsumexp - label_logit`` so the [B, T, V] log-softmax
     tensor is never materialized (one reduction pass over the vocab axis
     instead of a full fp32 logp array — vocab-sized HBM traffic halved).
+
+    ``shifted=True`` means ``labels`` are already next-token aligned with
+    ``logits`` position-by-position — REQUIRED under context parallelism,
+    where the sequence is zigzag-sharded and "the next position" is not the
+    next array index (reference context_parallelism.md:113-121: shift labels
+    *before* sharding, pass as ``shift_labels``).
     """
-    logits = logits[:, :-1].astype(jnp.float32)
-    labels = labels[:, 1:]
+    if shifted:
+        logits = logits.astype(jnp.float32)
+    else:
+        logits = logits[:, :-1].astype(jnp.float32)
+        labels = labels[:, 1:]
     mask = labels != ignore_index
     safe_labels = jnp.where(mask, labels, 0)
     lse = jax.nn.logsumexp(logits, axis=-1)
@@ -300,6 +401,8 @@ def make_llama_loss_fn(model: LlamaForCausalLM, fused_vocab_chunks: Optional[int
     if fused_vocab_chunks is None:
         def loss_fn(params, batch):
             logits = model.apply(params, batch["input_ids"], segment_ids=batch.get("segment_ids"))
+            if "shift_labels" in batch:  # pre-shifted (the CP contract)
+                return causal_lm_loss(logits, batch["shift_labels"], shifted=True)
             return causal_lm_loss(logits, batch["labels"])
 
         return loss_fn
@@ -319,9 +422,10 @@ def make_llama_loss_fn(model: LlamaForCausalLM, fused_vocab_chunks: Optional[int
         else:
             weight = inner["lm_head"]["kernel"].astype(cfg.dtype)  # [H, V]
             vocab_major = False
+        shifted = "shift_labels" in batch  # pre-shifted (the CP contract)
         return fused_causal_lm_loss(
-            hidden, weight, batch["labels"], vocab_major=vocab_major,
-            num_chunks=fused_vocab_chunks,
+            hidden, weight, batch["shift_labels"] if shifted else batch["labels"],
+            vocab_major=vocab_major, num_chunks=fused_vocab_chunks, shifted=shifted,
         )
 
     return fused_loss_fn

@@ -115,14 +115,20 @@ class MixtralBlock(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None):
         cfg = self.config
-        h = x + LlamaAttention(cfg, name="self_attn")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x), positions, segment_ids
-        )
+        attn_in = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x)
+        attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, segment_ids, cache,
+                                                     cache_write_mask)
+        new_cache = None
+        if cache is not None:
+            attn, new_cache = attn
+        h = x + attn
         out = h + MixtralSparseMoE(cfg, name="block_sparse_moe")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h)
         )
+        if cache is not None:
+            return out, new_cache
         return out
 
 
@@ -145,7 +151,10 @@ def make_mixtral_loss_fn(model: MixtralForCausalLM):
             params, batch["input_ids"], segment_ids=batch.get("segment_ids"),
             mutable=["intermediates"],
         )
-        loss = causal_lm_loss(logits, batch["labels"])
+        if "shift_labels" in batch:  # pre-shifted (the CP contract)
+            loss = causal_lm_loss(logits, batch["shift_labels"], shifted=True)
+        else:
+            loss = causal_lm_loss(logits, batch["labels"])
         inter = mods.get("intermediates", {})
         aux = [v for k, v in _iter_sown(inter) if k == "router_aux_loss"]
         zl = [v for k, v in _iter_sown(inter) if k == "router_z_loss"]

@@ -178,26 +178,39 @@ class T5DecoderLayer(nn.Module):
 
 
 class T5ForConditionalGeneration(nn.Module):
-    """``__call__(input_ids, decoder_input_ids, attention_mask) -> logits``."""
+    """``__call__(input_ids, decoder_input_ids, attention_mask) -> logits``.
+
+    Generation support (encode once, decode many): with
+    ``decoder_input_ids=None`` only the encoder runs and the normalized
+    encoder states come back; pass them back via ``encoder_output`` (with
+    ``input_ids=None``) to run only the decoder against the cached states —
+    the split :func:`~accelerate_tpu.generation.generate_seq2seq` drives.
+    """
 
     config: T5Config
 
     @nn.compact
-    def __call__(self, input_ids, decoder_input_ids, attention_mask=None):
+    def __call__(self, input_ids, decoder_input_ids=None, attention_mask=None,
+                 encoder_output=None):
         cfg = self.config
         embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
             name="shared_embedding",
         )
 
-        # encoder
-        x = embed(input_ids)
-        enc_bias = RelativePositionBias(cfg, bidirectional=True, name="enc_rel_bias")(
-            input_ids.shape[1], input_ids.shape[1]
-        )
-        for i in range(cfg.num_layers):
-            x = T5EncoderLayer(cfg, name=f"enc_layers_{i}")(x, enc_bias, attention_mask)
-        enc = RMSNorm(cfg.layer_norm_epsilon, cfg.dtype, name="enc_norm")(x)
+        # encoder (skipped when pre-computed states are supplied)
+        if encoder_output is None:
+            x = embed(input_ids)
+            enc_bias = RelativePositionBias(cfg, bidirectional=True, name="enc_rel_bias")(
+                input_ids.shape[1], input_ids.shape[1]
+            )
+            for i in range(cfg.num_layers):
+                x = T5EncoderLayer(cfg, name=f"enc_layers_{i}")(x, enc_bias, attention_mask)
+            enc = RMSNorm(cfg.layer_norm_epsilon, cfg.dtype, name="enc_norm")(x)
+        else:
+            enc = encoder_output
+        if decoder_input_ids is None:
+            return enc
 
         # decoder
         y = embed(decoder_input_ids)

@@ -48,6 +48,92 @@ def _on_tpu() -> bool:
         return False
 
 
+# VMEM budget the block-size heuristic designs against: ~16 MiB/core on
+# v4/v5e-class chips, minus headroom for double-buffered input tiles and the
+# compiler's own scratch.
+_VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+
+
+def default_block_sizes(t: int, s: int, d: int) -> tuple[int, int]:
+    """Heuristic (block_q, block_k) keyed on sequence lengths and head dim.
+
+    Start from the sweet spot measured at seq 2048 / head_dim≤128 on v5e
+    (512, 1024); clamp to the actual sequence lengths rounded up to the MXU
+    tile (128); then shrink block_k while the fp32 working set (q/k/v tiles +
+    scores tile + accumulator) exceeds the VMEM budget — at head_dim ≥ 256
+    the naive (512, 1024) tiles no longer double-buffer.
+    """
+    round_up = lambda x: max(128, -(-x // 128) * 128)
+    block_q = min(512, round_up(t))
+    block_k = min(1024, round_up(s))
+
+    def working_set(bq, bk):
+        # q, k, v, out-acc tiles in fp32 + the [bq, bk] scores/probs tile
+        return 4 * (bq * d + 2 * bk * d + bq * d + bq * bk)
+
+    while working_set(block_q, block_k) > _VMEM_BUDGET_BYTES and block_k > 128:
+        block_k //= 2
+    while working_set(block_q, block_k) > _VMEM_BUDGET_BYTES and block_q > 128:
+        block_q //= 2
+    return block_q, block_k
+
+
+def autotune_block_sizes(
+    b: int, t: int, h: int, d: int, hkv: Optional[int] = None, *,
+    dtype=jnp.bfloat16, causal: bool = True, candidates=None, iters: int = 3,
+) -> tuple[int, int]:
+    """Measure the best (block_q, block_k) for a shape on the current device.
+
+    Runs a short sweep of forward+backward over candidate tilings and returns
+    the fastest.  Results are cached per (shape, device kind) for the
+    process.  Meant for offline tuning (bench setup), not the hot path —
+    each candidate pays a compile.
+    """
+    key = (b, t, h, d, hkv, str(dtype), causal,
+           getattr(jax.devices()[0], "device_kind", "cpu"))
+    if key in _AUTOTUNE_CACHE:
+        return _AUTOTUNE_CACHE[key]
+    import time
+
+    hkv = hkv or h
+    rng = np.random.default_rng(0)
+    mk = lambda heads: jnp.asarray(rng.normal(size=(b, t, heads, d)), dtype)
+    q, k, v = mk(h), mk(hkv), mk(hkv)
+    if candidates is None:
+        base_q, base_k = default_block_sizes(t, t, d)
+        candidates = {
+            (base_q, base_k), (max(base_q // 2, 128), base_k), (base_q, max(base_k // 2, 128)),
+            (min(1024, base_q * 2), base_k), (256, 256), (512, 512),
+        }
+        # keep MXU-aligned tiles; the kernel clamps to t internally, so
+        # oversized candidates just duplicate the largest feasible tiling
+        candidates = {(bq, bk) for bq, bk in candidates if bq % 128 == 0 and bk % 128 == 0}
+    best, best_dt = None, float("inf")
+    for bq, bk in sorted(candidates):
+        f = jax.jit(lambda q, k, v: jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk).astype(jnp.float32))
+        )(q, k, v))
+        try:
+            jax.block_until_ready(f(q, k, v))  # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = f(q, k, v)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+        except Exception:  # tiling too big for VMEM etc. — skip candidate
+            continue
+        if dt < best_dt:
+            best, best_dt = (bq, bk), dt
+    if best is None:
+        best = default_block_sizes(t, t, d)
+    _AUTOTUNE_CACHE[key] = best
+    return best
+
+
+_AUTOTUNE_CACHE: dict = {}
+
+
 def _zero_oob_rows(x, start: int, limit: int):
     """Zero-fill tile rows past ``limit`` — padded rows of a non-divisible
     last block read garbage (NaN in interpret mode), and 0 * NaN = NaN would
@@ -448,11 +534,12 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_ids=None,
+    kv_segment_ids=None,
     positions=None,
     kv_positions=None,
     sm_scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     return_lse: bool = False,
     interpret: Optional[bool] = None,
 ):
@@ -463,7 +550,10 @@ def flash_attention(
     accumulate the group sum in VMEM scratch.
 
     ``segment_ids`` [B, T] masks cross-segment attention in-kernel (packed
-    sequences at flash speed; requires self-attention shapes, T == S).
+    sequences at flash speed).  ``kv_segment_ids`` [B, S] gives the KV side
+    its own ids when it differs from the query side (ring CP, where KV
+    shards rotate between ranks); without it, self-attention shapes (T == S)
+    are required and the query ids are reused.
 
     ``positions``/``kv_positions`` [B, T]/[B, S] give explicit global token
     positions for the causal mask — the ring-CP path, where each shard holds
@@ -480,14 +570,28 @@ def flash_attention(
         sm_scale = 1.0 / float(np.sqrt(d))
     if interpret is None:
         interpret = not _on_tpu()
+    if block_q is None or block_k is None:
+        bq, bk = default_block_sizes(t, s, d)
+        block_q = block_q or bq
+        block_k = block_k or bk
 
     segmented = segment_ids is not None
     if segmented:
-        if s != t:
-            raise ValueError("segment_ids requires self-attention (T == S)")
-        seg = jnp.asarray(segment_ids, jnp.int32)[:, None, :]  # [B, 1, T]
-        seg_q = seg_kv = seg
+        if kv_segment_ids is None:
+            if s != t:
+                raise ValueError(
+                    "segment_ids without kv_segment_ids requires self-attention (T == S)"
+                )
+            kv_segment_ids = segment_ids
+        seg_q = jnp.asarray(segment_ids, jnp.int32)[:, None, :]  # [B, 1, T]
+        seg_kv = jnp.asarray(kv_segment_ids, jnp.int32)[:, None, :]  # [B, 1, S]
+        if seg_q.shape[-1] != t:
+            raise ValueError("segment_ids length must match the query sequence")
+        if seg_kv.shape[-1] != s:
+            raise ValueError("kv_segment_ids length must match the KV sequence")
     else:
+        if kv_segment_ids is not None:
+            raise ValueError("kv_segment_ids requires segment_ids")
         seg_q = jnp.zeros((b, 1, t), jnp.int32)
         seg_kv = jnp.zeros((b, 1, s), jnp.int32)
 

@@ -147,14 +147,21 @@ fused_linear_xent.defvjp(
 
 
 def fused_causal_lm_loss(hidden, weight, labels, *, vocab_major: bool,
-                         num_chunks: int = 8, ignore_index: int = -100):
+                         num_chunks: int = 8, ignore_index: int = -100,
+                         shifted: bool = False):
     """Shifted next-token CE from pre-head hidden states.
 
     hidden [B, T, H], weight [V, H] (``vocab_major``, e.g. a tied embedding
-    table) or [H, V] (an lm_head kernel), labels [B, T].
+    table) or [H, V] (an lm_head kernel), labels [B, T].  ``shifted=True``:
+    labels are already next-token aligned (the context-parallel contract —
+    see models/llama.py:causal_lm_loss).
     """
-    h = hidden[:, :-1].reshape(-1, hidden.shape[-1])
-    lab = labels[:, 1:].reshape(-1)
+    if shifted:
+        h = hidden.reshape(-1, hidden.shape[-1])
+        lab = labels.reshape(-1)
+    else:
+        h = hidden[:, :-1].reshape(-1, hidden.shape[-1])
+        lab = labels[:, 1:].reshape(-1)
     mask = lab != ignore_index
     safe = jnp.where(mask, lab, 0)
     return fused_linear_xent(h, weight, safe, mask, num_chunks, vocab_major)

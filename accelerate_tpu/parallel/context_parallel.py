@@ -38,11 +38,18 @@ NEG_INF = -1e30
 def _block_attend(q, k, v, scores_mask, sm_scale):
     """One (q-shard, kv-shard) block: returns (numerator, denom, max) in fp32.
 
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; scores_mask: [Tq, Tk] bool or None.
+    q: [B, Tq, H, D]; k/v: [B, Tk, Hkv, D] (GQA broadcast here); scores_mask:
+    [Tq, Tk] or [B, Tq, Tk] bool, or None.
     """
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * sm_scale
     if scores_mask is not None:
-        scores = jnp.where(scores_mask[None, None], scores, NEG_INF)
+        if scores_mask.ndim == 2:
+            scores_mask = scores_mask[None]
+        scores = jnp.where(scores_mask[:, None], scores, NEG_INF)
     m = jnp.max(scores, axis=-1, keepdims=True)  # [B,H,Tq,1]
     # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1 — zero them
     row_valid = m > NEG_INF / 2
@@ -108,14 +115,22 @@ def _combine_lse(a, b):
 
 
 def ring_attention_sharded(
-    q, k, v, *, axis_name: str = "cp", causal: bool = True, sm_scale: Optional[float] = None,
-    rotate_method: str = "alltoall", zigzag: bool = True, use_flash: Optional[bool] = None,
+    q, k, v, seg=None, *, axis_name: str = "cp", causal: bool = True,
+    sm_scale: Optional[float] = None, rotate_method: str = "alltoall",
+    zigzag: bool = True, use_flash: Optional[bool] = None,
 ):
-    """The shard_map body: q/k/v are LOCAL shards [B, T/cp, H, D].
+    """The shard_map body: q/k/v are LOCAL shards [B, T/cp, H, D] / [B, T/cp,
+    Hkv, D] (GQA: kv heads stay un-repeated — the flash kernel maps q heads
+    to their group's kv head, the XLA path broadcasts per block — so ppermute
+    moves only Hkv-sized tensors over ICI).
 
     With ``alltoall`` KV rotates ``cp`` times around the ring (ppermute);
     with ``allgather`` KV is gathered once and attention is a single local
     block.  Causal masks are built from global zigzag positions.
+
+    ``seg`` [B, T/cp] are local segment ids (packed sequences): the query
+    side stays put while the KV side travels with K/V around the ring, and
+    cross-segment pairs are masked in-kernel.
 
     ``use_flash`` (default: on TPU) computes each (q-shard, kv-shard) block
     with the Pallas flash kernel — global zigzag positions feed the kernel's
@@ -128,6 +143,11 @@ def ring_attention_sharded(
     t_global = t_local * cp
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
+    # Zigzag needs 2 chunks per rank; indivisible lengths (e.g. a short
+    # model.init trace) cannot have been zigzag_shard-ed by the caller, so
+    # they are contiguous — use contiguous positions.
+    if t_global % (2 * cp) != 0:
+        zigzag = False
     if use_flash is None:
         # fallback when called directly as a shard_map body; make_ring_attention
         # resolves this from the mesh's own devices instead
@@ -145,19 +165,25 @@ def ring_attention_sharded(
             return _zigzag_positions(t_local, t_global, kv_rank, cp)
         return kv_rank * t_local + jnp.arange(t_local)
 
-    def mask_for(kv_rank):
-        if not causal:
-            return None
-        return q_pos[:, None] >= pos_for(kv_rank)[None, :]
+    def mask_for(kv_rank, kv_seg=None):
+        """[Tq, Tk] or [B, Tq, Tk] mask for the XLA path (causal ∧ segment)."""
+        mask = None
+        if causal:
+            mask = q_pos[:, None] >= pos_for(kv_rank)[None, :]
+        if kv_seg is not None:
+            seg_mask = seg[:, :, None] == kv_seg[:, None, :]
+            mask = seg_mask if mask is None else mask[None] & seg_mask
+        return mask
 
     if use_flash:
         from ..ops.flash_attention import flash_attention
 
         pos_q_b = jnp.broadcast_to(q_pos, (b, t_local))
 
-        def attend(kv_pos, k_blk, v_blk):
+        def attend(kv_pos, k_blk, v_blk, kv_seg=None):
             out, lse = flash_attention(
                 q, k_blk, v_blk, causal=causal, sm_scale=sm_scale,
+                segment_ids=seg, kv_segment_ids=kv_seg,
                 positions=pos_q_b if causal else None,
                 kv_positions=jnp.broadcast_to(kv_pos, (b, t_local)) if causal else None,
                 return_lse=True,
@@ -178,38 +204,48 @@ def ring_attention_sharded(
         combine = _combine
 
     if rotate_method == "allgather":
-        k_all = lax.all_gather(k, axis_name, axis=0, tiled=False)  # [cp, B, T/cp, H, D]
+        k_all = lax.all_gather(k, axis_name, axis=0, tiled=False)  # [cp, B, T/cp, Hkv, D]
         v_all = lax.all_gather(v, axis_name, axis=0, tiled=False)
+        seg_all = lax.all_gather(seg, axis_name, axis=0, tiled=False) if seg is not None else None
         acc = zero
         for kv_rank in range(cp):
+            kv_seg = seg_all[kv_rank] if seg is not None else None
             if use_flash:
-                part = attend(pos_for(kv_rank), k_all[kv_rank], v_all[kv_rank])
+                part = attend(pos_for(kv_rank), k_all[kv_rank], v_all[kv_rank], kv_seg)
             else:
-                part = _block_attend(q, k_all[kv_rank], v_all[kv_rank], mask_for(kv_rank), sm_scale)
+                part = _block_attend(
+                    q, k_all[kv_rank], v_all[kv_rank], mask_for(kv_rank, kv_seg), sm_scale
+                )
             acc = combine(acc, part)
     else:
         # ring: step s sees KV originally from rank (rank - s) mod cp
+        perm = [(i, (i + 1) % cp) for i in range(cp)]
+
         def ring_step(s, carry):
-            k_cur, v_cur, acc = carry
+            k_cur, v_cur, seg_cur, acc = carry
             kv_rank = (rank - s) % cp
             if use_flash:
-                part = attend(pos_for(kv_rank), k_cur, v_cur)
+                part = attend(pos_for(kv_rank), k_cur, v_cur, seg_cur)
             else:
                 mask = None
                 if causal:
-                    # select the right mask for this step's kv source rank
+                    # select the right causal mask for this step's kv source rank
                     mask = jnp.stack([mask_for(r) for r in range(cp)])[kv_rank]
+                    if seg_cur is not None:
+                        mask = mask[None] & (seg[:, :, None] == seg_cur[:, None, :])
+                elif seg_cur is not None:
+                    mask = seg[:, :, None] == seg_cur[:, None, :]
                 part = _block_attend(q, k_cur, v_cur, mask, sm_scale)
             acc = combine(acc, part)
-            perm = [(i, (i + 1) % cp) for i in range(cp)]
             k_nxt = lax.ppermute(k_cur, axis_name, perm)
             v_nxt = lax.ppermute(v_cur, axis_name, perm)
-            return (k_nxt, v_nxt, acc)
+            seg_nxt = lax.ppermute(seg_cur, axis_name, perm) if seg_cur is not None else None
+            return (k_nxt, v_nxt, seg_nxt, acc)
 
-        carry = (k, v, zero)
+        carry = (k, v, seg, zero)
         for s in range(cp):  # unrolled: cp is small; lets XLA overlap ppermute+compute
             carry = ring_step(s, carry)
-        acc = carry[2]
+        acc = carry[3]
 
     if use_flash:
         out, _ = acc
@@ -231,22 +267,24 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = 
         use_flash = mesh.devices.flat[0].platform == "tpu"
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
-        if segment_ids is not None:
-            raise NotImplementedError("ring attention does not support segment_ids yet")
-        h_kv = k.shape[2]
-        h_q = q.shape[2]
-        if h_kv != h_q:
-            rep = h_q // h_kv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
         spec = P(None, axis_name, None, None)
+        seg_spec = P(None, axis_name)
         body = functools.partial(
             ring_attention_sharded, axis_name=axis_name, causal=causal,
             rotate_method=rotate_method, zigzag=zigzag, use_flash=use_flash,
         )
+        if segment_ids is None:
+            return shard_map(
+                body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+            )(q, k, v)
+        # NOTE: under zigzag layout the caller shards segment_ids with the
+        # same zigzag_shard reorder as the tokens
+        # (Accelerator.maybe_context_parallel does this for step buffers)
+        # so local ids line up with local tokens.
         return shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-        )(q, k, v)
+            body, mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v, jnp.asarray(segment_ids, jnp.int32))
 
     return attn
 

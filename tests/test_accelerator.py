@@ -320,3 +320,26 @@ def test_grad_accum_buffers_shard_like_params():
     assert accum_spec == w_spec, (accum_spec, w_spec)
     # scalars replicated on the mesh (not single-device)
     assert state.step.sharding.spec == jax.sharding.PartitionSpec()
+
+
+def test_maybe_context_parallel_shards_buffers():
+    """CP per-step buffer sharding (reference maybe_context_parallel :4076):
+    yields zigzag-reordered, cp-sharded buffers; no-op without cp."""
+    from accelerate_tpu.parallel.context_parallel import zigzag_unshard
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(cp_size=8))
+    ids = np.arange(2 * 32).reshape(2, 32).astype(np.int32)
+    with acc.maybe_context_parallel(buffers=[ids, ids], buffer_seq_dims=[1, 1]) as (a, b):
+        assert a.sharding.spec == P(None, "cp")
+        # zigzag round-trips back to the original ordering
+        np.testing.assert_array_equal(zigzag_unshard(np.asarray(a), 8), ids)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_maybe_context_parallel_noop_without_cp():
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
+    ids = np.ones((2, 16), np.int32)
+    with acc.maybe_context_parallel(buffers=[ids], buffer_seq_dims=[1]) as (out,):
+        np.testing.assert_array_equal(np.asarray(out), ids)
+    with acc.maybe_context_parallel() as empty:
+        assert empty == []

@@ -221,5 +221,120 @@ def test_cli_help_lists_subcommands():
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert result.returncode == 0
-    for sub in ("config", "env", "launch", "test", "estimate-memory", "merge-weights", "tpu-config"):
+    for sub in ("config", "env", "launch", "test", "estimate-memory", "merge-weights",
+                "tpu-config", "from-accelerate"):
         assert sub in result.stdout
+
+
+# ---------------------------------------------------------------------------
+# from-accelerate importer (migration path from reference configs)
+# ---------------------------------------------------------------------------
+
+
+def test_from_accelerate_fsdp_config():
+    from accelerate_tpu.commands.from_accelerate import convert
+
+    raw = {
+        "compute_environment": "LOCAL_MACHINE",
+        "distributed_type": "FSDP",
+        "mixed_precision": "bf16",
+        "num_machines": 1,
+        "num_processes": 8,
+        "machine_rank": 0,
+        "use_cpu": False,
+        "debug": False,
+        "fsdp_config": {
+            "fsdp_sharding_strategy": "FULL_SHARD",
+            "fsdp_offload_params": True,
+            "fsdp_activation_checkpointing": True,
+            "fsdp_auto_wrap_policy": "TRANSFORMER_BASED_WRAP",
+            "fsdp_transformer_layer_cls_to_wrap": "BertLayer",
+        },
+    }
+    cfg, notes = convert(raw)
+    assert cfg.use_fsdp and cfg.fsdp_sharding_strategy == "FULL_SHARD"
+    assert cfg.fsdp_offload_params and cfg.fsdp_activation_checkpointing
+    assert cfg.num_processes == 8
+    assert cfg.machine_rank is None  # single machine: rank not meaningful
+    assert any("wrap" in n for n in notes)  # wrap-policy drop explained
+
+
+def test_from_accelerate_deepspeed_zero3():
+    from accelerate_tpu.commands.from_accelerate import convert
+
+    raw = {
+        "distributed_type": "DEEPSPEED",
+        "deepspeed_config": {
+            "zero_stage": 3,
+            "offload_optimizer_device": "cpu",
+            "gradient_accumulation_steps": 4,
+        },
+        "mixed_precision": "fp16",
+    }
+    cfg, notes = convert(raw)
+    assert cfg.use_fsdp and cfg.fsdp_sharding_strategy == "FULL_SHARD"
+    assert cfg.fsdp_offload_params
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.mixed_precision == "bf16"  # fp16 -> bf16 on TPU
+    assert any("zero_stage 3" in n for n in notes)
+
+
+def test_from_accelerate_deepspeed_config_file_refused():
+    """Delegating to an unread DeepSpeed JSON must hard-fail, not silently
+    convert with assumed stage/offload."""
+    from accelerate_tpu.commands.from_accelerate import convert
+
+    with pytest.raises(ValueError, match="DeepSpeed JSON"):
+        convert({"distributed_type": "DEEPSPEED",
+                 "deepspeed_config": {"deepspeed_config_file": "ds3.json"}})
+
+
+def test_from_accelerate_nested_keys_reported():
+    from accelerate_tpu.commands.from_accelerate import convert
+
+    _, notes = convert({
+        "distributed_type": "FSDP",
+        "fsdp_config": {"fsdp_sharding_strategy": "FULL_SHARD",
+                        "fsdp_backward_prefetch": "BACKWARD_PRE"},
+        "parallelism_config": {"parallelism_config_cp_size": 2,
+                               "parallelism_config_cp_comm_strategy": "alltoall"},
+    })
+    assert any("fsdp_config.fsdp_backward_prefetch" in n for n in notes)
+    assert any("parallelism_config.parallelism_config_cp_comm_strategy" in n for n in notes)
+
+
+def test_from_accelerate_parallelism_axes():
+    from accelerate_tpu.commands.from_accelerate import convert
+
+    raw = {
+        "distributed_type": "MULTI_GPU",
+        "parallelism_config": {
+            "parallelism_config_dp_replicate_size": 2,
+            "parallelism_config_dp_shard_size": 4,
+            "parallelism_config_tp_size": 2,
+            "parallelism_config_cp_size": 1,
+        },
+    }
+    cfg, _ = convert(raw)
+    assert (cfg.dp_replicate_size, cfg.dp_shard_size, cfg.tp_size) == (2, 4, 2)
+
+
+def test_from_accelerate_cli_end_to_end(tmp_path):
+    src = tmp_path / "ref.yaml"
+    out = tmp_path / "tpu.yaml"
+    yaml.safe_dump(
+        {"distributed_type": "FSDP", "num_processes": 4, "mixed_precision": "no",
+         "fsdp_config": {"fsdp_sharding_strategy": "FULL_SHARD"},
+         "tpu_use_cluster": False, "gpu_ids": "all"},
+        open(src, "w"),
+    )
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu", "from-accelerate", str(src),
+         "--output", str(out)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stderr
+    assert "dropped gpu_ids" in result.stdout
+    cfg = LaunchConfig.load(out)
+    assert cfg.use_fsdp and cfg.num_processes == 4

@@ -262,6 +262,86 @@ def test_flash_positions_and_lse():
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=1e-4)
 
 
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ring_attention_gqa_no_repeat(cp_mesh, use_flash):
+    """GQA KV shards travel the ring at kv-head width (no pre-repeat)."""
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(1, 32, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    ref = native_attention(q, k, v, causal=True)
+    qz, kz, vz = (jnp.asarray(zigzag_shard(x, 8)) for x in (q, k, v))
+    attn = make_ring_attention(cp_mesh, rotate_method="alltoall", zigzag=True, use_flash=use_flash)
+    out = zigzag_unshard(np.asarray(attn(qz, kz, vz, causal=True)), 8)
+    np.testing.assert_allclose(out, np.asarray(ref), atol=2e-4)
+
+
+@pytest.mark.parametrize("rotate", ["allgather", "alltoall"])
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ring_attention_segment_ids(cp_mesh, rotate, use_flash):
+    """Packed sequences under CP: segment ids rotate with KV; cross-segment
+    attention masked identically to the unsharded native reference."""
+    rng = np.random.default_rng(12)
+    B, T, H, Hkv, D = 2, 32, 4, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+    segs = jnp.asarray(np.repeat([[0] * 10 + [1] * 14 + [2] * 8], B, axis=0), jnp.int32)
+    for causal in (True, False):
+        ref = native_attention(q, k, v, causal=causal, segment_ids=segs)
+        qz, kz, vz = (jnp.asarray(zigzag_shard(x, 8)) for x in (q, k, v))
+        segz = jnp.asarray(zigzag_shard(segs, 8)) if causal else segs
+        attn = make_ring_attention(cp_mesh, rotate_method=rotate, zigzag=causal, use_flash=use_flash)
+        out = zigzag_unshard(np.asarray(attn(qz if causal else q, kz if causal else k,
+                                             vz if causal else v, causal=causal,
+                                             segment_ids=segz)), 8) if causal else \
+            np.asarray(attn(q, k, v, causal=causal, segment_ids=segs))
+        np.testing.assert_allclose(out, np.asarray(ref), atol=2e-4,
+                                   err_msg=f"causal={causal}")
+
+
+def test_ring_attention_segment_ids_differentiable(cp_mesh):
+    """Grads flow through the segment-masked ring path (flash in-kernel)."""
+    rng = np.random.default_rng(13)
+    q, k, v = _qkv(t=16, seed=13)
+    segs = jnp.asarray(np.repeat([[0] * 6 + [1] * 10], 2, axis=0), jnp.int32)
+    attn = make_ring_attention(cp_mesh, rotate_method="alltoall", zigzag=False, use_flash=True)
+    f = lambda q: jnp.sum(attn(q, k, v, causal=True, segment_ids=segs) ** 2)
+    g = lambda q: jnp.sum(native_attention(q, k, v, causal=True, segment_ids=segs) ** 2)
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(q)), np.asarray(jax.grad(g)(q)), atol=2e-4)
+
+
+def test_flash_cross_segment_ids():
+    """Distinct q/kv segment ids (the ring building block) against a masked
+    reference with T != S."""
+    rng = np.random.default_rng(14)
+    B, T, S, H, D = 1, 8, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    seg_q = jnp.asarray([[0] * 4 + [1] * 4], jnp.int32)
+    seg_kv = jnp.asarray([[0] * 10 + [1] * 6], jnp.int32)
+    out = flash_attention(q, k, v, causal=False, segment_ids=seg_q, kv_segment_ids=seg_kv,
+                          block_q=8, block_k=8, interpret=True)
+    s = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D)
+    mask = seg_q[0][:, None] == seg_kv[0][None, :]
+    s = jnp.where(mask[None, None], s, -1e30)
+    ref = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_default_block_sizes_heuristic():
+    """Tiling heuristic: MXU-aligned, seq-clamped, VMEM-bounded."""
+    from accelerate_tpu.ops.flash_attention import _VMEM_BUDGET_BYTES, default_block_sizes
+
+    assert default_block_sizes(2048, 2048, 96) == (512, 1024)  # measured sweet spot
+    bq, bk = default_block_sizes(12, 12, 8)
+    assert bq == 128 and bk == 128  # never below one MXU tile
+    bq, bk = default_block_sizes(8192, 8192, 1024)  # giant head dim must shrink
+    assert 4 * (2 * bq * 1024 + 2 * bk * 1024 + bq * bk) <= _VMEM_BUDGET_BYTES
+    assert bq % 128 == 0 and bk % 128 == 0
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_flash_inner_matches_native(sp_mesh, causal):
     """Ulysses with the flash kernel as the inner attention (the TPU path)."""
