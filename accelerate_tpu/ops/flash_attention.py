@@ -57,14 +57,15 @@ _VMEM_BUDGET_BYTES = 10 * 1024 * 1024
 def default_block_sizes(t: int, s: int, d: int) -> tuple[int, int]:
     """Heuristic (block_q, block_k) keyed on sequence lengths and head dim.
 
-    Start from the sweet spot measured at seq 2048 / head_dim≤128 on v5e
-    (512, 1024); clamp to the actual sequence lengths rounded up to the MXU
-    tile (128); then shrink block_k while the fp32 working set (q/k/v tiles +
-    scores tile + accumulator) exceeds the VMEM budget — at head_dim ≥ 256
-    the naive (512, 1024) tiles no longer double-buffer.
+    Start from the sweet spot measured at seq 2048-8192 / head_dim≤128 on
+    v5e ((1024, 1024) — the autotune sweep at those shapes, worth ~1.5%
+    end-to-end over (512, 1024) on the headline bench); clamp to the actual
+    sequence lengths rounded up to the MXU tile (128); then shrink while the
+    fp32 working set (q/k/v tiles + scores tile + accumulator) exceeds the
+    VMEM budget — at large head_dim the 1024-tiles no longer double-buffer.
     """
     round_up = lambda x: max(128, -(-x // 128) * 128)
-    block_q = min(512, round_up(t))
+    block_q = min(1024, round_up(t))
     block_k = min(1024, round_up(s))
 
     def working_set(bq, bk):
@@ -98,7 +99,10 @@ def autotune_block_sizes(
     hkv = hkv or h
     rng = np.random.default_rng(0)
     mk = lambda heads: jnp.asarray(rng.normal(size=(b, t, heads, d)), dtype)
-    q, k, v = mk(h), mk(hkv), mk(hkv)
+    # distinct inputs per measured iteration: dispatch-level caches (e.g.
+    # remote-tunnel transports) would otherwise short-circuit repeat calls
+    # and the sweep would time the cache, not the kernel
+    inputs = [(mk(h), mk(hkv), mk(hkv)) for _ in range(iters + 1)]
     if candidates is None:
         base_q, base_k = default_block_sizes(t, t, d)
         candidates = {
@@ -110,16 +114,20 @@ def autotune_block_sizes(
         candidates = {(bq, bk) for bq, bk in candidates if bq % 128 == 0 and bk % 128 == 0}
     best, best_dt = None, float("inf")
     for bq, bk in sorted(candidates):
-        f = jax.jit(lambda q, k, v: jax.grad(
-            lambda q, k, v: jnp.sum(flash_attention(
-                q, k, v, causal=causal, block_q=bq, block_k=bk).astype(jnp.float32))
-        )(q, k, v))
+        # sum-of-grad-norms gives a scalar to fetch — a host transfer is the
+        # only reliable full-execution sync on tunneled backends
+        def score(q, k, v, bq=bq, bk=bk):
+            g = jax.grad(lambda q: jnp.sum(flash_attention(
+                q, k, v, causal=causal, block_q=bq, block_k=bk).astype(jnp.float32)))(q)
+            return jnp.sum(jnp.abs(g).astype(jnp.float32))
+
+        f = jax.jit(score)
         try:
-            jax.block_until_ready(f(q, k, v))  # compile + warm
+            float(f(*inputs[0]))  # compile + warm
             t0 = time.perf_counter()
-            for _ in range(iters):
-                out = f(q, k, v)
-            jax.block_until_ready(out)
+            for i in range(iters):
+                acc = f(*inputs[i + 1])
+            float(acc)
             dt = time.perf_counter() - t0
         except Exception:  # tiling too big for VMEM etc. — skip candidate
             continue

@@ -70,9 +70,10 @@ def main():
     params = model.init(jax.random.key(0), ids[:, :8])
     state = acc.create_train_state(params, optax.adamw(3e-4), apply_fn=model.apply)
     # fused linear+CE keeps the [B,T,V] logits out of HBM, which is what lets
-    # the cheaper "dots" remat policy fit on a 16G chip
+    # the cheaper "dots" remat policy fit on a 16G chip; 4 vocab chunks
+    # measured best on v5e (vs 8: +1%, vs 16: +1.2%)
     step = acc.prepare_train_step(
-        make_llama_loss_fn(model, fused_vocab_chunks=8 if on_tpu else None),
+        make_llama_loss_fn(model, fused_vocab_chunks=4 if on_tpu else None),
         max_grad_norm=1.0,
     )
 

@@ -334,7 +334,7 @@ def test_default_block_sizes_heuristic():
     """Tiling heuristic: MXU-aligned, seq-clamped, VMEM-bounded."""
     from accelerate_tpu.ops.flash_attention import _VMEM_BUDGET_BYTES, default_block_sizes
 
-    assert default_block_sizes(2048, 2048, 96) == (512, 1024)  # measured sweet spot
+    assert default_block_sizes(2048, 2048, 96) == (1024, 1024)  # measured sweet spot
     bq, bk = default_block_sizes(12, 12, 8)
     assert bq == 128 and bk == 128  # never below one MXU tile
     bq, bk = default_block_sizes(8192, 8192, 1024)  # giant head dim must shrink
