@@ -85,6 +85,12 @@ try:
 except ImportError:  # pragma: no cover
     pass
 try:
-    from .generation import GenerationConfig, generate, generate_seq2seq, sample_logits
+    from .generation import (
+        GenerationConfig,
+        beam_search,
+        generate,
+        generate_seq2seq,
+        sample_logits,
+    )
 except ImportError:  # pragma: no cover
     pass

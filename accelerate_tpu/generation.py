@@ -147,6 +147,131 @@ def _jitted_generate(model, generation_config):
 
 
 # ---------------------------------------------------------------------------
+# Beam search (decoder-only)
+# ---------------------------------------------------------------------------
+
+
+def _beam_search_impl(model, gen_config, num_beams, length_penalty, params,
+                      input_ids, prompt_lengths, max_cache_len):
+    b, t_prompt = input_ids.shape
+    k = num_beams
+    neg = jnp.float32(-1e9)
+    eos = gen_config.eos_token_id
+    pad = gen_config.pad_token_id
+
+    cache = init_cache(model.config, b, max_cache_len)
+    positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
+    write_mask = positions < prompt_lengths[:, None]
+    logits, cache = model.apply(
+        params, input_ids, positions=positions, cache=cache, cache_write_mask=write_mask
+    )
+    last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0]
+
+    # tile prefill cache to B*K beams (beam-major within each batch row)
+    def tile(x):
+        return jnp.repeat(x, k, axis=0) if x.ndim > 0 else x
+
+    cache = [{"k": tile(c["k"]), "v": tile(c["v"]), "pos": tile(c["pos"]),
+              "index": c["index"]} for c in cache]
+    last = jnp.repeat(last, k, axis=0)                      # [B*K, V]
+    beam_lengths = jnp.repeat(prompt_lengths, k, axis=0)    # [B*K]
+    # only beam 0 live at the start, else the K identical beams collapse
+    beam_scores = jnp.tile(jnp.where(jnp.arange(k) == 0, 0.0, neg), (b,))
+    done = jnp.zeros((b * k,), bool)
+
+    v = last.shape[-1]
+
+    def step(carry, step_i):
+        cache, last_logits, beam_scores, done, cur_pos, tokens = carry
+        logp = jax.nn.log_softmax(last_logits.astype(jnp.float32), axis=-1)
+        # finished beams expand only with pad at score 0 (they persist as-is)
+        pad_row = jnp.full((v,), neg).at[pad].set(0.0)
+        logp = jnp.where(done[:, None], pad_row[None, :], logp)
+        cand = (beam_scores[:, None] + logp).reshape(b, k * v)
+        top_scores, top_idx = jax.lax.top_k(cand, k)        # [B, K]
+        src_beam = top_idx // v                             # beam within batch row
+        token = (top_idx % v).astype(jnp.int32)
+        flat_src = (jnp.arange(b)[:, None] * k + src_beam).reshape(-1)  # [B*K]
+
+        beam_scores = top_scores.reshape(-1)
+        token = token.reshape(-1)
+        done = jnp.take(done, flat_src, axis=0)
+        cur_pos = jnp.take(cur_pos, flat_src, axis=0)
+        tokens = jnp.take(tokens, flat_src, axis=0)
+        tokens = jax.lax.dynamic_update_slice(tokens, token[:, None], (0, step_i))
+        if eos is not None:
+            done = done | (token == eos)
+        done_now = done
+
+        cache = [
+            {"k": jnp.take(c["k"], flat_src, axis=0),
+             "v": jnp.take(c["v"], flat_src, axis=0),
+             "pos": jnp.take(c["pos"], flat_src, axis=0),
+             "index": c["index"]}
+            for c in cache
+        ]
+        logits, cache = model.apply(
+            params, token[:, None], positions=cur_pos[:, None],
+            cache=cache, cache_write_mask=~done_now[:, None],
+        )
+        # done beams stop advancing (keeps gen_len honest for length penalty)
+        return (cache, logits[:, 0], beam_scores, done, cur_pos + (~done), tokens), None
+
+    n = gen_config.max_new_tokens
+    tokens0 = jnp.full((b * k, n), pad, jnp.int32)
+    carry = (cache, last, beam_scores, done, beam_lengths, tokens0)
+    (cache, _, beam_scores, done, cur_pos, tokens), _ = jax.lax.scan(
+        step, carry, jnp.arange(n)
+    )
+    # pick the best beam per batch row, length-penalized (GNMT-style)
+    gen_len = jnp.maximum((cur_pos - jnp.repeat(prompt_lengths, k)).astype(jnp.float32), 1.0)
+    norm = beam_scores / (gen_len ** length_penalty)
+    best = jnp.argmax(norm.reshape(b, k), axis=-1)          # [B]
+    flat_best = jnp.arange(b) * k + best
+    return jnp.take(tokens, flat_best, axis=0)
+
+
+def beam_search(
+    model,
+    params,
+    input_ids,
+    generation_config: Optional[GenerationConfig] = None,
+    *,
+    num_beams: int = 4,
+    length_penalty: float = 1.0,
+    prompt_lengths=None,
+):
+    """Beam-search decoding with a per-beam KV cache.
+
+    Beams live on the batch axis ([B*num_beams, ...]); each step re-gathers
+    the cache by the surviving beams' source indices — a batched gather XLA
+    fuses into the decode step, not a host-side reorder.  Finished beams
+    persist by expanding only with ``pad_token_id`` at score 0.  The best
+    hypothesis per batch row is chosen by GNMT length-penalized score.
+    Returns [B, max_new_tokens] int32.
+    """
+    generation_config = generation_config or GenerationConfig()
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, t_prompt = input_ids.shape
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((b,), t_prompt, jnp.int32)
+    else:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    max_cache_len = t_prompt + generation_config.max_new_tokens
+    return _jitted_beam_search(model, generation_config, num_beams, length_penalty)(
+        params, input_ids, prompt_lengths, max_cache_len
+    )
+
+
+@lru_cache(maxsize=32)
+def _jitted_beam_search(model, generation_config, num_beams, length_penalty):
+    return jax.jit(
+        partial(_beam_search_impl, model, generation_config, num_beams, length_penalty),
+        static_argnums=(3,),
+    )
+
+
+# ---------------------------------------------------------------------------
 # Encoder-decoder (T5-family) generation
 # ---------------------------------------------------------------------------
 

@@ -41,6 +41,9 @@ class T5Config:
     relative_attention_num_buckets: int = 32
     relative_attention_max_distance: int = 128
     layer_norm_epsilon: float = 1e-6
+    # per-layer jax.checkpoint, like LlamaConfig.remat (activation-
+    # checkpointing analog, reference fsdp_utils.py:588)
+    remat: bool = False
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -198,6 +201,11 @@ class T5ForConditionalGeneration(nn.Module):
             name="shared_embedding",
         )
 
+        enc_layer, dec_layer = T5EncoderLayer, T5DecoderLayer
+        if cfg.remat:
+            enc_layer = nn.remat(enc_layer, policy=jax.checkpoint_policies.nothing_saveable)
+            dec_layer = nn.remat(dec_layer, policy=jax.checkpoint_policies.nothing_saveable)
+
         # encoder (skipped when pre-computed states are supplied)
         if encoder_output is None:
             x = embed(input_ids)
@@ -205,7 +213,7 @@ class T5ForConditionalGeneration(nn.Module):
                 input_ids.shape[1], input_ids.shape[1]
             )
             for i in range(cfg.num_layers):
-                x = T5EncoderLayer(cfg, name=f"enc_layers_{i}")(x, enc_bias, attention_mask)
+                x = enc_layer(cfg, name=f"enc_layers_{i}")(x, enc_bias, attention_mask)
             enc = RMSNorm(cfg.layer_norm_epsilon, cfg.dtype, name="enc_norm")(x)
         else:
             enc = encoder_output
@@ -218,7 +226,7 @@ class T5ForConditionalGeneration(nn.Module):
             decoder_input_ids.shape[1], decoder_input_ids.shape[1]
         )
         for i in range(cfg.num_decoder_layers):
-            y = T5DecoderLayer(cfg, name=f"dec_layers_{i}")(y, enc, dec_bias, attention_mask)
+            y = dec_layer(cfg, name=f"dec_layers_{i}")(y, enc, dec_bias, attention_mask)
         y = RMSNorm(cfg.layer_norm_epsilon, cfg.dtype, name="dec_norm")(y)
 
         # tied head with T5's rescaling

@@ -182,3 +182,51 @@ def test_t5_encode_only_and_cached_decode():
     enc = model.apply(params, src, None)
     split = model.apply(params, None, dec, encoder_output=enc)
     np.testing.assert_allclose(np.asarray(split), np.asarray(joint), atol=1e-5)
+
+
+def test_beam_search_k1_equals_greedy(tiny_model):
+    from accelerate_tpu.generation import beam_search
+
+    model, params = tiny_model
+    prompt = jnp.asarray([[5, 42, 7]], jnp.int32)
+    cfg = GenerationConfig(max_new_tokens=4)
+    greedy = generate(model, params, prompt, cfg)
+    beam1 = beam_search(model, params, prompt, cfg, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(beam1), np.asarray(greedy))
+
+
+def test_beam_search_score_at_least_greedy(tiny_model):
+    """The best of K beams scores >= the greedy hypothesis (sum of token
+    log-probs under the model)."""
+    from accelerate_tpu.generation import beam_search
+
+    model, params = tiny_model
+    prompt = jnp.asarray([[5, 42, 7, 9]], jnp.int32)
+    cfg = GenerationConfig(max_new_tokens=5)
+
+    def seq_logprob(new_tokens):
+        seq = jnp.concatenate([prompt, new_tokens[None]], axis=1)
+        logits = model.apply(params, seq)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        total = 0.0
+        for i, tok in enumerate(np.asarray(new_tokens)):
+            total += float(logp[0, prompt.shape[1] - 1 + i, int(tok)])
+        return total
+
+    greedy = generate(model, params, prompt, cfg)[0]
+    beam = beam_search(model, params, prompt, cfg, num_beams=4)[0]
+    assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+
+def test_beam_search_batch_and_lengths(tiny_model):
+    """Beam search handles right-padded variable-length prompts per row."""
+    from accelerate_tpu.generation import beam_search
+
+    model, params = tiny_model
+    cfg = GenerationConfig(max_new_tokens=3)
+    batch = jnp.asarray([[5, 42, 7, 9], [11, 3, 0, 0]], jnp.int32)
+    out = beam_search(model, params, batch, cfg, num_beams=3,
+                      prompt_lengths=jnp.asarray([4, 2]))
+    solo = beam_search(model, params, jnp.asarray([[11, 3]], jnp.int32), cfg, num_beams=3)
+    assert out.shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(solo[0]))

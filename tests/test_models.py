@@ -301,3 +301,26 @@ def test_fused_linear_xent_non_divisible_vocab():
         assert abs(float(l_f) - float(l_r)) < 1e-5, (nc, float(l_f), float(l_r))
         np.testing.assert_allclose(np.asarray(g_f[0]), np.asarray(g_r[0]), atol=1e-5)
         np.testing.assert_allclose(np.asarray(g_f[1]), np.asarray(g_r[1]), atol=1e-5)
+
+
+def test_t5_remat_matches_plain():
+    """remat=True changes memory, not math: same logits and grads."""
+    import numpy as np
+
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+    from accelerate_tpu.models.t5 import make_t5_loss_fn
+
+    enc = jnp.ones((1, 8), jnp.int32)
+    dec = jnp.arange(8, dtype=jnp.int32)[None] % 256
+    plain = T5ForConditionalGeneration(T5Config.tiny(dtype=jnp.float32))
+    remat = T5ForConditionalGeneration(T5Config.tiny(dtype=jnp.float32, remat=True))
+    params = plain.init(jax.random.key(0), enc, dec)
+    np.testing.assert_allclose(
+        np.asarray(remat.apply(params, enc, dec)),
+        np.asarray(plain.apply(params, enc, dec)), atol=1e-5,
+    )
+    batch = {"input_ids": enc, "labels": dec}
+    g1 = jax.grad(make_t5_loss_fn(plain))(params, batch)
+    g2 = jax.grad(make_t5_loss_fn(remat))(params, batch)
+    for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
