@@ -468,6 +468,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         skip_batches: int = 0,
         put_on_device: bool = True,
         prefetch_size: int = 0,
+        even_batches: bool = True,
         _non_blocking: bool = True,
         _loader_batch_size: Optional[int] = None,
     ):
@@ -475,6 +476,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.device = device
         self.mesh = mesh
         self.batch_spec = batch_spec
+        self.even_batches = even_batches
         self.rng_types = rng_types
         self.synchronized_generator = synchronized_generator
         self.skip_batches = skip_batches
@@ -487,11 +489,38 @@ class DataLoaderShard(DataLoaderStateMixin):
 
     # -- device placement ---------------------------------------------------
 
+    def _pad_to_device_multiple(self, batch):
+        """Device-level even_batches: a partial final batch whose dp-sharded
+        dim does not divide the mesh's data-parallel size cannot be laid out
+        as a global array — pad it by cycling samples from the batch head
+        (reference even_batches semantics, BatchSamplerShard :110).
+        ``gather_for_metrics`` drops the duplicate tail on the way back out
+        via ``GradientState.remainder``."""
+        def _pad(x):
+            spec = self.batch_spec(x) if callable(self.batch_spec) else self.batch_spec
+            if not spec or len(spec) == 0 or spec[0] is None:
+                return x
+            names = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+            div = 1
+            for nm in names:
+                div *= self.mesh.shape[nm]
+            n = x.shape[0]
+            if div <= 1 or n % div == 0:
+                return x
+            need = div - n % div
+            reps = -(-need // n)  # cycle if the batch is shorter than the pad
+            return np.concatenate([x] + [x] * (reps - 1) + [x[: need - (reps - 1) * n]], axis=0) \
+                if reps > 1 else np.concatenate([x, x[:need]], axis=0)
+
+        return jax.tree_util.tree_map(_pad, batch)
+
     def _device_put_batch(self, batch):
         batch = _to_numpy(batch)
         if not self.put_on_device:
             return batch
         if self.mesh is not None and self.batch_spec is not None:
+            if self.even_batches:
+                batch = self._pad_to_device_multiple(batch)
             return host_local_to_global(batch, self.mesh, self.batch_spec)
         return send_to_device(batch, self.device)
 
@@ -815,6 +844,7 @@ def prepare_data_loader(
         synchronized_generator=synchronized_generator,
         put_on_device=put_on_device,
         prefetch_size=prefetch_size,
+        even_batches=even_batches,
         _non_blocking=non_blocking,
         _loader_batch_size=loader_batch_size,
     )

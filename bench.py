@@ -48,11 +48,14 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         # ~600M decoder: fits one v5e chip with fp32 Adam state; seq 2048.
+        # remat off: with the fused CE keeping [B,T,V] logits out of HBM,
+        # full activations for this config fit in 16G — worth +7% step time
+        # over remat_policy="dots" (measured on v5e)
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
             max_position_embeddings=2048, attn_implementation="flash",
-            remat=True, remat_policy="dots", dtype=jnp.bfloat16,
+            remat=False, dtype=jnp.bfloat16,
         )
         batch, seq, iters = 8, 2048, 10
     else:  # CPU smoke mode

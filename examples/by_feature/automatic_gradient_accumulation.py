@@ -1,0 +1,57 @@
+"""Automatic gradient accumulation (reference
+examples/by_feature/automatic_gradient_accumulation.py).
+
+Combines ``find_executable_batch_size`` with gradient accumulation: when the
+wanted batch size OOMs, the physical batch halves and the accumulation steps
+double, keeping the EFFECTIVE batch (and so the training recipe) unchanged.
+"""
+
+import argparse
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+from accelerate_tpu.utils.memory import find_executable_batch_size
+
+
+def main(args):
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=args.effective_batch_size)
+    def train(batch_size):
+        attempts.append(batch_size)
+        if batch_size > args.fits:  # simulated capacity limit (observable anywhere)
+            raise MemoryError(f"simulated OOM at batch size {batch_size}")
+        accum = max(args.effective_batch_size // batch_size, 1)
+        acc = Accelerator(
+            gradient_accumulation_plugin=GradientAccumulationPlugin(
+                num_steps=accum, mode="in_step"
+            )
+        )
+        dl = acc.prepare(make_regression_loader(batch_size=batch_size, length=128))
+        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+        step = acc.prepare_train_step(regression_loss_fn)
+        for _ in range(3):
+            for batch in dl:
+                state, metrics = step(state, batch)
+        acc.print(
+            f"trained at physical batch {batch_size} x {accum} accumulation steps "
+            f"= effective {batch_size * accum}"
+        )
+        return float(metrics["loss"])
+
+    loss = train()
+    print(f"attempted physical batch sizes {attempts}; final loss {loss:.5f}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--effective_batch_size", type=int, default=64)
+    parser.add_argument("--fits", type=int, default=16, help="largest batch that 'fits'")
+    main(parser.parse_args())

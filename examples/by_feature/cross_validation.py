@@ -1,0 +1,73 @@
+"""K-fold cross validation (reference examples/by_feature/cross_validation.py).
+
+Each fold trains on k-1 splits and evaluates on the held-out split;
+``gather_for_metrics`` keeps per-fold metrics exact under any process count.
+The reference stratifies GLUE with sklearn; here the toy regression fixture
+keeps the example self-contained.
+"""
+
+import argparse
+
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import prepare_data_loader
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def _loader_from_arrays(x, y, batch_size):
+    import torch.utils.data as tud
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return len(x)
+
+        def __getitem__(self, i):
+            return {"x": x[i], "y": y[i]}
+
+    return tud.DataLoader(_DS(), batch_size=batch_size)
+
+
+def main(args):
+    acc = Accelerator()
+    ds = RegressionDataset(length=args.samples, seed=0)
+    folds = np.array_split(np.arange(args.samples), args.k_folds)
+
+    fold_mse = []
+    for k, held_out in enumerate(folds):
+        train_idx = np.setdiff1d(np.arange(args.samples), held_out)
+        train_dl = acc.prepare(_loader_from_arrays(ds.x[train_idx], ds.y[train_idx], 16))
+        eval_dl = acc.prepare(_loader_from_arrays(ds.x[held_out], ds.y[held_out], 16))
+
+        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+        step = acc.prepare_train_step(regression_loss_fn)
+        for _ in range(args.epochs):
+            for batch in train_dl:
+                state, _ = step(state, batch)
+
+        eval_step = acc.prepare_eval_step(
+            lambda params, batch: params["a"] * batch["x"] + params["b"]
+        )
+        preds, ys = [], []
+        for batch in eval_dl:
+            out, y = acc.gather_for_metrics((eval_step(state.params, batch), batch["y"]))
+            preds.append(np.asarray(out))
+            ys.append(np.asarray(y))
+        mse = float(np.mean((np.concatenate(preds) - np.concatenate(ys)) ** 2))
+        fold_mse.append(mse)
+        acc.print(f"fold {k}: held-out mse {mse:.5f} ({len(held_out)} samples)")
+
+    acc.print(f"{args.k_folds}-fold mse: {np.mean(fold_mse):.5f} +/- {np.std(fold_mse):.5f}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--k_folds", type=int, default=4)
+    parser.add_argument("--samples", type=int, default=128)
+    parser.add_argument("--epochs", type=int, default=10)
+    main(parser.parse_args())

@@ -1,0 +1,47 @@
+"""Coordinated early stopping (reference examples/by_feature/early_stopping.py).
+
+Any rank can raise the stop flag (``set_trigger``); ``check_trigger``
+all-reduces it so EVERY rank leaves the loop on the same step — breaking
+out locally would desync the collective schedule and hang the others
+(reference accelerator.py:2824/:2850).
+"""
+
+import argparse
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main(args):
+    acc = Accelerator()
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+    step = acc.prepare_train_step(regression_loss_fn)
+
+    stopped_at = None
+    for epoch in range(10):
+        for batch in dl:
+            state, metrics = step(state, batch)
+            if float(metrics["loss"]) < args.loss_threshold:
+                acc.set_trigger()  # this rank votes to stop
+            if acc.check_trigger():  # all-reduced: every rank sees the vote
+                stopped_at = epoch
+                break
+        if stopped_at is not None:
+            break
+    acc.print(
+        f"stopped at epoch {stopped_at} with loss {float(metrics['loss']):.5f} "
+        f"(threshold {args.loss_threshold})"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--loss_threshold", type=float, default=0.5)
+    main(parser.parse_args())

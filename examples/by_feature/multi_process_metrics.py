@@ -1,0 +1,59 @@
+"""Correct metrics across processes (reference
+examples/by_feature/multi_process_metrics.py).
+
+``gather_for_metrics`` gathers each rank's predictions AND drops the
+duplicated tail samples that even-batch padding added, so metrics match a
+single-process run exactly (reference accelerator.py:3040).
+"""
+
+import argparse
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main(args):
+    acc = Accelerator()
+    train_dl = acc.prepare(make_regression_loader(batch_size=16, length=96))
+    eval_dl = acc.prepare(make_regression_loader(batch_size=16, length=args.eval_samples))
+
+    state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+    step = acc.prepare_train_step(regression_loss_fn)
+    for _ in range(10):
+        for batch in train_dl:
+            state, _ = step(state, batch)
+
+    eval_step = acc.prepare_eval_step(
+        lambda params, batch: params["a"] * batch["x"] + params["b"]
+    )
+    preds, targets = [], []
+    for batch in eval_dl:
+        out = eval_step(state.params, batch)
+        # gather from all ranks and drop even-batches duplicate tail
+        out, y = acc.gather_for_metrics((out, batch["y"]))
+        preds.append(np.asarray(out))
+        targets.append(np.asarray(y))
+    preds = np.concatenate(preds)
+    targets = np.concatenate(targets)
+    assert len(preds) == args.eval_samples, (len(preds), args.eval_samples)
+    mse = float(np.mean((preds - targets) ** 2))
+    acc.print(
+        f"eval on exactly {len(preds)} samples across {acc.num_processes} proc(s): "
+        f"mse={mse:.5f}"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    # deliberately not divisible by world*batch: exercises the dedup
+    parser.add_argument("--eval_samples", type=int, default=77)
+    main(parser.parse_args())

@@ -262,3 +262,42 @@ def test_dataloader_parallelism_rank_collapse():
     cfg = ParallelismConfig(dp_shard_size=4, tp_size=2)
     dl = prepare_data_loader(_torch_loader(), parallelism_config=cfg)
     assert len(list(dl)) == 4
+
+
+def test_partial_batch_pads_to_device_multiple():
+    """Device-level even_batches: a final partial batch that doesn't divide
+    the dp mesh size is padded by cycling head samples (and laid out as a
+    global array instead of crashing); even_batches=False surfaces the
+    layout error."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    mesh = ParallelismConfig(dp_shard_size=8).build_device_mesh()
+    spec = lambda x: P(("dp_shard",)) if getattr(x, "ndim", 0) >= 1 else P()
+    # 13 samples, batch 8 -> final batch of 5 (not divisible by 8)
+    import torch.utils.data as tud
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return 13
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    dl = DataLoaderShard(tud.DataLoader(_DS(), batch_size=8), mesh=mesh, batch_spec=spec)
+    batches = list(dl)
+    assert batches[0]["x"].shape == (8,)
+    assert batches[1]["x"].shape == (8,)  # 5 real + 3 cycled
+    pad = np.asarray(batches[1]["x"])
+    assert pad[:5].tolist() == [8.0, 9.0, 10.0, 11.0, 12.0]
+    assert pad[5:].tolist() == [8.0, 9.0, 10.0]  # cycled from the batch head
+
+    dl_strict = DataLoaderShard(
+        tud.DataLoader(_DS(), batch_size=8), mesh=mesh, batch_spec=spec, even_batches=False
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(Exception):
+        list(dl_strict)

@@ -48,6 +48,13 @@ def test_cv_example():
         ("memory.py", "attempted batch sizes [128, 64, 32]"),
         ("local_sgd.py", "final loss"),
         ("pipeline_inference.py", "pipeline over 2 stage(s)"),
+        ("generation.py", "generated (2, 16) tokens"),
+        ("early_stopping.py", "stopped at epoch"),
+        ("multi_process_metrics.py", "eval on exactly 77 samples"),
+        ("automatic_gradient_accumulation.py", "physical batch 16 x 4 accumulation"),
+        ("cross_validation.py", "4-fold mse"),
+        ("schedule_free.py", "schedule-free averaged params"),
+        ("fsdp_with_peak_mem_tracking.py", "q_proj sharding"),
     ],
 )
 def test_by_feature_examples(script, needle):
