@@ -404,7 +404,10 @@ def load_checkpoint_in_model(
                 loaded[key] = tensor.astype(target_dtype)
             else:
                 sharding = flat_plan.get(key)
-                arr = jax.numpy.asarray(tensor, dtype=target_dtype)
+                # cast on HOST before the transfer: device_put ships exactly
+                # the target dtype's bytes (fp32 ckpt -> bf16 target halves
+                # H2D traffic, which dominates load time on thin links)
+                arr = tensor if tensor.dtype == np.dtype(target_dtype) else tensor.astype(target_dtype)
                 if sharding is not None:
                     loaded[key] = jax.device_put(arr, sharding)
                 elif isinstance(placement, (int, np.integer)):

@@ -293,7 +293,10 @@ def save_model(accelerator, train_state_or_params, save_directory: str,
                max_shard_size: str = "10GB", safe_serialization: bool = True) -> list[str]:
     """Gather sharded params to host and write (sharded) safetensors +
     index json — the unified-model-save capability (reference :3406 +
-    get_state_dict :3967 Z3/FSDP gather)."""
+    get_state_dict :3967 Z3/FSDP gather).
+
+    ``accelerator=None`` writes unconditionally (single-process tooling,
+    e.g. authoring a checkpoint outside a training run)."""
     from .ops.operations import global_to_host_local
 
     params = getattr(train_state_or_params, "params", train_state_or_params)
@@ -315,7 +318,7 @@ def save_model(accelerator, train_state_or_params, save_directory: str,
         shards[-1][k] = v
         sizes[-1] += nbytes
 
-    if not accelerator.is_main_process:
+    if accelerator is not None and not accelerator.is_main_process:
         accelerator.wait_for_everyone()
         return []
 
@@ -340,7 +343,8 @@ def save_model(accelerator, train_state_or_params, save_directory: str,
         path = save_dir / "model.npz"
         np.savez(path, **flat)
         written.append(str(path))
-    accelerator.wait_for_everyone()
+    if accelerator is not None:
+        accelerator.wait_for_everyone()
     return written
 
 

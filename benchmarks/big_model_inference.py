@@ -1,0 +1,98 @@
+"""Big-model inference benchmark (reference
+benchmarks/big_model_inference/README.md: GPT-J/NeoX/OPT load time +
+per-token generation latency on consumer GPUs).
+
+TPU-native equivalents of the same three numbers:
+- **load**: write a sharded safetensors checkpoint to disk once, then time
+  ``load_checkpoint_and_dispatch`` streaming it into device placement
+  (abstract init -> plan -> shard-stream; no full-model host copy);
+- **prefill latency**: one jitted forward over the prompt writing the KV
+  cache;
+- **per-token latency**: steady-state decode step (the number the reference
+  reports as "generate time per token").
+
+Prints one JSON line per metric, bench.py-style.  Model: ~1.1B Llama
+(``llama2_1b``) in bf16 — sized to one v5e chip like the reference's
+GPT-J-6B was sized to its 2x Titan RTX.
+
+Run: ``python benchmarks/big_model_inference.py [--layers N]``
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(args):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.big_modeling import load_checkpoint_and_dispatch
+    from accelerate_tpu.checkpointing import save_model
+    from accelerate_tpu.generation import GenerationConfig, generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig.llama2_1b(num_hidden_layers=args.layers or 22)
+    else:  # CPU smoke
+        cfg = LlamaConfig.tiny(num_hidden_layers=args.layers or 2)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        # one-time checkpoint authoring (not timed — the reference times the
+        # *load*, the checkpoint already exists on disk)
+        params = jax.jit(
+            lambda: model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+        )()
+        n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+        save_model(None, params, ckpt_dir)
+        del params
+
+        t0 = time.perf_counter()
+        loaded, _ = load_checkpoint_and_dispatch(
+            model, ckpt_dir, sample_args=(jnp.ones((1, 8), jnp.int32),),
+            device_map=None, dtype=jnp.bfloat16,
+        )
+        jax.block_until_ready(loaded)
+        load_s = time.perf_counter() - t0
+
+    prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (args.batch, args.prompt_len)), jnp.int32)
+    gen_cfg = GenerationConfig(max_new_tokens=args.new_tokens)
+    wrapped = {"params": loaded["params"]} if "params" in loaded else loaded
+
+    t0 = time.perf_counter()
+    out = generate(model, wrapped, prompt, gen_cfg)
+    out.block_until_ready()
+    first_s = time.perf_counter() - t0  # includes compile
+
+    t0 = time.perf_counter()
+    out = generate(model, wrapped, jnp.asarray(
+        rng.integers(0, cfg.vocab_size, prompt.shape), jnp.int32), gen_cfg)
+    out.block_until_ready()
+    steady_s = time.perf_counter() - t0
+    per_token = steady_s / args.new_tokens
+
+    meta = {"params": n_params, "batch": args.batch, "prompt_len": args.prompt_len,
+            "new_tokens": args.new_tokens, "backend": jax.default_backend(),
+            "compile_s": round(first_s - steady_s, 2)}
+    print(json.dumps({"metric": "big_model_load_seconds", "value": round(load_s, 2),
+                      "unit": "s", "extra": meta}))
+    print(json.dumps({"metric": "big_model_decode_seconds_per_token",
+                      "value": round(per_token, 4), "unit": "s/token", "extra": meta}))
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--prompt_len", type=int, default=128)
+    p.add_argument("--new_tokens", type=int, default=64)
+    main(p.parse_args())

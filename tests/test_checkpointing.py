@@ -178,3 +178,13 @@ def test_resume_mid_epoch(tmp_path):
     # the resumed loader starts at batch index 2 -> samples 32..47
     expected = [make_regression_loader(batch_size=16).dataset[i]["x"].item() for i in range(32, 48)]
     np.testing.assert_allclose(np.asarray(remaining[0]["x"]).ravel(), expected, rtol=1e-6)
+
+
+def test_save_model_without_accelerator(tmp_path):
+    """accelerator=None writes unconditionally (offline tooling path, e.g.
+    authoring a checkpoint for the big-model inference benchmark)."""
+    params = {"w": jnp.arange(16.0).reshape(4, 4)}
+    files = save_model(None, params, str(tmp_path / "model"))
+    assert files
+    loaded = load_model_params(str(tmp_path / "model"))
+    np.testing.assert_allclose(loaded["w"], np.arange(16.0).reshape(4, 4))
