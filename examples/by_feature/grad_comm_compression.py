@@ -1,0 +1,47 @@
+"""Gradient-communication compression (reference
+examples/by_feature/ddp_comm_hook.py: DDP comm hooks — fp16/bf16
+compression of the gradient all-reduce).
+
+On GSPMD the all-reduce is compiler-inserted; the knob that survives is
+``GradSyncKwargs.comm_dtype``: gradients are cast to bf16/fp16 before the
+cross-``dp`` psum and back after, halving gradient collective bytes
+(reference DDPCommunicationHookType dataclasses.py:134).
+"""
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+from accelerate_tpu.utils.dataclasses import GradSyncKwargs
+
+
+def main(args):
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=jax.device_count()),
+        kwargs_handlers=[GradSyncKwargs(comm_dtype=args.comm_dtype)],
+    )
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
+    step = acc.prepare_train_step(regression_loss_fn)
+    for _ in range(5):
+        for batch in dl:
+            state, metrics = step(state, batch)
+    acc.print(
+        f"trained with {args.comm_dtype} gradient collectives over "
+        f"{acc.num_processes} proc(s) x {jax.device_count()} device(s): "
+        f"loss {float(metrics['loss']):.5f} a={float(state.params['a']):.3f} (target 2.0)"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--comm_dtype", choices=["bf16", "fp16"], default="bf16")
+    main(parser.parse_args())

@@ -55,6 +55,8 @@ def test_cv_example():
         ("cross_validation.py", "4-fold mse"),
         ("schedule_free.py", "schedule-free averaged params"),
         ("fsdp_with_peak_mem_tracking.py", "q_proj sharding"),
+        ("gradient_accumulation_for_autoregressive_models.py", "max param diff"),
+        ("grad_comm_compression.py", "bf16 gradient collectives"),
     ],
 )
 def test_by_feature_examples(script, needle):
