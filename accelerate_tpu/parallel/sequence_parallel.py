@@ -24,13 +24,19 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def ulysses_attention_sharded(q, k, v, *, axis_name: str = "sp", causal: bool = True,
+def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causal: bool = True,
                               inner_attn: Optional[Callable] = None):
     """shard_map body.  q/k/v local: [B, T/sp, H, D] → out [B, T/sp, H, D].
 
     all_to_all #1: seq-sharded → head-sharded ([B, T, H/sp, D]);
     full-sequence attention on local heads;
     all_to_all #2: back to seq-sharded.
+
+    GQA runs at kv-head width through the all_to_alls when ``Hkv % sp == 0``
+    (head-group alignment is preserved per rank: q heads [r·H/sp, …) map to
+    kv heads [r·Hkv/sp, …)).  ``seg`` [B, T/sp] local segment ids are
+    all-gathered to the full sequence each rank attends over (packed
+    sequences; int16-sized traffic, negligible next to KV).
     """
     sp = lax.axis_size(axis_name)
     b, t_local, h, d = q.shape
@@ -43,11 +49,17 @@ def ulysses_attention_sharded(q, k, v, *, axis_name: str = "sp", causal: bool = 
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     q_h, k_h, v_h = seq2head(q), seq2head(k), seq2head(v)
+    seg_full = None
+    if seg is not None:
+        seg_full = lax.all_gather(seg, axis_name, axis=1, tiled=True)  # [B, T]
     if inner_attn is None:
         from ..models.llama import native_attention
 
         inner_attn = native_attention
-    out_h = inner_attn(q_h, k_h, v_h, causal=causal)
+    # keyword only when present: custom inner_attn callables without a
+    # segment_ids parameter stay compatible
+    kwargs = {"segment_ids": seg_full} if seg_full is not None else {}
+    out_h = inner_attn(q_h, k_h, v_h, causal=causal, **kwargs)
     return head2seq(out_h)
 
 
@@ -64,11 +76,11 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
         inner_attn = flash_attention
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
-        if segment_ids is not None:
-            raise NotImplementedError("ulysses attention does not support segment_ids yet")
         h_q, h_kv = q.shape[2], k.shape[2]
         sp = mesh.shape[axis_name]
-        if h_kv != h_q:
+        if h_kv != h_q and h_kv % sp != 0:
+            # kv heads don't split across sp — broadcast to q width (the
+            # aligned case keeps kv at Hkv width through the all_to_alls)
             rep = h_q // h_kv
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)
@@ -77,8 +89,12 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
         spec = P(None, axis_name, None, None)
         body = functools.partial(ulysses_attention_sharded, axis_name=axis_name, causal=causal,
                                  inner_attn=inner_attn)
-        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                         check_vma=False)(q, k, v)
+        if segment_ids is None:
+            return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                             check_vma=False)(q, k, v)
+        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, P(None, axis_name)),
+                         out_specs=spec, check_vma=False)(
+            q, k, v, jnp.asarray(segment_ids, jnp.int32))
 
     return attn
 

@@ -330,6 +330,44 @@ def test_flash_cross_segment_ids():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_segment_ids(sp_mesh, causal):
+    """Packed sequences under SP: local segment ids all-gather to the full
+    sequence each rank attends over."""
+    rng = np.random.default_rng(21)
+    B, T, H, D = 2, 32, 4, 8
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    segs = jnp.asarray(np.repeat([[0] * 10 + [1] * 14 + [2] * 8], B, axis=0), jnp.int32)
+    ref = native_attention(q, k, v, causal=causal, segment_ids=segs)
+    attn = make_ulysses_attention(sp_mesh)
+    out = attn(q, k, v, causal=causal, segment_ids=segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ulysses_gqa_no_repeat_when_divisible(sp_mesh):
+    """GQA kv heads divisible by sp travel the all_to_alls at kv width."""
+    rng = np.random.default_rng(22)
+    q = jnp.asarray(rng.normal(size=(1, 32, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)  # 4 kv heads, sp=4
+    v = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+    ref = native_attention(q, k, v, causal=True)
+    out = make_ulysses_attention(sp_mesh)(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_ulysses_gqa_indivisible_falls_back(sp_mesh):
+    """kv heads < sp: broadcast to q width (correctness preserved)."""
+    rng = np.random.default_rng(23)
+    q = jnp.asarray(rng.normal(size=(1, 32, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)  # 2 kv heads, sp=4
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 8)), jnp.float32)
+    ref = native_attention(q, k, v, causal=True)
+    out = make_ulysses_attention(sp_mesh)(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
 def test_default_block_sizes_heuristic():
     """Tiling heuristic: MXU-aligned, seq-clamped, VMEM-bounded."""
     from accelerate_tpu.ops.flash_attention import _VMEM_BUDGET_BYTES, default_block_sizes
