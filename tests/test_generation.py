@@ -230,3 +230,31 @@ def test_beam_search_batch_and_lengths(tiny_model):
     solo = beam_search(model, params, jnp.asarray([[11, 3]], jnp.int32), cfg, num_beams=3)
     assert out.shape == (2, 3)
     np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(solo[0]))
+
+
+def test_generate_with_sharded_params():
+    """Generation over TP+FSDP-sharded params produces identical tokens to
+    the unsharded run (GSPMD propagates shardings through prefill + the
+    decode scan — the sharded big-model inference path)."""
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.generation import beam_search
+    from accelerate_tpu.parallel.sharding import make_sharding_plan
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    prompt = jnp.asarray([[5, 42, 7, 9]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    ref = generate(model, params, prompt, GenerationConfig(max_new_tokens=5))
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=2, tp_size=4))
+    plan = make_sharding_plan(params, acc.mesh, parallelism_config=acc.parallelism_config)
+    sharded = jax.device_put(params, plan)
+    out = generate(model, sharded, prompt, GenerationConfig(max_new_tokens=5))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    beam = beam_search(model, sharded, prompt, GenerationConfig(max_new_tokens=5), num_beams=3)
+    assert beam.shape == (1, 5)
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
