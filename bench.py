@@ -72,7 +72,11 @@ def main():
 
     ids = jnp.ones((batch, seq), jnp.int32)
     params = model.init(jax.random.key(0), ids[:, :8])
-    state = acc.create_train_state(params, optax.adamw(3e-4), apply_fn=model.apply)
+    # bf16 first moment: halves Adam's m-state HBM traffic and footprint
+    # (standard large-scale practice; second moment and master weights stay
+    # fp32) — worth ~3 MFU points at this config
+    tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16) if on_tpu else optax.adamw(3e-4)
+    state = acc.create_train_state(params, tx, apply_fn=model.apply)
     # fused linear+CE keeps the [B,T,V] logits out of HBM, which is what lets
     # the cheaper "dots" remat policy fit on a 16G chip; 4 vocab chunks
     # measured best on v5e (vs 8: +1%, vs 16: +1.2%)
