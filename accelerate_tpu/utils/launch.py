@@ -40,6 +40,13 @@ def _base_env(args, config) -> dict[str, str]:
     :class:`~accelerate_tpu.commands.config.LaunchConfig` already merged with
     CLI flags (flag > file > default)."""
     env = os.environ.copy()
+    # An uninstalled source checkout must stay importable in workers: the
+    # child runs the user script by path (sys.path[0] = script dir), so the
+    # package root rides PYTHONPATH (reference installs; we may not be).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH")) if p
+    )
     env.update({str(k): str(v) for k, v in (config.env or {}).items()})
     env["ACCELERATE_MIXED_PRECISION"] = str(config.mixed_precision)
     env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(config.gradient_accumulation_steps)

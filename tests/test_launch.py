@@ -76,3 +76,26 @@ def test_launch_propagates_failure(tmp_path):
         assert "code 3" in str(e)
     else:
         raise AssertionError("launch should have propagated the non-zero exit")
+
+
+def test_launch_child_importable_without_pythonpath(tmp_path):
+    """An uninstalled source checkout must stay importable in launched
+    workers: the parent resolves the package via cwd (`python -m` from the
+    repo root) but the child runs the script by path — the launcher's env
+    must carry the package root on PYTHONPATH (regression: `accelerate-tpu
+    test` failed with ModuleNotFoundError in the child)."""
+    import subprocess
+    import sys
+
+    script = tmp_path / "probe.py"
+    script.write_text("import accelerate_tpu; print('IMPORT-OK')\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = _clean_env()
+    env.pop("PYTHONPATH", None)  # parent finds the package via cwd only
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "launch", "--cpu", str(script)],
+        capture_output=True, text=True, timeout=300, cwd=repo, env=env,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "IMPORT-OK" in result.stdout
