@@ -75,13 +75,14 @@ def sample_logits(logits, rng, config: GenerationConfig):
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-def _generate_impl(model, gen_config, params, input_ids, prompt_lengths, rng, max_cache_len):
+def _generate_impl(model, gen_config, apply_fn, params, input_ids, prompt_lengths, rng, max_cache_len):
+    apply = apply_fn or model.apply
     b, t_prompt = input_ids.shape
     cache = init_cache(model.config, b, max_cache_len)
 
     positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
     write_mask = positions < prompt_lengths[:, None]
-    logits, cache = model.apply(
+    logits, cache = apply(
         params, input_ids, positions=positions, cache=cache, cache_write_mask=write_mask
     )
     # the last *real* prompt token's logits seed the loop
@@ -95,7 +96,7 @@ def _generate_impl(model, gen_config, params, input_ids, prompt_lengths, rng, ma
         token = jnp.where(done, gen_config.pad_token_id, token)
         if eos is not None:
             done = done | (token == eos)
-        logits, cache = model.apply(
+        logits, cache = apply(
             params, token[:, None], positions=cur_pos[:, None],
             cache=cache, cache_write_mask=~done[:, None],
         )
@@ -115,6 +116,7 @@ def generate(
     *,
     prompt_lengths=None,
     rng=None,
+    apply_fn=None,
 ):
     """Generate ``max_new_tokens`` continuations for a batch of prompts.
 
@@ -122,6 +124,11 @@ def generate(
     lengths (defaults to full width).  Returns [B, max_new_tokens] int32,
     padded with ``pad_token_id`` after EOS.  The whole prefill+decode program
     is one jit per (shape, config) pair.
+
+    ``apply_fn`` overrides ``model.apply`` inside the loop — e.g.
+    ``quantized_apply(model.apply)`` decodes from an int8/NF4-quantized
+    param tree (dequant fuses into the step).  Pass a *stable* function:
+    the compile cache keys on its identity.
     """
     generation_config = generation_config or GenerationConfig()
     input_ids = jnp.asarray(input_ids, jnp.int32)
@@ -136,14 +143,15 @@ def generate(
     # flax Modules and GenerationConfig are frozen/hashable — the jitted
     # program is cached per (model, config), so repeat calls at the same
     # shapes skip retracing entirely
-    return _jitted_generate(model, generation_config)(
+    return _jitted_generate(model, generation_config, apply_fn)(
         params, input_ids, prompt_lengths, rng, max_cache_len
     )
 
 
 @lru_cache(maxsize=32)
-def _jitted_generate(model, generation_config):
-    return jax.jit(partial(_generate_impl, model, generation_config), static_argnums=(4,))
+def _jitted_generate(model, generation_config, apply_fn=None):
+    return jax.jit(partial(_generate_impl, model, generation_config, apply_fn),
+                   static_argnums=(4,))
 
 
 # ---------------------------------------------------------------------------
@@ -151,8 +159,9 @@ def _jitted_generate(model, generation_config):
 # ---------------------------------------------------------------------------
 
 
-def _beam_search_impl(model, gen_config, num_beams, length_penalty, params,
+def _beam_search_impl(model, gen_config, num_beams, length_penalty, apply_fn, params,
                       input_ids, prompt_lengths, max_cache_len):
+    apply = apply_fn or model.apply
     b, t_prompt = input_ids.shape
     k = num_beams
     neg = jnp.float32(-1e9)
@@ -162,7 +171,7 @@ def _beam_search_impl(model, gen_config, num_beams, length_penalty, params,
     cache = init_cache(model.config, b, max_cache_len)
     positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
     write_mask = positions < prompt_lengths[:, None]
-    logits, cache = model.apply(
+    logits, cache = apply(
         params, input_ids, positions=positions, cache=cache, cache_write_mask=write_mask
     )
     last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0]
@@ -210,7 +219,7 @@ def _beam_search_impl(model, gen_config, num_beams, length_penalty, params,
              "index": c["index"]}
             for c in cache
         ]
-        logits, cache = model.apply(
+        logits, cache = apply(
             params, token[:, None], positions=cur_pos[:, None],
             cache=cache, cache_write_mask=~done_now[:, None],
         )
@@ -240,6 +249,7 @@ def beam_search(
     num_beams: int = 4,
     length_penalty: float = 1.0,
     prompt_lengths=None,
+    apply_fn=None,
 ):
     """Beam-search decoding with a per-beam KV cache.
 
@@ -258,15 +268,15 @@ def beam_search(
     else:
         prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
     max_cache_len = t_prompt + generation_config.max_new_tokens
-    return _jitted_beam_search(model, generation_config, num_beams, length_penalty)(
+    return _jitted_beam_search(model, generation_config, num_beams, length_penalty, apply_fn)(
         params, input_ids, prompt_lengths, max_cache_len
     )
 
 
 @lru_cache(maxsize=32)
-def _jitted_beam_search(model, generation_config, num_beams, length_penalty):
+def _jitted_beam_search(model, generation_config, num_beams, length_penalty, apply_fn=None):
     return jax.jit(
-        partial(_beam_search_impl, model, generation_config, num_beams, length_penalty),
+        partial(_beam_search_impl, model, generation_config, num_beams, length_penalty, apply_fn),
         static_argnums=(3,),
     )
 
