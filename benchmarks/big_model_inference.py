@@ -68,20 +68,33 @@ def main(args):
     gen_cfg = GenerationConfig(max_new_tokens=args.new_tokens)
     wrapped = {"params": loaded["params"]} if "params" in loaded else loaded
 
+    apply_fn = None
+    if args.load_in_8bit:
+        # int8 weight-only decode (reference bnb path): decode reads ~half
+        # the weight bytes per step, and decode is HBM-bound
+        from accelerate_tpu.utils.quantization import (
+            QuantizationConfig, quantize_params, quantized_apply,
+        )
+
+        wrapped = quantize_params(wrapped, QuantizationConfig(load_in_8bit=True))
+        apply_fn = quantized_apply(model.apply)
+
     t0 = time.perf_counter()
-    out = generate(model, wrapped, prompt, gen_cfg)
+    out = generate(model, wrapped, prompt, gen_cfg, apply_fn=apply_fn)
     out.block_until_ready()
     first_s = time.perf_counter() - t0  # includes compile
 
     t0 = time.perf_counter()
     out = generate(model, wrapped, jnp.asarray(
-        rng.integers(0, cfg.vocab_size, prompt.shape), jnp.int32), gen_cfg)
+        rng.integers(0, cfg.vocab_size, prompt.shape), jnp.int32), gen_cfg,
+        apply_fn=apply_fn)
     out.block_until_ready()
     steady_s = time.perf_counter() - t0
     per_token = steady_s / args.new_tokens
 
     meta = {"params": n_params, "batch": args.batch, "prompt_len": args.prompt_len,
             "new_tokens": args.new_tokens, "backend": jax.default_backend(),
+            "int8": bool(args.load_in_8bit),
             "compile_s": round(first_s - steady_s, 2)}
     print(json.dumps({"metric": "big_model_load_seconds", "value": round(load_s, 2),
                       "unit": "s", "extra": meta}))
@@ -92,6 +105,7 @@ def main(args):
 if __name__ == "__main__":
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--layers", type=int, default=None)
+    p.add_argument("--load_in_8bit", action="store_true")
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--prompt_len", type=int, default=128)
     p.add_argument("--new_tokens", type=int, default=64)
