@@ -119,12 +119,30 @@ def local_sgd_check():
     state.print("local sgd OK")
 
 
+def generation_check():
+    """KV-cache decode runs and is deterministic under the launch config."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import GenerationConfig, PartialState, generate
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    prompt = jnp.asarray([[5, 42, 7]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)
+    a = generate(model, params, prompt, GenerationConfig(max_new_tokens=3))
+    b = generate(model, params, prompt, GenerationConfig(max_new_tokens=3))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    PartialState().print("generation OK")
+
+
 def main():
     check_process_state()
     check_env_transport()
     check_collectives()
     training_check()
     local_sgd_check()
+    generation_check()
     from accelerate_tpu import PartialState
 
     PartialState().print("ALL CHECKS PASSED")
