@@ -41,6 +41,14 @@ def main():
     import jax.numpy as jnp
     import optax
 
+    # persistent compile cache: repeat bench runs (and driver rounds) skip
+    # the 30-40s first-compile of the train step
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/tmp/accelerate_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     from accelerate_tpu import Accelerator, ParallelismConfig
     from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
     from accelerate_tpu.models.llama import count_params, flops_per_token
