@@ -1,0 +1,124 @@
+"""Weight-only int8 matmul — Pallas TPU kernel.
+
+The missing piece that makes int8 decode speed-positive (benchmarks/README:
+in-scan ``dequantize_tree`` re-materializes full-width weights every decode
+step, ~4.9 s/token at 1.1B): here the int8 codes stream HBM→VMEM at one
+byte per weight and dequantize **inside** the matmul tile, so the HBM read
+— which bounds decode — is halved vs bf16 weights and the bf16 tensor never
+exists in HBM.
+
+Layout contract (utils/quantization.py:quantize): codes are blockwise over
+the row-major flat weight, so with ``block_size`` dividing the minor (F)
+dim, ``data`` reshapes to [H, F] int8 and ``scale`` to [H, F/block] fp32 —
+tile-friendly without any gather.
+
+reference parity: the bnb int8 inference path (reference utils/bnb.py) runs
+on fused CUDA kernels; this is its TPU-native equivalent.  Integration into
+the model layers (a QuantizedDense that consumes QuantizedTensor leaves) is
+tracked in ROADMAP.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    _HAS_PLTPU = False
+
+from .flash_attention import _on_tpu
+from ..utils.quantization import QuantizedTensor, dequantize
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype):
+    """Grid (M_tiles, F_tiles, K_tiles); K innermost/serial.
+
+    x [bm, bk] bf16; w [bk, bf] int8 codes; s [bf/qblock, bk] fp32 scales
+    (transposed so the tile's minor dim is the 128-aligned K — Mosaic's
+    (8, 128) tiling rule).  Dequant happens on the VMEM tile: codes *
+    per-block scale, broadcast along the quantization block within F.
+    """
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    w = w_ref[...].astype(jnp.float32)
+    s = s_ref[...].T  # [bk, bf/qblock]
+    bk, bf = w.shape
+    w = (w.reshape(bk, bf // qblock, qblock) * s[:, :, None]).reshape(bk, bf)
+    acc[:] += jax.lax.dot_general(
+        x_ref[...], w.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _done():
+        o_ref[...] = acc[:].astype(out_dtype)
+
+
+def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: int = 512,
+                     block_f: int = 512, out_dtype=None, interpret=None):
+    """``x @ W`` where W is an int8 :class:`QuantizedTensor` of shape [H, F].
+
+    x: [..., H].  Falls back to ``dequantize + matmul`` for nf4 codes or
+    layouts whose quantization block does not divide F (the kernel needs the
+    [H, F/block] scale view).
+    """
+    h, f = qt.shape[-2], qt.shape[-1]
+    qblock = qt.block_size
+    if (
+        qt.scheme != "int8"
+        or len(qt.shape) != 2
+        # the scale view needs whole q-blocks per row AND >= 8 blocks per
+        # f-tile (Mosaic's (8, 128) tiling rule on the transposed scales)
+        or f % (qblock * 8) != 0
+        or (h * f) % qblock != 0
+        # the in-kernel (bk, nb, qblock) dequant reshape needs a lane-width
+        # minor dim — quantize with block_size % 128 == 0 for the kernel path
+        or qblock % 128 != 0
+    ):
+        w = dequantize(qt, jnp.bfloat16)
+        return jnp.matmul(x, w).astype(out_dtype or x.dtype)
+    if interpret is None:
+        interpret = not _on_tpu()
+    out_dtype = out_dtype or x.dtype
+
+    lead = x.shape[:-1]
+    m = int(np.prod(lead)) if lead else 1
+    x2 = x.reshape(m, h).astype(jnp.bfloat16)
+    codes = qt.data.reshape(h, f)  # int8, row-major: free reshape
+    # transposed scale view [F/qblock, H]: minor dim is the 128-aligned K
+    scales = qt.scale.reshape(h, f // qblock).T
+
+    bm = min(block_m, max(8, m))
+    bk = min(block_k, h)
+    bf = min(block_f, f)
+    bf = max(qblock * 8, (bf // (qblock * 8)) * qblock * 8)  # whole q-blocks, >=8/tile
+
+    out = pl.pallas_call(
+        functools.partial(_qmm_kernel, qblock=qblock, out_dtype=out_dtype),
+        grid=(pl.cdiv(m, bm), pl.cdiv(f, bf), pl.cdiv(h, bk)),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bf), lambda i, j, k: (k, j)),
+            pl.BlockSpec((bf // qblock, bk), lambda i, j, k: (j, k)),
+        ],
+        out_specs=pl.BlockSpec((bm, bf), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, f), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bf), jnp.float32)] if _HAS_PLTPU else [],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ) if _HAS_PLTPU else None,
+        interpret=interpret,
+    )(x2, codes, scales)
+    return out.reshape(*lead, f)
