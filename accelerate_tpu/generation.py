@@ -57,8 +57,11 @@ def sample_logits(logits, rng, config: GenerationConfig):
     if config.temperature != 1.0:
         logits = logits / max(config.temperature, 1e-6)
     neg = jnp.finfo(jnp.float32).min
-    if config.top_k is not None:
-        kth = jax.lax.top_k(logits, config.top_k)[0][..., -1:]
+    if config.top_k:  # transformers convention: top_k=0 disables the filter
+        # clamp like transformers: top_k=50 on a 30-token vocab means "keep
+        # everything", not a lax.top_k ValueError
+        k = min(config.top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits < kth, neg, logits)
     if config.top_p is not None:
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
@@ -208,6 +211,7 @@ def _beam_search_impl(model, gen_config, num_beams, length_penalty, apply_fn, pa
         cur_pos = jnp.take(cur_pos, flat_src, axis=0)
         tokens = jnp.take(tokens, flat_src, axis=0)
         tokens = jax.lax.dynamic_update_slice(tokens, token[:, None], (0, step_i))
+        was_done = done
         if eos is not None:
             done = done | (token == eos)
         done_now = done
@@ -223,8 +227,9 @@ def _beam_search_impl(model, gen_config, num_beams, length_penalty, apply_fn, pa
             params, token[:, None], positions=cur_pos[:, None],
             cache=cache, cache_write_mask=~done_now[:, None],
         )
-        # done beams stop advancing (keeps gen_len honest for length penalty)
-        return (cache, logits[:, 0], beam_scores, done, cur_pos + (~done), tokens), None
+        # beams stop advancing the step *after* EOS: the EOS token itself
+        # counts toward gen_len, matching transformers' GNMT normalization
+        return (cache, logits[:, 0], beam_scores, done, cur_pos + (~was_done), tokens), None
 
     n = gen_config.max_new_tokens
     tokens0 = jnp.full((b * k, n), pad, jnp.int32)

@@ -38,6 +38,22 @@ from .flash_attention import _on_tpu
 from ..utils.quantization import QuantizedTensor, dequantize
 
 
+def _k_tile(h: int, block_k: int):
+    """Largest lane-aligned (multiple-of-128) divisor of ``h`` that fits in
+    ``block_k``, or None.
+
+    The K grid dimension is serial and un-masked: a tile size that does not
+    divide H would make the last K step read unspecified padding rows and
+    accumulate them into every output element (e.g. Llama-7B's 11008
+    intermediate dim with the default block_k=512 → here 256 is chosen
+    instead, keeping the kernel path while staying exact).
+    """
+    for bk in range(min(block_k, h) // 128 * 128, 0, -128):
+        if h % bk == 0:
+            return bk
+    return None
+
+
 def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype):
     """Grid (M_tiles, F_tiles, K_tiles); K innermost/serial.
 
@@ -76,6 +92,7 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: int
     """
     h, f = qt.shape[-2], qt.shape[-1]
     qblock = qt.block_size
+    bk = _k_tile(h, block_k)
     if (
         qt.scheme != "int8"
         or len(qt.shape) != 2
@@ -86,6 +103,9 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: int
         # the in-kernel (bk, nb, qblock) dequant reshape needs a lane-width
         # minor dim — quantize with block_size % 128 == 0 for the kernel path
         or qblock % 128 != 0
+        # the serial K grid is un-masked: H must split into whole lane-aligned
+        # tiles or the last K step would accumulate padding garbage
+        or bk is None
     ):
         w = dequantize(qt, jnp.bfloat16)
         return jnp.matmul(x, w).astype(out_dtype or x.dtype)
@@ -101,7 +121,6 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: int
     scales = qt.scale.reshape(h, f // qblock).T
 
     bm = min(block_m, max(8, m))
-    bk = min(block_k, h)
     bf = min(block_f, f)
     bf = max(qblock * 8, (bf // (qblock * 8)) * qblock * 8)  # whole q-blocks, >=8/tile
 

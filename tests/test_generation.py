@@ -101,6 +101,16 @@ def test_sampling_respects_top_k():
     assert len(picks) == 2  # both survivors actually reachable
 
 
+def test_sampling_top_k_larger_than_vocab_clamps():
+    """transformers silently clamps top_k > V; lax.top_k would raise."""
+    logits = jnp.asarray([[0.0, 1.0, 2.0]])
+    cfg = GenerationConfig(do_sample=True, top_k=50)
+    picks = {
+        int(sample_logits(logits, jax.random.PRNGKey(i), cfg)[0]) for i in range(60)
+    }
+    assert picks == {0, 1, 2}
+
+
 def test_sampling_respects_top_p():
     # softmax of [0,0,0,10] puts ~1.0 mass on index 3 -> top_p=0.5 keeps only it
     logits = jnp.asarray([[0.0, 0.0, 0.0, 10.0]])
@@ -216,6 +226,39 @@ def test_beam_search_score_at_least_greedy(tiny_model):
     greedy = generate(model, params, prompt, cfg)[0]
     beam = beam_search(model, params, prompt, cfg, num_beams=4)[0]
     assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+
+def test_beam_search_length_penalty_counts_eos_step(tiny_model):
+    """GNMT normalization parity (ADVICE r1): a hypothesis ending in EOS at
+    step 2 has gen_len 2 (the EOS step counts), not 1.  The stub transition
+    is built so the correct normalization picks the EOS beam and the
+    off-by-one normalization flips to the other beam."""
+    from accelerate_tpu.generation import beam_search
+
+    model, params = tiny_model
+
+    # vocab 4, pad=0, eos=3.  Prompt step: p = [.25, .30, .28, .17] so the
+    # two live beams after step 1 hold tokens 1 (score log .30) and 2
+    # (log .28).  Decode: token 1 -> EOS almost surely; token 2 -> token 2.
+    # Final raw scores: A ~= log .30, B ~= log .28, both over 2 generated
+    # tokens.  Correct: A/2 > B/2 -> A wins.  If the EOS step were dropped
+    # from gen_len, A/1 < B/2 -> B would win.
+    prefill_row = jnp.log(jnp.asarray([0.25, 0.30, 0.28, 0.17]))
+    row_eos = jnp.log(jnp.asarray([0.001, 0.001, 0.001, 0.997]))
+    row_tok2 = jnp.log(jnp.asarray([0.001, 0.001, 0.997, 0.001]))
+
+    def stub_apply(params, ids, positions=None, cache=None, cache_write_mask=None):
+        b, t = ids.shape
+        if t > 1:  # prefill
+            logits = jnp.broadcast_to(prefill_row, (b, t, 4))
+        else:
+            logits = jnp.where((ids == 1)[..., None], row_eos, row_tok2)
+        return logits, cache
+
+    cfg = GenerationConfig(max_new_tokens=2, eos_token_id=3, pad_token_id=0)
+    out = beam_search(model, params, jnp.asarray([[5, 5]], jnp.int32), cfg,
+                      num_beams=2, length_penalty=1.0, apply_fn=stub_apply)
+    np.testing.assert_array_equal(np.asarray(out), [[1, 3]])
 
 
 def test_beam_search_batch_and_lengths(tiny_model):

@@ -55,6 +55,44 @@ def test_kernel_accuracy_vs_fp32(wq):
     assert rel < 0.05, rel
 
 
+def test_non_divisible_contraction_dim_clamps_k_tile():
+    """H = 384 with block_k = 256: 256 does not divide 384, so the kernel
+    must clamp to bk = 128 instead of accumulating padding on the last K
+    step (ADVICE r1 high: all-NaN for h % block_k != 0)."""
+    rng = np.random.default_rng(6)
+    W = rng.normal(size=(384, 1024)).astype(np.float32)
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(4, 384)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, block_m=8, block_k=256, out_dtype=jnp.float32,
+                           interpret=True)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.3)
+
+
+def test_non_lane_aligned_contraction_dim_falls_back():
+    """H = 320 has no multiple-of-128 divisor <= block_k, so the call must
+    take the dequant+matmul fallback and stay exact."""
+    rng = np.random.default_rng(7)
+    W = rng.normal(size=(320, 1024)).astype(np.float32)
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(4, 320)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, block_k=256, out_dtype=jnp.float32)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.3)
+
+
+def test_llama7b_intermediate_dim_uses_kernel_tile():
+    """11008 (Llama-7B down_proj contraction dim) % 512 != 0 — the clamp must
+    pick a divisor, not fall back and not read padding."""
+    from accelerate_tpu.ops.quantized_matmul import _k_tile
+
+    assert _k_tile(11008, 512) == 256
+    assert _k_tile(320, 256) is None
+    assert _k_tile(256, 512) == 256
+
+
 def test_nf4_falls_back():
     rng = np.random.default_rng(4)
     W = rng.normal(size=(64, 256)).astype(np.float32)
