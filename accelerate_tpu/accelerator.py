@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import contextlib
 import inspect
+import logging
 import math
 from dataclasses import dataclass, field
 from functools import partial
@@ -39,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.experimental.compute_on import compute_on
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
@@ -46,7 +48,10 @@ from .ops import operations as ops
 from .ops.precision import DynamicLossScale, Policy, all_finite, get_policy
 from .optimizer import AcceleratedOptimizer
 from .parallel.sharding import (
+    device_plan,
     get_tp_rules,
+    host_offload_supported,
+    host_plan,
     make_opt_state_sharding_plan,
     make_sharding_plan,
     shard_params,
@@ -70,6 +75,8 @@ from .utils.dataclasses import (
     TensorParallelConfig,
 )
 from .utils.environment import parse_flag_from_env
+
+logger = logging.getLogger(__name__)
 
 try:
     import flax.struct
@@ -200,6 +207,7 @@ class Accelerator:
         self._save_model_state_pre_hooks: dict = {}
         self._load_model_state_pre_hooks: dict = {}
         self.step_count = 0
+        self._in_accumulate = False
 
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
@@ -339,7 +347,11 @@ class Accelerator:
 
             if isinstance(obj, nn.Module):
                 return self.prepare_model(obj, device_placement=device_placement)
-        # schedules: plain callables of step -> lr
+        # schedules: plain callables of step -> lr.  Only auto-wrap callables
+        # that are identifiably schedules (optax-built, or explicitly marked
+        # with `.is_schedule = True`) — a user's collate_fn or loss_fn is
+        # also a 1-arg callable and silently wrapping it as a scheduler is a
+        # foot-gun; those pass through with a hint instead.
         if callable(obj) and not hasattr(obj, "shape") and not inspect.isclass(obj):
             sig = None
             try:
@@ -347,7 +359,17 @@ class Accelerator:
             except (TypeError, ValueError):
                 pass
             if sig is not None and len(sig.parameters) == 1:
-                return self.prepare_scheduler(obj)
+                is_schedule = getattr(obj, "is_schedule", False) or getattr(
+                    obj, "__module__", ""
+                ).startswith("optax")
+                if is_schedule:
+                    return self.prepare_scheduler(obj)
+                logger.warning(
+                    "prepare() received a 1-argument callable %r that is not an optax "
+                    "schedule; returning it unchanged. If it is a learning-rate schedule, "
+                    "pass it through accelerator.prepare_scheduler() or set "
+                    "`fn.is_schedule = True`.", getattr(obj, "__name__", obj),
+                )
         return obj
 
     def prepare_model(self, model, device_placement=None, evaluation_mode: bool = False):
@@ -446,6 +468,32 @@ class Accelerator:
             tp_rules=tp_rules,
         )
 
+    def device_params(self, params):
+        """Device-memory copies of (possibly host-offloaded) params.
+
+        Under ``cpu_offload`` the fp32 masters live in pinned host memory;
+        any consumer outside the prepared train step — eval, generation,
+        export — needs HBM copies.  No-op for resident params, so it is
+        always safe to call (reference analog: DeepSpeed gathers/unpartitions
+        params for inference after ZeRO-offload training)."""
+        def _leaf(x):
+            s = getattr(x, "sharding", None)
+            if isinstance(s, NamedSharding) and s.memory_kind not in (None, "device"):
+                return jax.device_put(x, NamedSharding(s.mesh, s.spec))
+            return x
+
+        return jax.tree_util.tree_map(_leaf, params)
+
+    def _offload_flags(self) -> tuple[bool, bool]:
+        """(offload optimizer state, offload master params) — the ZeRO-offload
+        configuration resolved from the FSDP plugin (reference DeepSpeed
+        ``offload_optimizer_device``/``offload_param_device``,
+        dataclasses.py:1172-1187)."""
+        p = self.fsdp_plugin
+        if p is None:
+            return False, False
+        return bool(p.cpu_offload), bool(p.cpu_offload and p.offload_params)
+
     def create_train_state(
         self,
         params,
@@ -466,6 +514,7 @@ class Accelerator:
             # input state, and donating the shared root key would delete it
             rng = jax.random.fold_in(get_rng_key(), 0)
 
+        offload_opt, offload_params = self._offload_flags()
         if sharded:
             plan = self._params_plan(params)
             params = shard_params(params, plan)
@@ -474,7 +523,18 @@ class Accelerator:
                 abstract_opt, plan, self.mesh,
                 parallelism_config=self.parallelism_config, fsdp_plugin=self.fsdp_plugin,
             )
+            if offload_opt and host_offload_supported():
+                # ZeRO-offload storage: the m/v moments (and the count
+                # scalars — mixing spaces inside one optax update is
+                # rejected by the memory-space checker) live in pinned host
+                # memory from init on; HBM never holds them.
+                opt_plan = host_plan(opt_plan)
             opt_state = jax.jit(tx.init, out_shardings=opt_plan)(params)
+            if offload_params and host_offload_supported():
+                # fp32 master params follow: the train step fetches a device
+                # copy for compute each step and the host-side update writes
+                # the refreshed masters back without touching HBM.
+                params = jax.device_put(params, host_plan(plan))
         else:
             plan = None
             opt_state = tx.init(params)
@@ -548,6 +608,27 @@ class Accelerator:
         mode = self.gradient_state.plugin.mode
         policy = self.policy
         comm_dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16, None: None}[self.grad_sync_kwargs.comm_dtype]
+        offload_opt, offload_params = self._offload_flags()
+        # memory-kind placement works on TPU; on the CPU test mesh the
+        # storage stays in device memory but the host-compute update region
+        # is still exercised, so numerics are pinned by the CPU suite.
+        kinds_ok = offload_opt and host_offload_supported()
+
+        def _stored_params_shardings():
+            ss = self._state_sharding
+            return getattr(ss, "params", None) if ss is not None else None
+
+        def fetch_params(params):
+            """Device copies of host-resident master params (one H2D fetch per
+            step; XLA's latency-hiding scheduler overlaps the per-leaf copies
+            with the first layers' compute)."""
+            psh = _stored_params_shardings()
+            if not (offload_params and kinds_ok) or psh is None:
+                return params
+            return jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(p, s) if isinstance(s, NamedSharding) else p,
+                params, device_plan(psh),
+            )
 
         def compute_grads(params, batch, rng, loss_scale):
             def scaled_loss(p, mb):
@@ -584,17 +665,50 @@ class Accelerator:
                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                 grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
 
-            updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
-            if loss_scale is not None:
-                # overflow: hold params/opt_state (reference skipped-step)
-                new_params = jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(finite, n, o), new_params, state.params
-                )
-                new_opt = jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(finite, n, o) if hasattr(n, "shape") and n.shape == getattr(o, "shape", None) else n,
-                    new_opt, state.opt_state,
-                )
+            def run_update(grads, opt_state, params, finite):
+                updates, new_opt = state.tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                if loss_scale is not None:
+                    # overflow: hold params/opt_state (reference skipped-step)
+                    new_params = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(finite, n, o), new_params, params
+                    )
+                    new_opt = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(finite, n, o) if hasattr(n, "shape") and n.shape == getattr(o, "shape", None) else n,
+                        new_opt, opt_state,
+                    )
+                return new_params, new_opt
+
+            if offload_opt:
+                # ZeRO-offload update: grads stream D2H, the optimizer math
+                # runs as XLA host compute against the host-resident
+                # moments/masters, and only what compute needs returns to HBM.
+                params_master = state.params
+                psh = _stored_params_shardings()
+                grads_in, finite_in = grads, finite
+                if kinds_ok and psh is not None:
+                    ghost = host_plan(psh)
+                    # every operand of the host region must sit in host memory
+                    # space — jax 0.9 rejects mixed-space elementwise ops
+                    grads_in = jax.tree_util.tree_map(jax.device_put, grads, ghost)
+                    if not offload_params:
+                        params_master = jax.tree_util.tree_map(jax.device_put, state.params, ghost)
+                    if loss_scale is not None:
+                        finite_in = jax.device_put(
+                            finite, NamedSharding(self.mesh, PartitionSpec(), memory_kind="pinned_host")
+                        )
+                with compute_on("device_host"):
+                    new_params, new_opt = run_update(grads_in, state.opt_state, params_master, finite_in)
+                if kinds_ok and psh is not None:
+                    # pin the host-execute outputs back to their storage
+                    # spaces — libtpu's host-compute alias assigner aborts on
+                    # unannotated outputs aliased with pinned-host inputs
+                    osh = getattr(self._state_sharding, "opt_state", None)
+                    if osh is not None:
+                        new_opt = jax.tree_util.tree_map(jax.device_put, new_opt, osh)
+                    new_params = jax.tree_util.tree_map(jax.device_put, new_params, psh)
+            else:
+                new_params, new_opt = run_update(grads, state.opt_state, state.params, finite)
             metrics = {"loss": loss, "grad_norm": gnorm}
             if loss_scale is not None:
                 metrics["grads_finite"] = finite
@@ -611,10 +725,11 @@ class Accelerator:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)
+                params_c = fetch_params(state.params)
 
                 def microbatch(carry, mb):
                     grads_acc, loss_acc, _prev_aux = carry
-                    loss, aux, grads = compute_grads(state.params, mb, use_rng, state.loss_scale)
+                    loss, aux, grads = compute_grads(params_c, mb, use_rng, state.loss_scale)
                     grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
                     # aux rides the carry (overwritten each microbatch) so only
                     # one copy is live — stacking it as scan output would cost
@@ -632,12 +747,12 @@ class Accelerator:
                     return x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
 
                 micro = jax.tree_util.tree_map(reshape, batch)
-                zeros = _tree_zeros_like(state.params)
+                zeros = _tree_zeros_like(params_c)
                 if has_aux:
                     first_mb = jax.tree_util.tree_map(lambda x: x[0] if np.ndim(x) else x, micro)
                     aux0 = jax.eval_shape(
                         lambda p, mb: loss_fn(*((p, mb, use_rng) if wants_rng else (p, mb)))[1],
-                        policy.cast_to_compute(state.params), first_mb,
+                        policy.cast_to_compute(params_c), first_mb,
                     )
                     aux0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), aux0)
                 else:
@@ -657,7 +772,7 @@ class Accelerator:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)
-                loss, aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
+                loss, aux, grads = compute_grads(fetch_params(state.params), batch, use_rng, state.loss_scale)
                 grad_accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
                 accum_step = state.accum_step + 1
                 is_boundary = accum_step >= accum_steps
@@ -667,7 +782,7 @@ class Accelerator:
                     mean_grads = jax.tree_util.tree_map(lambda g: g / accum_steps, acc)
                     new_st, _m = apply_update(st, mean_grads, loss)
                     return new_st.replace(
-                        grad_accum=_tree_zeros_like(st.params), accum_step=jnp.int32(0)
+                        grad_accum=_tree_zeros_like(acc), accum_step=jnp.int32(0)
                     )
 
                 def no_update(operand):
@@ -689,7 +804,7 @@ class Accelerator:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)
-                loss, aux, grads = compute_grads(state.params, batch, use_rng, state.loss_scale)
+                loss, aux, grads = compute_grads(fetch_params(state.params), batch, use_rng, state.loss_scale)
                 new_state, metrics = apply_update(state.replace(rng=rng), grads, loss)
                 if has_aux:
                     metrics["aux"] = aux
@@ -708,12 +823,20 @@ class Accelerator:
             new_state, metrics = step_fn(state, batch)
             state_sharding = self._state_sharding
             if state_sharding is not None:
+
+                def _pin(x, s):
+                    if not isinstance(s, NamedSharding):
+                        return x
+                    if s.memory_kind not in (None, "device"):
+                        # host-resident members (offloaded opt state/masters)
+                        # were already placed by apply_update; device_put is a
+                        # no-op there and with_sharding_constraint would strip
+                        # the memory kind
+                        return jax.device_put(x, s)
+                    return jax.lax.with_sharding_constraint(x, s)
+
                 try:
-                    new_state = jax.tree_util.tree_map(
-                        lambda x, s: jax.lax.with_sharding_constraint(x, s)
-                        if isinstance(s, NamedSharding) else x,
-                        new_state, state_sharding,
-                    )
+                    new_state = jax.tree_util.tree_map(_pin, new_state, state_sharding)
                 except ValueError:
                     pass
             return new_state, metrics
@@ -721,10 +844,11 @@ class Accelerator:
         jitted = jax.jit(pinned_step_fn, donate_argnums=(0,) if donate_state else ())
 
         def wrapped(state, batch):
-            self.step_count += 1
-            self.gradient_state._set_sync_gradients(
-                mode != "across_steps" or (self.step_count % accum_steps == 0)
-            )
+            if not getattr(self, "_in_accumulate", False):
+                self.step_count += 1
+                self.gradient_state._set_sync_gradients(
+                    mode != "across_steps" or (self.step_count % accum_steps == 0)
+                )
             return jitted(state, batch)
 
         wrapped._jitted = jitted
@@ -732,12 +856,16 @@ class Accelerator:
 
     def prepare_eval_step(self, eval_fn: Callable) -> Callable:
         """jit an eval function ``(params, batch) -> outputs`` with compute
-        casting applied (the autocast analog for eval, reference :1791)."""
+        casting applied (the autocast analog for eval, reference :1791).
+        Host-offloaded masters are fetched to device memory first."""
         policy = self.policy
 
         @jax.jit
-        def step(params, batch):
+        def jitted(params, batch):
             return eval_fn(policy.cast_to_compute(params), batch)
+
+        def step(params, batch):
+            return jitted(self.device_params(params), batch)
 
         return step
 
@@ -759,7 +887,12 @@ class Accelerator:
         With the default ``in_step`` mode this is a no-op provided for loop
         compatibility; with ``across_steps`` it flips
         ``GradientState.sync_gradients`` exactly like the reference
-        (``_do_sync`` :1228), including the end-of-dataloader forced sync."""
+        (``_do_sync`` :1228), including the end-of-dataloader forced sync.
+
+        ``step_count`` advances exactly once per batch: when a prepared train
+        step runs *inside* this context (the reference loop shape
+        ``with accelerator.accumulate(): step(...)``), the context owns the
+        increment and the step skips its own bookkeeping."""
         self.step_count += 1
         end = self.gradient_state.end_of_dataloader and self.gradient_state.plugin.sync_with_dataloader
         sync = (
@@ -769,7 +902,11 @@ class Accelerator:
             or self.gradient_state.plugin.sync_each_batch
         )
         self.gradient_state._set_sync_gradients(sync)
-        yield
+        self._in_accumulate = True
+        try:
+            yield
+        finally:
+            self._in_accumulate = False
 
     def no_sync(self, model=None):
         """reference no_sync (:1131): under GSPMD the compiler owns collective
@@ -804,17 +941,24 @@ class Accelerator:
             recursively_gathered = False
         data = ops.gather(input_data) if recursively_gathered else ops.gather_object(input_data)
 
-        try:
-            if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
-                def _drop(t):
-                    return t[: self.gradient_state.remainder]
+        if self.gradient_state.end_of_dataloader and self.gradient_state.remainder > 0:
+            def _drop(t):
+                return t[: self.gradient_state.remainder]
 
+            try:
                 if recursively_gathered:
                     data = ops.recursively_apply(_drop, data)
                 else:
                     data = data[: self.gradient_state.remainder]
-        except Exception:
-            pass
+            except (TypeError, IndexError) as e:
+                # un-sliceable gathered objects: return everything, loudly —
+                # silently wrong eval metrics are worse than duplicates
+                # (reference gather_for_metrics logs and falls through :3070)
+                logger.warning(
+                    "gather_for_metrics could not drop the %d duplicate tail "
+                    "samples (%s); returning the full gathered data.",
+                    self.gradient_state.remainder, e,
+                )
         return data
 
     def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):

@@ -67,6 +67,14 @@ def default_block_sizes(t: int, s: int, d: int) -> tuple[int, int]:
     round_up = lambda x: max(128, -(-x // 128) * 128)
     block_q = min(1024, round_up(t))
     block_k = min(1024, round_up(s))
+    if round_up(t) >= 32768:
+        # long-context: the (1024, 1024) backward tile is both slower
+        # (measured 1.55x at 32k standalone) and over the Mosaic scoped-VMEM
+        # stack limit once the remat'd layer context is fused around it —
+        # the [bq, bk] score/ds fp32 tiles dominate, so halve block_q.
+        # (measured: at 16k the 1024 tile is still ~6% faster end-to-end, so
+        # the clamp starts at 32k where 1024 fails to compile anyway)
+        block_q = min(block_q, 512)
 
     def working_set(bq, bk):
         # q, k, v, out-acc tiles in fp32 + the [bq, bk] scores/probs tile

@@ -243,7 +243,11 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     reshard_after_forward: bool = True      # ZeRO-3 vs ZeRO-2 behavior
     min_weight_size: int = 2**12            # auto-wrap-policy analog: don't shard tiny params
     state_dict_type: CheckpointFormat = CheckpointFormat.SHARDED
-    cpu_offload: Optional[bool] = None      # optimizer state pinned to host memory
+    cpu_offload: Optional[bool] = None      # ZeRO-offload: optimizer state in pinned host
+                                            # memory, update as XLA host compute
+    offload_params: Optional[bool] = None   # also keep the fp32 master params host-side
+                                            # (default: follows cpu_offload, matching FSDP
+                                            # CPUOffload(offload_params=True) semantics)
     activation_checkpointing: Optional[bool] = None  # jax.checkpoint on remat-policy blocks
     remat_policy: str = "nothing_saveable"  # name of a jax.checkpoint policy
     use_orig_params: bool = True            # API parity; always true under GSPMD
@@ -260,6 +264,8 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
             self.state_dict_type = CheckpointFormat(self.state_dict_type)
         if self.cpu_offload is None:
             self.cpu_offload = parse_flag_from_env("FSDP_OFFLOAD_PARAMS")
+        if self.offload_params is None:
+            self.offload_params = self.cpu_offload
         if self.activation_checkpointing is None:
             self.activation_checkpointing = parse_flag_from_env("FSDP_ACTIVATION_CHECKPOINTING")
 

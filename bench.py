@@ -36,10 +36,56 @@ def _peak_flops(device) -> tuple[float, bool]:
     return 197e12, False
 
 
+def selftest(report: dict) -> None:
+    """On-chip kernel parity: flash fwd+grad vs the XLA-native path, on the
+    real device (the CPU suite runs the kernels interpret-mode only, so a
+    Mosaic lowering bug could otherwise ship behind a green suite)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.flash_attention import flash_attention
+    from accelerate_tpu.models.llama import native_attention
+
+    b, t, h, hkv, d = 2, 1024, 8, 4, 64
+    k1, k2, k3 = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(k2, (b, t, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(k3, (b, t, hkv, d), jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_native(q, k, v):
+        return jnp.mean(native_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    lf, gf = jax.jit(jax.value_and_grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    ln, gn = jax.jit(jax.value_and_grad(loss_native, argnums=(0, 1, 2)))(q, k, v)
+    import numpy as np
+
+    np.testing.assert_allclose(float(lf), float(ln), rtol=2e-2)
+    for a, c, name in zip(gf, gn, "qkv"):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32) - c.astype(jnp.float32))))
+        ref = float(jnp.max(jnp.abs(c.astype(jnp.float32)))) + 1e-6
+        assert err / ref < 5e-2, f"flash d{name} mismatch: rel {err / ref:.4f}"
+    report["selftest"] = "ok"
+
+
 def main():
+    import argparse
+
     import jax
     import jax.numpy as jnp
     import optax
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seq-len", type=int, default=None, help="override sequence length")
+    ap.add_argument("--batch", type=int, default=None, help="override batch size")
+    ap.add_argument("--offload", action="store_true",
+                    help="ZeRO-offload: optimizer state + fp32 masters in pinned host memory")
+    ap.add_argument("--no-selftest", action="store_true",
+                    help="skip the on-chip flash-vs-native parity check")
+    ap.add_argument("--iters", type=int, default=None)
+    args = ap.parse_args()
 
     # persistent compile cache: repeat bench runs (and driver rounds) skip
     # the 30-40s first-compile of the train step
@@ -54,28 +100,41 @@ def main():
     from accelerate_tpu.models.llama import count_params, flops_per_token
 
     on_tpu = jax.default_backend() == "tpu"
+    extra_report = {}
+    if on_tpu and not args.no_selftest:
+        selftest(extra_report)
     if on_tpu:
-        # ~600M decoder: fits one v5e chip with fp32 Adam state; seq 2048.
-        # remat off: with the fused CE keeping [B,T,V] logits out of HBM,
-        # full activations for this config fit in 16G — worth +7% step time
-        # over remat_policy="dots" (measured on v5e)
+        seq = args.seq_len or 2048
+        # Long sequences need full remat (activations dominate); the shipped
+        # 2048 config runs remat-off — with the fused CE keeping [B,T,V]
+        # logits out of HBM, full activations fit in 16G, worth +7% step
+        # time over remat_policy="dots" (measured on v5e)
+        long_ctx = seq > 4096
+        # ~600M decoder: fits one v5e chip with fp32 Adam state at seq 2048
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
-            max_position_embeddings=2048, attn_implementation="flash",
-            remat=False, dtype=jnp.bfloat16,
+            max_position_embeddings=seq, attn_implementation="flash",
+            remat=long_ctx, dtype=jnp.bfloat16,
         )
         # batch 10 is the HBM sweet spot without remat (8: -4%, 12: OOM)
-        batch, seq, iters = 10, 2048, 10
+        batch = args.batch or (1 if long_ctx else 10)
+        iters = args.iters or (4 if long_ctx else 10)
     else:  # CPU smoke mode
         cfg = LlamaConfig.tiny()
-        batch, seq, iters = 4, 128, 3
+        batch, seq, iters = args.batch or 4, args.seq_len or 128, args.iters or 3
 
     model = LlamaForCausalLM(cfg)
     n_dev = jax.device_count()
+    fsdp_plugin = None
+    if args.offload:
+        from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+        fsdp_plugin = FullyShardedDataParallelPlugin(cpu_offload=True)
     acc = Accelerator(
         parallelism_config=ParallelismConfig(dp_shard_size=n_dev),
         mixed_precision="bf16",
+        fsdp_plugin=fsdp_plugin,
     )
 
     ids = jnp.ones((batch, seq), jnp.int32)
@@ -85,11 +144,21 @@ def main():
     # fp32) — worth ~3 MFU points at this config
     tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16) if on_tpu else optax.adamw(3e-4)
     state = acc.create_train_state(params, tx, apply_fn=model.apply)
+    if args.offload and on_tpu:
+        # the whole point of offload: moments live in pinned host memory
+        kinds = {
+            getattr(getattr(x, "sharding", None), "memory_kind", None)
+            for x in jax.tree_util.tree_leaves(state.opt_state)
+            if hasattr(x, "sharding")
+        }
+        assert kinds == {"pinned_host"}, f"offload storage not host-pinned: {kinds}"
+        extra_report["offload"] = "pinned_host"
     # fused linear+CE keeps the [B,T,V] logits out of HBM, which is what lets
     # the cheaper "dots" remat policy fit on a 16G chip; 4 vocab chunks
-    # measured best on v5e (vs 8: +1%, vs 16: +1.2%)
+    # measured best on v5e (vs 8: +1%, vs 16: +1.2%); long context wants 16
+    chunks = (16 if seq > 4096 else 4) if on_tpu else None
     step = acc.prepare_train_step(
-        make_llama_loss_fn(model, fused_vocab_chunks=4 if on_tpu else None),
+        make_llama_loss_fn(model, fused_vocab_chunks=chunks),
         max_grad_norm=1.0,
     )
 
@@ -129,6 +198,7 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
         "extra": {
+            **extra_report,
             "mfu": round(mfu, 4),
             "params": count_params(state.params),
             "batch": batch, "seq_len": seq,
