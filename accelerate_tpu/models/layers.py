@@ -1,0 +1,61 @@
+"""Shared model layers.
+
+:class:`QuantizableDense` is the integration point for weight-only
+quantization (reference bnb int8 inference path, ``utils/bnb.py:469``,
+where ``Linear8bitLt`` modules are swapped in): a drop-in ``nn.Dense``
+whose kernel may be a :class:`~accelerate_tpu.utils.quantization.QuantizedTensor`
+pytree leaf.  When it is, the matmul runs through the Pallas int8 kernel
+(``ops/quantized_matmul.py``) — codes stream HBM→VMEM at one byte per
+weight and dequantize in-tile, so decode reads half the bytes of bf16
+weights and the full-width tensor never materializes in HBM.  (The previous
+integration, ``quantized_apply``'s whole-tree dequantize-then-apply, left
+int8 decode ~700x slower than bf16 because XLA re-materialized every
+weight every step.)
+
+Non-quantized kernels take the standard ``jnp.dot`` path; NF4 kernels fall
+back to an in-layer dequantize that XLA fuses into the consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.quantized_matmul import quantized_matmul
+from ..utils.quantization import is_quantized
+
+
+class QuantizableDense(nn.Module):
+    """``nn.Dense`` that accepts an int8/NF4 ``QuantizedTensor`` kernel.
+
+    The quantized kernel is fetched with ``get_variable`` (``self.param``
+    would flatten the QuantizedTensor pytree and fail its leaf-wise shape
+    check); init mode always creates a full-precision kernel.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Optional[Any] = None
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        stored = None
+        if not self.is_initializing() and self.has_variable("params", "kernel"):
+            stored = self.get_variable("params", "kernel")
+        dtype = self.dtype or x.dtype
+        if is_quantized(stored):
+            y = quantized_matmul(x.astype(dtype), stored, out_dtype=dtype)
+        else:
+            kernel = self.param(
+                "kernel", self.kernel_init, (x.shape[-1], self.features), self.param_dtype
+            )
+            y = jnp.dot(x.astype(dtype), kernel.astype(dtype))
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
+            y = y + bias.astype(dtype)
+        return y

@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .layers import QuantizableDense
+
 
 @dataclasses.dataclass(frozen=True)
 class LlamaConfig:
@@ -223,7 +225,7 @@ class LlamaAttention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None):
         cfg = self.config
-        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        dense = partial(QuantizableDense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
         q = dense(cfg.num_attention_heads * cfg.head_dim, name="q_proj")(x)
         k = dense(cfg.num_key_value_heads * cfg.head_dim, name="k_proj")(x)
         v = dense(cfg.num_key_value_heads * cfg.head_dim, name="v_proj")(x)
@@ -265,7 +267,7 @@ class LlamaMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        dense = partial(QuantizableDense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
         gate = dense(cfg.intermediate_size, name="gate_proj")(x)
         up = dense(cfg.intermediate_size, name="up_proj")(x)
         return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)

@@ -21,6 +21,7 @@ tracked in ROADMAP.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +69,9 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype):
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
+    # fp32 dequant math: Mosaic only supports non-no-op minor-dim insertion
+    # (the s[:, :, None] broadcast) for 32-bit types, so the scale expansion
+    # stays fp32 and the product casts down to bf16 for the MXU.
     w = w_ref[...].astype(jnp.float32)
     s = s_ref[...].T  # [bk, bf/qblock]
     bk, bf = w.shape
@@ -82,7 +86,7 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype):
         o_ref[...] = acc[:].astype(out_dtype)
 
 
-def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: int = 512,
+def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Optional[int] = None,
                      block_f: int = 512, out_dtype=None, interpret=None):
     """``x @ W`` where W is an int8 :class:`QuantizedTensor` of shape [H, F].
 
@@ -92,14 +96,22 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: int
     """
     h, f = qt.shape[-2], qt.shape[-1]
     qblock = qt.block_size
+    lead = x.shape[:-1]
+    m = int(np.prod(lead)) if lead else 1
+    if block_k is None:
+        # decode (tiny m): larger K tiles amortize the per-invocation scale
+        # transpose + dequant setup; at large m the 512 tile double-buffers
+        # better (measured on v5e)
+        block_k = 1024 if m <= 8 else 512
     bk = _k_tile(h, block_k)
     if (
         qt.scheme != "int8"
         or len(qt.shape) != 2
-        # the scale view needs whole q-blocks per row AND >= 8 blocks per
-        # f-tile (Mosaic's (8, 128) tiling rule on the transposed scales)
-        or f % (qblock * 8) != 0
-        or (h * f) % qblock != 0
+        # the scale view needs whole q-blocks per row.  Partial *F* grid
+        # tiles are fine: out-of-range columns only ever receive garbage that
+        # the clipped output write discards (the K grid, by contrast, is
+        # serial and un-masked — see bk below).
+        or f % qblock != 0
         # the in-kernel (bk, nb, qblock) dequant reshape needs a lane-width
         # minor dim — quantize with block_size % 128 == 0 for the kernel path
         or qblock % 128 != 0
@@ -113,16 +125,26 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: int
         interpret = not _on_tpu()
     out_dtype = out_dtype or x.dtype
 
-    lead = x.shape[:-1]
-    m = int(np.prod(lead)) if lead else 1
     x2 = x.reshape(m, h).astype(jnp.bfloat16)
-    codes = qt.data.reshape(h, f)  # int8, row-major: free reshape
-    # transposed scale view [F/qblock, H]: minor dim is the 128-aligned K
-    scales = qt.scale.reshape(h, f // qblock).T
+    if getattr(qt, "layout", "flat") == "k2d":
+        # codes/scales are already stored in the kernel's operand layouts —
+        # the decode scan body contains no per-step reshape or transpose
+        codes, scales = qt.data, qt.scale
+    else:
+        codes = qt.data.reshape(h, f)  # int8, row-major: free reshape
+        # transposed scale view [F/qblock, H]: minor dim is the 128-aligned K
+        scales = qt.scale.reshape(h, f // qblock).T
 
     bm = min(block_m, max(8, m))
-    bf = min(block_f, f)
-    bf = max(qblock * 8, (bf // (qblock * 8)) * qblock * 8)  # whole q-blocks, >=8/tile
+    # The transposed-scale block's sublane dim (bf/qblock) must be divisible
+    # by 8 or equal the full array dim (Mosaic lowering rule).  Partial last
+    # F tiles are fine — their out-of-range columns land in the clipped
+    # output write.
+    if f <= 8 * qblock:
+        bf = f  # single F tile: scale block covers the full (small) dim
+    else:
+        bf = min(block_f, f)
+        bf = max(qblock * 8, (bf // (qblock * 8)) * qblock * 8)
 
     out = pl.pallas_call(
         functools.partial(_qmm_kernel, qblock=qblock, out_dtype=out_dtype),

@@ -85,11 +85,24 @@ def _spec_for_leaf(
                     )
             break
 
-    # 2. FSDP: shard the largest still-free, divisible dim
+    # 2. FSDP: shard the largest still-free, divisible dim — but never below
+    # the TPU tile (8 sublanes x 128 lanes): a shard extent smaller than the
+    # tile forces the partitioner into replicate-then-reshard churn
+    # ("involuntary full rematerialization") every time the param crosses a
+    # differently-sharded region (e.g. the cp ring shard_map), costing ICI
+    # traffic each step.  Small params replicate instead — the same trade
+    # min_weight_size makes, applied per-dim.
     fsdp_size = _axis_size(mesh, fsdp_axes)
     if fsdp_size > 1 and int(np.prod(shape)) >= min_weight_size:
+        def _tile_ok(d: int) -> bool:
+            extent = shape[d] // fsdp_size
+            return extent >= (128 if d == ndim - 1 else 8)
+
         candidates = sorted(
-            (d for d in range(ndim) if spec[d] is None and shape[d] % fsdp_size == 0),
+            (
+                d for d in range(ndim)
+                if spec[d] is None and shape[d] % fsdp_size == 0 and _tile_ok(d)
+            ),
             key=lambda d: shape[d],
             reverse=True,
         )
@@ -122,6 +135,14 @@ def make_sharding_plan(
 
     if strategy in (ShardingStrategy.FULL_SHARD, ShardingStrategy.HYBRID_SHARD):
         fsdp_axes = cfg.fsdp_dim_names or (("dp_shard",) if mesh.shape.get("dp_shard", 1) > 1 else ())
+        # Params consumed inside the cp ring shard_map (a *manual* region
+        # over cp) must be cp-replicated there; sharding them over the joint
+        # (dp_shard, cp) axes makes the partitioner replicate-then-reshard
+        # every layer every step ("involuntary full rematerialization" —
+        # wasted ICI).  So params shard over the non-manual axes only; the
+        # optimizer state keeps the full joint ZeRO sharding (it never
+        # crosses the shard_map) — see make_opt_state_sharding_plan.
+        fsdp_axes = tuple(a for a in fsdp_axes if a != "cp")
     else:
         # NO_SHARD / SHARD_GRAD_OP: parameters replicated across dp
         # (grad/optimizer sharding for SHARD_GRAD_OP is applied to opt_state
@@ -166,6 +187,12 @@ def make_opt_state_sharding_plan(
         fsdp_axes = cfg.fsdp_dim_names or (("dp_shard",) if mesh.shape.get("dp_shard", 1) > 1 else ())
     else:
         fsdp_axes = ()
+    # the entry shape the *params* plan uses for its (cp-excluded) fsdp axes,
+    # so mirrors can be recognized and upgraded to the joint ZeRO sharding
+    param_axes = tuple(a for a in fsdp_axes if a != "cp")
+    param_entry = (param_axes if len(param_axes) > 1 else param_axes[0]) if param_axes else None
+    joint_entry = (tuple(fsdp_axes) if len(fsdp_axes) > 1 else fsdp_axes[0]) if fsdp_axes else None
+    joint_size = _axis_size(mesh, fsdp_axes)
 
     def _leaf(path, leaf):
         shape = tuple(leaf.shape) if hasattr(leaf, "shape") else ()
@@ -176,7 +203,15 @@ def make_opt_state_sharding_plan(
         for param_path, sharding in flat_plan.items():
             if p.endswith(param_path) and len(sharding.spec) <= len(shape):
                 if sharding.spec and any(s is not None for s in sharding.spec):
-                    return NamedSharding(mesh, sharding.spec)
+                    spec = list(sharding.spec)
+                    if joint_entry is not None and joint_entry != param_entry:
+                        # moments never enter the cp shard_map: upgrade the
+                        # param's fsdp entry to the joint (dp_shard, cp)
+                        # sharding for the full ZeRO memory saving
+                        for d, entry in enumerate(spec):
+                            if entry == param_entry and shape[d] % joint_size == 0:
+                                spec[d] = joint_entry
+                    return NamedSharding(mesh, PartitionSpec(*spec))
                 break
         return NamedSharding(mesh, _spec_for_leaf(p, shape, mesh, tuple(fsdp_axes), min_size, []))
 

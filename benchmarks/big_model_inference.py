@@ -68,26 +68,23 @@ def main(args):
     gen_cfg = GenerationConfig(max_new_tokens=args.new_tokens)
     wrapped = {"params": loaded["params"]} if "params" in loaded else loaded
 
-    apply_fn = None
     if args.load_in_8bit:
         # int8 weight-only decode (reference bnb path): decode reads ~half
-        # the weight bytes per step, and decode is HBM-bound
-        from accelerate_tpu.utils.quantization import (
-            QuantizationConfig, quantize_params, quantized_apply,
-        )
+        # the weight bytes per step, and decode is HBM-bound.  QuantizedTensor
+        # kernels route natively through the Pallas in-tile-dequant matmul in
+        # QuantizableDense — no apply wrapper needed.
+        from accelerate_tpu.utils.quantization import QuantizationConfig, quantize_params
 
         wrapped = quantize_params(wrapped, QuantizationConfig(load_in_8bit=True))
-        apply_fn = quantized_apply(model.apply)
 
     t0 = time.perf_counter()
-    out = generate(model, wrapped, prompt, gen_cfg, apply_fn=apply_fn)
+    out = generate(model, wrapped, prompt, gen_cfg)
     out.block_until_ready()
     first_s = time.perf_counter() - t0  # includes compile
 
     t0 = time.perf_counter()
     out = generate(model, wrapped, jnp.asarray(
-        rng.integers(0, cfg.vocab_size, prompt.shape), jnp.int32), gen_cfg,
-        apply_fn=apply_fn)
+        rng.integers(0, cfg.vocab_size, prompt.shape), jnp.int32), gen_cfg)
     out.block_until_ready()
     steady_s = time.perf_counter() - t0
     per_token = steady_s / args.new_tokens

@@ -304,9 +304,36 @@ def test_generate_with_sharded_params():
 
 
 def test_generate_from_quantized_params(tiny_model):
-    """int8-quantized params decode through apply_fn=quantized_apply (the
-    bnb-analog inference path: dequant fuses into the jitted step)."""
+    """int8-quantized params decode natively: QuantizedTensor kernel leaves
+    route through QuantizableDense -> the Pallas in-tile-dequant matmul (the
+    bnb-analog inference path, reference utils/bnb.py:469), with no apply
+    wrapper."""
     from accelerate_tpu.generation import beam_search
+    from accelerate_tpu.utils.quantization import QuantizationConfig, quantize_params
+
+    model, params = tiny_model
+    prompt = jnp.asarray([[5, 42, 7, 9]], jnp.int32)
+    qparams = quantize_params(
+        params, QuantizationConfig(load_in_8bit=True, min_size=1, skip_patterns=(
+            "embed", "norm", "bias", "scale", "lm_head"))
+    )
+    from accelerate_tpu.utils.quantization import is_quantized
+
+    assert any(is_quantized(x) for x in jax.tree_util.tree_leaves(
+        qparams, is_leaf=is_quantized))
+    out = generate(model, qparams, prompt, GenerationConfig(max_new_tokens=6))
+    ref = generate(model, params, prompt, GenerationConfig(max_new_tokens=6))
+    # int8 blockwise-absmax is tight enough that the tiny model's greedy
+    # path is unchanged — a strong end-to-end dequant-correctness signal
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    beam = beam_search(model, qparams, prompt, GenerationConfig(max_new_tokens=4),
+                       num_beams=3)
+    assert beam.shape == (1, 4)
+
+
+def test_generate_quantized_via_apply_wrapper(tiny_model):
+    """The generic quantized_apply wrapper (for model families without
+    QuantizableDense) still decodes correctly."""
     from accelerate_tpu.utils.quantization import (
         QuantizationConfig,
         quantize_params,
@@ -315,14 +342,8 @@ def test_generate_from_quantized_params(tiny_model):
 
     model, params = tiny_model
     prompt = jnp.asarray([[5, 42, 7, 9]], jnp.int32)
-    qparams = quantize_params(params, QuantizationConfig(load_in_8bit=True))
-    qapply = quantized_apply(model.apply)
+    qparams = quantize_params(params, QuantizationConfig(load_in_8bit=True, min_size=1))
     out = generate(model, qparams, prompt, GenerationConfig(max_new_tokens=6),
-                   apply_fn=qapply)
+                   apply_fn=quantized_apply(model.apply))
     ref = generate(model, params, prompt, GenerationConfig(max_new_tokens=6))
-    # int8 blockwise-absmax is tight enough that the tiny model's greedy
-    # path is unchanged — a strong end-to-end dequant-correctness signal
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
-    beam = beam_search(model, qparams, prompt, GenerationConfig(max_new_tokens=4),
-                       num_beams=3, apply_fn=qapply)
-    assert beam.shape == (1, 4)

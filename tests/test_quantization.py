@@ -119,6 +119,32 @@ def test_load_and_quantize_model_streams(tmp_path):
     assert rel < 0.15 * np.abs(params["block"]["kernel"]).max() + 1e-6
 
 
+def test_load_and_quantize_model_preserves_k2d_layout(tmp_path):
+    """int8 streaming load keeps the kernel-ready k2d layout through the
+    device_put re-wrap — dropping it corrupts dequantization on non-square
+    shapes (r2 review finding)."""
+    from accelerate_tpu.checkpointing import save_model
+
+    class _Acc:
+        is_main_process = True
+
+        @staticmethod
+        def wait_for_everyone():
+            pass
+
+    W = _weight((64, 128))
+    params = {"block": {"kernel": W}}
+    save_model(_Acc(), params, str(tmp_path))
+    abstract = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params
+    )
+    q = load_and_quantize_model(abstract, str(tmp_path), QuantizationConfig(load_in_8bit=True))
+    qt = q["block"]["kernel"]
+    assert is_quantized(qt) and qt.layout == "k2d"
+    deq = np.asarray(dequantize_tree(q, jnp.float32)["block"]["kernel"])
+    assert np.abs(deq - W).max() < 0.05 * np.abs(W).max() + 1e-6
+
+
 def test_config_validation():
     with pytest.raises(ValueError):
         QuantizationConfig()
