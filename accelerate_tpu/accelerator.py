@@ -45,7 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
 from .ops import operations as ops
-from .ops.precision import DynamicLossScale, Policy, all_finite, get_policy
+from .ops.precision import DynamicLossScale, Policy, all_finite, fp8_autocast, get_policy
 from .optimizer import AcceleratedOptimizer
 from .parallel.sharding import (
     device_plan,
@@ -450,9 +450,17 @@ class Accelerator:
     def init_params(self, module, rng, *sample_args, **sample_kwargs):
         """Abstract-init + shard: params materialize directly into their
         target shards (never a full replica per host — the big-model path,
-        SURVEY §2.7 TPU-native note)."""
+        SURVEY §2.7 TPU-native note).  Under ``cpu_offload`` the outputs are
+        placed in pinned host memory, but the init *computation* still
+        stages the full-precision tree on device — for models whose fp32
+        tree exceeds HBM, stream real weights leaf-wise via
+        ``load_checkpoint_in_model`` or use
+        :func:`~accelerate_tpu.big_modeling.init_params_leafwise`."""
         abstract = jax.eval_shape(partial(module.init, rng), *sample_args, **sample_kwargs)
         plan = self._params_plan(abstract)
+        _, offload_params = self._offload_flags()
+        if offload_params and host_offload_supported():
+            plan = host_plan(plan)
         init_fn = jax.jit(partial(module.init, rng), out_shardings=plan)
         return init_fn(*sample_args, **sample_kwargs)
 
@@ -517,7 +525,13 @@ class Accelerator:
         offload_opt, offload_params = self._offload_flags()
         if sharded:
             plan = self._params_plan(params)
-            params = shard_params(params, plan)
+            # fp32 masters placed straight into pinned host memory under
+            # offload — at 7B the fp32 tree must never transit HBM; the
+            # train step fetches a compute-width device copy each step
+            place_plan = (
+                host_plan(plan) if offload_params and host_offload_supported() else plan
+            )
+            params = shard_params(params, place_plan)
             abstract_opt = jax.eval_shape(tx.init, params)
             opt_plan = make_opt_state_sharding_plan(
                 abstract_opt, plan, self.mesh,
@@ -527,14 +541,19 @@ class Accelerator:
                 # ZeRO-offload storage: the m/v moments (and the count
                 # scalars — mixing spaces inside one optax update is
                 # rejected by the memory-space checker) live in pinned host
-                # memory from init on; HBM never holds them.
+                # memory from init on, and the init itself runs as host
+                # compute — a device-side init would stage the full fp32
+                # moment tree in HBM before writing the host outputs
+                # (measured OOM at 7B).
                 opt_plan = host_plan(opt_plan)
-            opt_state = jax.jit(tx.init, out_shardings=opt_plan)(params)
-            if offload_params and host_offload_supported():
-                # fp32 master params follow: the train step fetches a device
-                # copy for compute each step and the host-side update writes
-                # the refreshed masters back without touching HBM.
-                params = jax.device_put(params, host_plan(plan))
+
+                def _host_init(p):
+                    with compute_on("device_host"):
+                        return tx.init(p)
+
+                opt_state = jax.jit(_host_init, out_shardings=opt_plan)(params)
+            else:
+                opt_state = jax.jit(tx.init, out_shardings=opt_plan)(params)
         else:
             plan = None
             opt_state = tx.init(params)
@@ -625,16 +644,29 @@ class Accelerator:
             psh = _stored_params_shardings()
             if not (offload_params and kinds_ok) or psh is None:
                 return params
+            # cast the fp32 masters to the compute dtype *on the host* so
+            # only the compute-width copy crosses PCIe and HBM never holds
+            # the fp32 tree (at 7B, the fp32 params alone exceed a v5e chip)
+            with compute_on("device_host"):
+                params = policy.cast_to_compute(params)
             return jax.tree_util.tree_map(
                 lambda p, s: jax.device_put(p, s) if isinstance(s, NamedSharding) else p,
                 params, device_plan(psh),
             )
 
+        use_fp8 = str(self.mixed_precision) == "fp8"
+
         def compute_grads(params, batch, rng, loss_scale):
             def scaled_loss(p, mb):
                 p = policy.cast_to_compute(p)
                 mb_args = (p, mb, rng) if wants_rng else (p, mb)
-                out = loss_fn(*mb_args)
+                if use_fp8:
+                    # trace the model under the fp8 region: QuantizableDense
+                    # layers route their matmuls through scaled e4m3
+                    with fp8_autocast():
+                        out = loss_fn(*mb_args)
+                else:
+                    out = loss_fn(*mb_args)
                 loss, aux = (out if has_aux else (out, None))
                 # the scalar loss always lives in fp32 (torch-AMP keeps
                 # reductions fp32); otherwise scaling by 2^16 overflows fp16
@@ -646,7 +678,15 @@ class Accelerator:
             (loss, aux), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params, batch)
             if comm_dtype is not None:
                 grads = jax.tree_util.tree_map(lambda g: g.astype(comm_dtype), grads)
-            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            if not kinds_ok or policy.needs_loss_scaling:
+                # fp16 loss scaling must unscale in fp32 — dividing fp16
+                # grads by ~2^16 first would flush small gradients to zero,
+                # defeating the point of scaling
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            # otherwise, under real host offload, grads stay in compute width
+            # until the host upcasts them inside the update region: HBM never
+            # holds the fp32 grad tree and the D2H transfer is half the bytes
+            # (the DeepSpeed ZeRO-offload wire format)
             return loss, aux, grads
 
         def apply_update(state: TrainState, grads, loss):
@@ -660,10 +700,19 @@ class Accelerator:
                 finite = jnp.bool_(True)
                 new_scale = None
 
-            gnorm = global_norm(grads)
-            if max_grad_norm is not None:
-                clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
+            # Under real host offload with clipping, the norm + clip move
+            # into the host region: a device-side clip keeps every gradient
+            # alive until the global norm is ready (an all-grads barrier —
+            # at 7B that is the whole 13.5GiB bf16 grad tree resident at
+            # once, measured OOM).  Without clipping the device norm is just
+            # per-leaf partial sums and each grad streams D2H as backward
+            # produces it, so it stays on device.
+            gnorm_on_host = offload_opt and kinds_ok and max_grad_norm is not None
+            if not gnorm_on_host:
+                gnorm = global_norm(grads)
+                if max_grad_norm is not None:
+                    clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                    grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
 
             def run_update(grads, opt_state, params, finite):
                 updates, new_opt = state.tx.update(grads, opt_state, params)
@@ -698,6 +747,17 @@ class Accelerator:
                             finite, NamedSharding(self.mesh, PartitionSpec(), memory_kind="pinned_host")
                         )
                 with compute_on("device_host"):
+                    if kinds_ok:
+                        # grads crossed PCIe at compute width; the host
+                        # upcasts before touching the fp32 moments/masters
+                        grads_in = jax.tree_util.tree_map(
+                            lambda g: g.astype(jnp.float32), grads_in
+                        )
+                    if gnorm_on_host:
+                        gnorm = global_norm(grads_in)
+                        if max_grad_norm is not None:
+                            clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                            grads_in = jax.tree_util.tree_map(lambda g: g * clip, grads_in)
                     new_params, new_opt = run_update(grads_in, state.opt_state, params_master, finite_in)
                 if kinds_ok and psh is not None:
                     # pin the host-execute outputs back to their storage
@@ -707,6 +767,9 @@ class Accelerator:
                     if osh is not None:
                         new_opt = jax.tree_util.tree_map(jax.device_put, new_opt, osh)
                     new_params = jax.tree_util.tree_map(jax.device_put, new_params, psh)
+                if gnorm_on_host:
+                    # the metric scalar returns to device memory space
+                    gnorm = jax.device_put(gnorm, NamedSharding(self.mesh, PartitionSpec()))
             else:
                 new_params, new_opt = run_update(grads, state.opt_state, state.params, finite)
             metrics = {"loss": loss, "grad_norm": gnorm}
@@ -859,9 +922,13 @@ class Accelerator:
         casting applied (the autocast analog for eval, reference :1791).
         Host-offloaded masters are fetched to device memory first."""
         policy = self.policy
+        use_fp8 = str(self.mixed_precision) == "fp8"
 
         @jax.jit
         def jitted(params, batch):
+            if use_fp8:
+                with fp8_autocast():
+                    return eval_fn(policy.cast_to_compute(params), batch)
             return eval_fn(policy.cast_to_compute(params), batch)
 
         def step(params, batch):

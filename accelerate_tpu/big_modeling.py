@@ -52,6 +52,58 @@ def abstract_init(module, rng, *sample_args, **sample_kwargs):
     return jax.eval_shape(lambda: module.init(rng, *sample_args, **sample_kwargs))
 
 
+def init_params_leafwise(model, accelerator, sample_ids, *, scale: float = 0.02):
+    """Materialize params leaf-by-leaf straight into their planned shards —
+    peak device memory is one leaf, like the streaming checkpoint loader.
+
+    This is the big-model alternative to ``Accelerator.init_params`` when
+    the full-precision tree exceeds HBM (e.g. 7B fp32 masters on a 16GiB
+    chip under host offload): flax's monolithic init executable stages the
+    whole tree on device before writing outputs (measured OOM at 7B).  The
+    initialization is *synthetic* (normal(0, scale) matrices, ones for
+    norm scales, zeros elsewhere) — real 7B flows load trained weights via
+    :func:`load_checkpoint_in_model`, which is leaf-streamed already.
+    """
+    import jax.numpy as jnp
+
+    from .parallel.sharding import host_offload_supported, host_plan, path_str
+
+    abstract = jax.eval_shape(lambda: model.init(jax.random.key(0), sample_ids))
+    plan = accelerator._params_plan(abstract)
+    if accelerator._offload_flags()[1] and host_offload_supported():
+        plan = host_plan(plan)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+    shardings = jax.tree_util.tree_leaves(plan, is_leaf=lambda x: hasattr(x, "spec"))
+    # one jit per distinct (kind, shape, dtype, sharding) — NOT per leaf
+    # (a per-leaf closure would pay a full compile hundreds of times)
+    jits: dict = {}
+
+    def initializer(kind, shape, dtype, sh):
+        key = (kind, shape, str(dtype), sh)
+        if key not in jits:
+            if kind == "normal":
+                jits[key] = jax.jit(
+                    lambda k: (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype),
+                    out_shardings=sh,
+                )
+            elif kind == "ones":
+                jits[key] = jax.jit(lambda: jnp.ones(shape, dtype), out_shardings=sh)
+            else:
+                jits[key] = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sh)
+        return jits[key]
+
+    out = []
+    for i, ((path, sds), sh) in enumerate(zip(flat, shardings)):
+        name = path_str(path)
+        if sds.ndim >= 2:
+            out.append(initializer("normal", sds.shape, sds.dtype, sh)(jax.random.key(i)))
+        elif "scale" in name or "norm" in name.lower():
+            out.append(initializer("ones", sds.shape, sds.dtype, sh)())
+        else:
+            out.append(initializer("zeros", sds.shape, sds.dtype, sh)())
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 @contextlib.contextmanager
 def init_empty_weights(include_buffers: bool = False):
     """API-parity context (reference :61).  Under JAX initialization is

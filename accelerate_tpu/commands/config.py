@@ -125,26 +125,91 @@ def _ask(prompt: str, default, cast=str):
     return cast(raw)
 
 
+def _ask_choice(prompt: str, choices: tuple, default):
+    """Re-prompt until the answer is one of ``choices`` (reference cluster.py
+    `_ask_options` menu behavior, as a validated free-text prompt)."""
+    while True:
+        raw = _ask(f"{prompt} ({'/'.join(choices)})", default)
+        if raw in choices:
+            return raw
+        print(f"  -> {raw!r} is not one of {choices}")
+
+
+def _ask_pos_int(prompt: str, default: int) -> int:
+    while True:
+        try:
+            val = _ask(prompt, default, int)
+        except ValueError:
+            print("  -> enter an integer")
+            continue
+        if val >= 1:
+            return val
+        print("  -> must be >= 1")
+
+
 def interactive_config() -> LaunchConfig:
+    """Validated questionnaire covering every field the launcher transports
+    (reference commands/config/cluster.py questionnaire; the vendor-engine
+    branches collapse into the mesh-axis questions)."""
     cfg = LaunchConfig()
     print("accelerate-tpu configuration (enter to accept defaults)")
-    cfg.num_processes = _ask("How many processes (= TPU hosts)?", 1, int)
+    cfg.num_processes = _ask_pos_int("How many processes (= TPU hosts)?", 1)
     if cfg.num_processes > 1:
-        cfg.num_machines = _ask(
-            "How many machines (1 = spawn all processes on this host)?", 1, int
+        cfg.num_machines = _ask_pos_int(
+            "How many machines (1 = spawn all processes on this host)?", 1
         )
         if cfg.num_machines > 1:
             cfg.main_process_ip = _ask("Coordinator (process-0) IP?", "127.0.0.1")
             cfg.main_process_port = _ask("Coordinator port?", 29500, int)
-    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)?", "bf16")
-    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps?", 1, int)
-    cfg.use_fsdp = _ask("Shard parameters/optimizer state (FSDP/ZeRO-3)?", True, bool)
-    cfg.tp_size = _ask("Tensor-parallel size?", 1, int)
-    cfg.cp_size = _ask("Context-parallel size (ring attention)?", 1, int)
-    cfg.sp_size = _ask("Sequence-parallel size (Ulysses)?", 1, int)
-    cfg.ep_size = _ask("Expert-parallel size (MoE)?", 1, int)
-    cfg.pp_size = _ask("Pipeline-parallel size?", 1, int)
+    cfg.use_cpu = _ask("Force CPU (debug runs without an accelerator)?", False, bool)
+    cfg.debug = _ask("Enable debug mode (collective shape verification)?", False, bool)
+    cfg.mixed_precision = _ask_choice(
+        "Mixed precision", ("no", "bf16", "fp16", "fp8"), "bf16"
+    )
+    cfg.gradient_accumulation_steps = _ask_pos_int("Gradient accumulation steps?", 1)
+
+    # -- model-parallel mesh axes, validated as ParallelismConfig would ----
+    cfg.tp_size = _ask_pos_int("Tensor-parallel size?", 1)
+    while True:
+        cfg.cp_size = _ask_pos_int("Context-parallel size (ring attention)?", 1)
+        cfg.sp_size = _ask_pos_int("Sequence-parallel size (Ulysses)?", 1)
+        if cfg.cp_size > 1 and cfg.sp_size > 1:
+            print("  -> cp and sp are alternative long-context mechanisms; "
+                  "pick one (cp: ring attention, sp: Ulysses)")
+            continue
+        break
+    cfg.ep_size = _ask_pos_int("Expert-parallel size (MoE)?", 1)
+    cfg.pp_size = _ask_pos_int("Pipeline-parallel size?", 1)
+    cfg.dp_replicate_size = _ask_pos_int(
+        "Data-parallel replicate size (HSDP outer/DCN axis)?", 1
+    )
+    # device count per host is unknown at config time, so divisibility is
+    # re-validated by ParallelismConfig at launch; surface the product here
+    model_axes = (cfg.tp_size * cfg.cp_size * cfg.sp_size * cfg.ep_size
+                  * cfg.pp_size * cfg.dp_replicate_size)
+    print(f"  (model-axis product: {model_axes}; dp_shard fills the remainder)")
+
+    cfg.use_fsdp = _ask("Shard parameters/optimizer state (FSDP/ZeRO)?", True, bool)
+    if cfg.use_fsdp:
+        cfg.fsdp_sharding_strategy = _ask_choice(
+            "Sharding strategy",
+            ("FULL_SHARD", "SHARD_GRAD_OP", "HYBRID_SHARD", "NO_SHARD"),
+            "FULL_SHARD",
+        )
+        cfg.fsdp_offload_params = _ask(
+            "ZeRO-offload (optimizer state + fp32 masters in host memory)?",
+            False, bool,
+        )
+        cfg.fsdp_activation_checkpointing = _ask(
+            "Activation checkpointing (remat)?", False, bool
+        )
     cfg.dp_shard_size = -1 if cfg.use_fsdp else 1
+    print(
+        "Mesh: dp_replicate=%d x dp_shard=%s x pp=%d x cp=%d x sp=%d x tp=%d x ep=%d"
+        % (cfg.dp_replicate_size,
+           "auto" if cfg.dp_shard_size == -1 else cfg.dp_shard_size,
+           cfg.pp_size, cfg.cp_size, cfg.sp_size, cfg.tp_size, cfg.ep_size)
+    )
     return cfg
 
 

@@ -33,7 +33,13 @@ def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
         parser = subparsers.add_parser("estimate-memory", description=description, help=description)
     else:
         parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
-    parser.add_argument("model", choices=["llama", "bert", "resnet"], help="Model family.")
+    parser.add_argument(
+        "model",
+        help="Model family (llama|bert|resnet) OR a path to a safetensors "
+             "checkpoint — file or directory — whose headers are read without "
+             "loading any tensor data (reference estimate.py:318 meta-loads "
+             "any hub checkpoint; here any local/HF-format one).",
+    )
     parser.add_argument("--config_file", default=None,
                         help="HF-style config.json with model dims (overrides flags).")
     parser.add_argument("--hidden_size", type=int, default=None)
@@ -111,11 +117,57 @@ def abstract_param_sizes(model_family: str, overrides: dict) -> tuple[int, int, 
     return total, largest, per_module
 
 
-def estimate_command(args) -> None:
-    total, largest, _ = abstract_param_sizes(args.model, _build_config(args))
+def checkpoint_param_sizes(path: str) -> tuple[int, int, dict, dict]:
+    """Header-only scan of a safetensors checkpoint (no tensor data read):
+    (total_params, largest_module_params, per_module_params, per_dtype_params).
+    """
+    import os
+
+    from ..utils.serialization import read_safetensors_header
+
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+        )
+        if not files:
+            raise ValueError(f"no .safetensors files in {path}")
+    else:
+        files = [path]
+
+    total = 0
+    per_module: dict[str, int] = {}
+    per_dtype: dict[str, int] = {}
+    for f in files:
+        header, _ = read_safetensors_header(f)
+        for name, info in header.items():
+            if name == "__metadata__":
+                continue
+            n = 1
+            for d in info["shape"]:
+                n *= d
+            total += n
+            # group by the first two path segments (HF dot-names or our
+            # slash-names both split sensibly)
+            parts = name.replace(".", "/").split("/")
+            top = "/".join(parts[:2])
+            per_module[top] = per_module.get(top, 0) + n
+            per_dtype[str(info["dtype"])] = per_dtype.get(str(info["dtype"]), 0) + n
+    largest = max(per_module.values()) if per_module else 0
+    return total, largest, per_module, per_dtype
+
+
+def _st_dtype_bytes(dt: str) -> int:
+    """Byte width of a safetensors dtype string, from the serialization
+    module's own table (single source of truth)."""
+    from ..utils.serialization import _STR_TO_DTYPE
+
+    if dt not in _STR_TO_DTYPE:
+        raise ValueError(f"unknown safetensors dtype {dt!r} in checkpoint header")
+    return _STR_TO_DTYPE[dt].itemsize
+
+
+def _print_table(args, total: int, largest: int) -> None:
     n = max(args.num_chips, 1)
-    print(f"Model: {args.model}  parameters: {total:,}  (largest module: {largest:,})"
-          + (f"  sharded over {n} chips" if n > 1 else ""))
     header = f"{'dtype':>9} | {'largest module':>14} | {'weights':>10} | {'+grads':>10} | {'train (Adam)':>12}"
     print(header)
     print("-" * len(header))
@@ -130,6 +182,35 @@ def estimate_command(args) -> None:
               f"| {_sizeof_fmt(grads):>10} | {_sizeof_fmt(train):>12}")
     print("\nNote: activations excluded (batch/seq dependent); use remat "
           "(FSDP_ACTIVATION_CHECKPOINTING) to bound them.")
+
+
+def estimate_command(args) -> None:
+    import os
+
+    n = max(args.num_chips, 1)
+    # built-in family names win over a same-named local path — dimension
+    # flags apply to families, and silently scanning a stray ./llama dir
+    # instead would ignore them
+    if args.model not in ("llama", "bert", "resnet") and os.path.exists(args.model):
+        total, largest, _, per_dtype = checkpoint_param_sizes(args.model)
+        stored = sum(n_ * _st_dtype_bytes(dt) for dt, n_ in per_dtype.items())
+        print(f"Checkpoint: {args.model}  parameters: {total:,}  "
+              f"(largest module: {largest:,})"
+              + (f"  sharded over {n} chips" if n > 1 else ""))
+        print("stored dtypes: " + ", ".join(
+            f"{dt}: {n_:,}" for dt, n_ in sorted(per_dtype.items())) +
+            f"  ({_sizeof_fmt(stored)} on disk)")
+        _print_table(args, total, largest)
+        return
+    if args.model not in ("llama", "bert", "resnet"):
+        raise SystemExit(
+            f"{args.model!r} is neither a built-in family (llama|bert|resnet) "
+            "nor an existing checkpoint path"
+        )
+    total, largest, _ = abstract_param_sizes(args.model, _build_config(args))
+    print(f"Model: {args.model}  parameters: {total:,}  (largest module: {largest:,})"
+          + (f"  sharded over {n} chips" if n > 1 else ""))
+    _print_table(args, total, largest)
 
 
 def main():

@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.precision import fp8_current_scaled_dot, fp8_enabled
 from ..ops.quantized_matmul import quantized_matmul
 from ..utils.quantization import is_quantized
 
@@ -54,7 +55,14 @@ class QuantizableDense(nn.Module):
             kernel = self.param(
                 "kernel", self.kernel_init, (x.shape[-1], self.features), self.param_dtype
             )
-            y = jnp.dot(x.astype(dtype), kernel.astype(dtype))
+            if fp8_enabled():
+                # inside an fp8_autocast region (mixed_precision="fp8"):
+                # scaled-e4m3 matmul on the MXU, bf16 straight-through bwd
+                y = fp8_current_scaled_dot(
+                    x.astype(dtype), kernel.astype(dtype), preferred_element_type=dtype
+                )
+            else:
+                y = jnp.dot(x.astype(dtype), kernel.astype(dtype))
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
             y = y + bias.astype(dtype)

@@ -15,6 +15,9 @@ TPU-native re-design of the reference precision subsystem (SURVEY §2.6):
 
 from __future__ import annotations
 
+import contextlib
+import functools
+import threading
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Optional
@@ -179,6 +182,39 @@ def quantize_fp8(x, meta: Fp8Meta, dtype=jnp.float8_e4m3fn, fp8_max: float = E4M
     return q, new_meta
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fp8_matmul(x, w, x_scale, w_scale, preferred_element_type):
+    """Scaled-e4m3 matmul on the MXU with a bf16 straight-through backward
+    (the HYBRID e5m2-bwd behavior approximated by bf16 — strictly more
+    accurate, same speed class on TPU)."""
+    qx = jnp.clip(x.astype(jnp.float32) * x_scale, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+    qw = jnp.clip(w.astype(jnp.float32) * w_scale, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
+    out = jax.lax.dot_general(
+        qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (out / (x_scale * w_scale)).astype(preferred_element_type)
+
+
+def _fp8_matmul_fwd(x, w, x_scale, w_scale, preferred_element_type):
+    return _fp8_matmul(x, w, x_scale, w_scale, preferred_element_type), (x, w)
+
+
+def _fp8_matmul_bwd(preferred_element_type, res, g):
+    x, w = res
+    g = g.astype(preferred_element_type)
+    dx = jax.lax.dot_general(
+        g, w.astype(preferred_element_type), (((g.ndim - 1,), (1,)), ((), ()))
+    ).astype(x.dtype)
+    x2 = x.reshape(-1, x.shape[-1]).astype(preferred_element_type)
+    g2 = g.reshape(-1, g.shape[-1])
+    dw = jax.lax.dot_general(x2, g2, (((0,), (0,)), ((), ()))).astype(w.dtype)
+    return dx, dw, None, None
+
+
+_fp8_matmul.defvjp(_fp8_matmul_fwd, _fp8_matmul_bwd)
+
+
 def fp8_dot(
     x,
     w,
@@ -187,49 +223,53 @@ def fp8_dot(
     fp8_format: FP8Format = FP8Format.HYBRID,
     preferred_element_type=jnp.bfloat16,
 ):
-    """fp8 matmul forward: quantize both operands to e4m3, matmul on the MXU,
-    de-scale the result.  Returns (out, (new_x_meta, new_w_meta)).
-
-    Gradient flows through a straight-through estimator: backward matmuls run
-    in ``preferred_element_type`` (the HYBRID e5m2-bwd behavior is approximated
-    by bf16 — strictly more accurate, same speed class on TPU).
-    """
+    """fp8 matmul with TE-style delayed scaling: quantize both operands to
+    e4m3 using amax-history scales, matmul on the MXU, de-scale the result.
+    Returns (out, (new_x_meta, new_w_meta))."""
     del fp8_format
-
-    @jax.custom_vjp
-    def _dot(x, w, x_scale, w_scale):
-        qx = jnp.clip(x.astype(jnp.float32) * x_scale, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
-        qw = jnp.clip(w.astype(jnp.float32) * w_scale, -E4M3_MAX, E4M3_MAX).astype(jnp.float8_e4m3fn)
-        out = jax.lax.dot_general(
-            qx,
-            qw,
-            (((qx.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return (out / (x_scale * w_scale)).astype(preferred_element_type)
-
-    def _fwd(x, w, x_scale, w_scale):
-        return _dot(x, w, x_scale, w_scale), (x, w)
-
-    def _bwd(res, g):
-        x, w = res
-        g = g.astype(preferred_element_type)
-        dx = jax.lax.dot_general(
-            g, w.astype(preferred_element_type), (((g.ndim - 1,), (1,)), ((), ()))
-        ).astype(x.dtype)
-        x2 = x.reshape(-1, x.shape[-1]).astype(preferred_element_type)
-        g2 = g.reshape(-1, g.shape[-1])
-        dw = jax.lax.dot_general(x2, g2, (((0,), (0,)), ((), ()))).astype(w.dtype)
-        return dx, dw, None, None
-
-    _dot.defvjp(_fwd, _bwd)
-
     amax_x = jnp.max(jnp.abs(x)).astype(jnp.float32)
     amax_w = jnp.max(jnp.abs(w)).astype(jnp.float32)
     new_x_meta = x_meta.updated(amax_x, E4M3_MAX)
     new_w_meta = w_meta.updated(amax_w, E4M3_MAX)
-    out = _dot(x, w, new_x_meta.scale, new_w_meta.scale)
+    out = _fp8_matmul(x, w, new_x_meta.scale, new_w_meta.scale, preferred_element_type)
     return out, (new_x_meta, new_w_meta)
+
+
+def fp8_current_scaled_dot(x, w, preferred_element_type=jnp.bfloat16):
+    """Stateless fp8 matmul with current-step scaling.
+
+    The delayed-scaling history (TE DelayedScaling) exists on GPUs to avoid
+    an extra amax pass over the operands; on TPU the amax reduction fuses
+    into the producing op, so fresh per-call scales are both simpler (no
+    meta state threaded through the step) and strictly more accurate.  This
+    is the form :class:`~accelerate_tpu.models.layers.QuantizableDense`
+    uses under :func:`fp8_autocast`."""
+    amax_x = jnp.maximum(jnp.max(jnp.abs(x)).astype(jnp.float32), 1e-12)
+    amax_w = jnp.maximum(jnp.max(jnp.abs(w)).astype(jnp.float32), 1e-12)
+    return _fp8_matmul(
+        x, w, E4M3_MAX / amax_x, E4M3_MAX / amax_w, preferred_element_type
+    )
+
+
+# Trace-time fp8 region flag (the TE fp8_autocast analog, reference
+# utils/transformer_engine.py / ao.py).  The prepared train/eval steps wrap
+# the loss under this context when mixed_precision="fp8"; QuantizableDense
+# reads it at trace time and routes its matmul through fp8.
+_FP8_STATE = threading.local()
+
+
+@contextlib.contextmanager
+def fp8_autocast(enabled: bool = True):
+    prev = getattr(_FP8_STATE, "enabled", False)
+    _FP8_STATE.enabled = enabled
+    try:
+        yield
+    finally:
+        _FP8_STATE.enabled = prev
+
+
+def fp8_enabled() -> bool:
+    return getattr(_FP8_STATE, "enabled", False)
 
 
 # ---------------------------------------------------------------------------

@@ -268,6 +268,27 @@ def replicated_plan(params, mesh: Mesh):
 # ---------------------------------------------------------------------------
 
 
+def plan_bytes_per_device(abstract_tree, plan) -> int:
+    """Per-device bytes of a pytree under a sharding plan (abstract: pure
+    arithmetic over specs — works with :class:`jax.sharding.AbstractMesh`,
+    no real devices needed).  Used by ``bench.py --plan`` and the memory
+    estimator to report multi-chip footprints from one host."""
+    total = 0
+    leaves = jax.tree_util.tree_leaves(
+        abstract_tree, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype")
+    )
+    plans = jax.tree_util.tree_leaves(plan, is_leaf=lambda x: isinstance(x, NamedSharding))
+    for leaf, sh in zip(leaves, plans):
+        n = int(np.prod(leaf.shape)) * jax.dtypes.canonicalize_dtype(leaf.dtype).itemsize
+        div = 1
+        if isinstance(sh, NamedSharding):
+            for entry in sh.spec:
+                if entry is not None:
+                    div *= _axis_size(sh.mesh, entry)
+        total += -(-n // div)
+    return total
+
+
 def host_offload_supported() -> bool:
     """Whether in-``jit`` memory-kind placement works on this backend.
 

@@ -1,0 +1,29 @@
+"""Process-aware tqdm wrapper (reference utils/tqdm.py): progress bars
+display on the local main process only, so an 8-host launch prints one bar,
+not eight interleaved ones."""
+
+from __future__ import annotations
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """``tqdm.tqdm`` that renders only on the local main process by default.
+
+    Pass ``main_process_only=False`` to show a bar on every process.
+    """
+    try:
+        from tqdm.auto import tqdm as _tqdm
+    except ImportError as e:  # pragma: no cover - tqdm is a torch dep in-image
+        raise ImportError(
+            "accelerate_tpu's tqdm wrapper requires `tqdm` to be installed."
+        ) from e
+    if args and isinstance(args[0], bool):
+        raise ValueError(
+            "Passing True/False as the first argument is unsupported; use the "
+            "main_process_only keyword argument instead."
+        )
+    from ..state import PartialState
+
+    disable = kwargs.pop("disable", False)
+    if main_process_only and not disable:
+        disable = PartialState().local_process_index != 0
+    return _tqdm(*args, **kwargs, disable=disable)

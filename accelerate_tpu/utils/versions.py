@@ -1,0 +1,37 @@
+"""Version comparison helpers (reference capability role: utils/versions.py
+``compare_versions``/``is_torch_version`` — here the pinned library is jax).
+"""
+
+from __future__ import annotations
+
+import importlib.metadata
+import operator as op
+from typing import Union
+
+from packaging.version import Version, parse
+
+STR_OPERATION_TO_FUNC = {
+    ">": op.gt, ">=": op.ge, "==": op.eq, "!=": op.ne, "<=": op.le, "<": op.lt,
+}
+
+jax_version = parse(importlib.metadata.version("jax"))
+
+
+def compare_versions(
+    library_or_version: Union[str, Version], operation: str, requirement_version: str
+) -> bool:
+    """Compare an installed library's version (by name) or a Version against
+    a requirement using ``operation`` (one of > >= == != <= <)."""
+    if operation not in STR_OPERATION_TO_FUNC:
+        raise ValueError(
+            f"`operation` must be one of {list(STR_OPERATION_TO_FUNC)}, received {operation}"
+        )
+    fn = STR_OPERATION_TO_FUNC[operation]
+    if isinstance(library_or_version, str):
+        library_or_version = parse(importlib.metadata.version(library_or_version))
+    return fn(library_or_version, parse(requirement_version))
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    """Compare the running jax version against ``version``."""
+    return compare_versions(jax_version, operation, version)

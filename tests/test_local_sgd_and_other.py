@@ -150,3 +150,29 @@ def test_aot_compile_and_regions():
 def test_check_os_kernel_no_crash(caplog):
     with caplog.at_level(logging.WARNING):
         check_os_kernel()
+
+
+def test_version_helpers():
+    from accelerate_tpu.utils.versions import compare_versions, is_jax_version
+
+    assert is_jax_version(">=", "0.4.0")
+    assert not is_jax_version("<", "0.4.0")
+    assert compare_versions("numpy", ">", "1.0.0")
+    import pytest
+
+    with pytest.raises(ValueError, match="operation"):
+        compare_versions("numpy", "~", "1.0")
+
+
+def test_tqdm_main_process_only():
+    from accelerate_tpu.utils.tqdm import tqdm
+
+    bar = tqdm(range(3), main_process_only=True)
+    # single process: local main -> not disabled (checked before iteration
+    # completes — tqdm flips disable on close)
+    assert not bar.disable
+    assert list(bar) == [0, 1, 2]
+    import pytest
+
+    with pytest.raises(ValueError, match="main_process_only"):
+        tqdm(True, range(3))
