@@ -160,7 +160,7 @@ def interactive_config() -> LaunchConfig:
         )
         if cfg.num_machines > 1:
             cfg.main_process_ip = _ask("Coordinator (process-0) IP?", "127.0.0.1")
-            cfg.main_process_port = _ask("Coordinator port?", 29500, int)
+            cfg.main_process_port = _ask_pos_int("Coordinator port?", 29500)
     cfg.use_cpu = _ask("Force CPU (debug runs without an accelerator)?", False, bool)
     cfg.debug = _ask("Enable debug mode (collective shape verification)?", False, bool)
     cfg.mixed_precision = _ask_choice(

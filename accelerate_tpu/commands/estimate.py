@@ -146,10 +146,16 @@ def checkpoint_param_sizes(path: str) -> tuple[int, int, dict, dict]:
             for d in info["shape"]:
                 n *= d
             total += n
-            # group by the first two path segments (HF dot-names or our
-            # slash-names both split sensibly)
+            # group up to (and including) the first numeric segment so HF
+            # names like model.layers.17.mlp... bucket per layer, not all
+            # 32 layers into one "model/layers" module
             parts = name.replace(".", "/").split("/")
-            top = "/".join(parts[:2])
+            cut = 2
+            for i, seg in enumerate(parts):
+                if seg.isdigit() or (seg.rsplit("_", 1)[-1].isdigit()):
+                    cut = i + 1
+                    break
+            top = "/".join(parts[:cut])
             per_module[top] = per_module.get(top, 0) + n
             per_dtype[str(info["dtype"])] = per_dtype.get(str(info["dtype"]), 0) + n
     largest = max(per_module.values()) if per_module else 0

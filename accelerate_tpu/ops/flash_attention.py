@@ -67,13 +67,13 @@ def default_block_sizes(t: int, s: int, d: int) -> tuple[int, int]:
     round_up = lambda x: max(128, -(-x // 128) * 128)
     block_q = min(1024, round_up(t))
     block_k = min(1024, round_up(s))
-    if round_up(t) >= 32768:
-        # long-context: the (1024, 1024) backward tile is both slower
-        # (measured 1.55x at 32k standalone) and over the Mosaic scoped-VMEM
-        # stack limit once the remat'd layer context is fused around it —
-        # the [bq, bk] score/ds fp32 tiles dominate, so halve block_q.
-        # (measured: at 16k the 1024 tile is still ~6% faster end-to-end, so
-        # the clamp starts at 32k where 1024 fails to compile anyway)
+    if round_up(t) >= 32768 or d >= 128:
+        # The (1024, 1024) backward tile exceeds the Mosaic scoped-VMEM
+        # stack limit (by ~160KB) once the remat'd layer context is fused
+        # around it, at long sequence or at head_dim >= 128 (7B-class
+        # models) — and at 32k it is 1.55x slower standalone anyway; halve
+        # block_q.  (At 16k/d<128 the 1024 tile is ~6% faster end-to-end,
+        # so the clamp stays off there.)
         block_q = min(block_q, 512)
 
     def working_set(bq, bk):

@@ -82,7 +82,8 @@ def _7b_config(jnp, seq):
     )
 
 
-def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool):
+def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool,
+                optimizer: str = "lion"):
     """Abstract per-device memory plan for Llama-2-7B on an ``n_devices``
     v5e mesh (FSDP over dp_shard) — pure eval_shape + sharding-plan
     arithmetic, no chips needed (VERDICT r1 missing #4)."""
@@ -107,7 +108,9 @@ def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool):
     p_bytes = plan_bytes_per_device(params, plan)  # fp32 leaves as initialized
     bf16 = p_bytes // 2          # compute copy
     fp32 = p_bytes               # master
-    adam = 2 * p_bytes           # m + v fp32
+    # matches the bench's 7b optimizer choice: lion = bf16 momentum only,
+    # adamw = fp32 m + v
+    opt_state = p_bytes // 2 if optimizer == "lion" else 2 * p_bytes
     if offload:
         # grads stream D2H as backward produces them (clipping off — see
         # docs/offload.md); resident at once: ~the largest leaf, in bf16
@@ -122,21 +125,22 @@ def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool):
     # activations: full remat keeps one bf16 [B, T, H] per layer boundary
     # plus the flash workspace; fused CE avoids [B, T, V] logits
     act = batch_per_device * seq * cfg.hidden_size * 2 * (cfg.num_hidden_layers + 2)
-    hbm = bf16 + grads + act + (0 if offload else fp32 + adam)
-    host = (fp32 + adam) if offload else 0
+    hbm = bf16 + grads + act + (0 if offload else fp32 + opt_state)
+    host = (fp32 + opt_state) if offload else 0
     gib = lambda b: round(b / 2**30, 2)
     return {
         "model": "llama2-7b", "n_devices": n_devices,
         "per_device_GiB": {
             "params_bf16": gib(bf16), "grads_bf16": gib(grads),
             "master_fp32": gib(0 if offload else fp32),
-            "adam_moments_fp32": gib(0 if offload else adam),
+            "optimizer_state": gib(0 if offload else opt_state),
             "activations_est": gib(act), "total_hbm": gib(hbm),
         },
         "host_GiB_per_device": gib(host),
         "fits_v5e_16GiB": hbm < 15 * 2**30,
         "grads_streamed": offload,
-        "offload": offload, "seq_len": seq, "batch_per_device": batch_per_device,
+        "offload": offload, "optimizer": optimizer,
+        "seq_len": seq, "batch_per_device": batch_per_device,
     }
 
 
@@ -158,8 +162,8 @@ def main():
     ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--precision", choices=["bf16", "fp8"], default="bf16",
                     help="mixed_precision for the train step (fp8: scaled-e4m3 matmuls)")
-    ap.add_argument("--optimizer", choices=["adafactor", "adamw"], default="adafactor",
-                    help="7b mode only: adafactor (factored moments, ~50MiB host state) "
+    ap.add_argument("--optimizer", choices=["lion", "adamw"], default="lion",
+                    help="7b mode only: lion (bf16 momentum, ~13.5GiB host state) "
                          "or adamw (full m+v, needs ~67GiB host RAM)")
     ap.add_argument("--plan", type=int, default=None, metavar="N",
                     help="print the abstract per-device 7B memory plan for an N-chip mesh and exit")
@@ -169,7 +173,7 @@ def main():
         print(json.dumps({
             "metric": "llama2_7b_memory_plan", "value": args.plan, "unit": "devices",
             "extra": plan_report(args.plan, args.seq_len or 2048, args.batch or 1,
-                                 offload=args.offload),
+                                 offload=args.offload, optimizer=args.optimizer),
         }))
         return
 
@@ -258,16 +262,15 @@ def main():
                 mu_dtype=jnp.bfloat16,
             )
         else:
-            # adafactor: factored second moments — host-side optimizer state
-            # shrinks from ~54GiB (adam m+v) to ~50MiB, the classic
-            # memory-constrained-training choice (T5)
-            tx = optax.inject_hyperparams(
-                optax.adafactor,
-                static_args=(
-                    "factored", "dtype_momentum", "min_dim_size_to_factor",
-                    "decay_offset", "multiply_by_parameter_scale", "momentum",
-                ),
-            )(learning_rate=3e-4, momentum=None)
+            # lion: momentum-only state (bf16-able) — host-side optimizer
+            # state shrinks from ~54GiB (adam m+v) to ~13.5GiB, keeping the
+            # whole host working set inside the TPU VM's RAM.  (adafactor's
+            # internal `where`s mix host/device memory spaces under the
+            # host-compute lowering; lion's sign-based update lowers clean.)
+            tx = optax.inject_hyperparams(optax.lion, static_args=("mu_dtype",))(
+                learning_rate=1e-4, b1=0.9, b2=0.99, weight_decay=0.0,
+                mu_dtype=jnp.bfloat16,
+            )
     elif on_tpu:
         tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
     else:
