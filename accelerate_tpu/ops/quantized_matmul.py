@@ -55,13 +55,17 @@ def _k_tile(h: int, block_k: int):
     return None
 
 
-def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype):
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype, k_len, masked_k):
     """Grid (M_tiles, F_tiles, K_tiles); K innermost/serial.
 
     x [bm, bk] bf16; w [bk, bf] int8 codes; s [bf/qblock, bk] fp32 scales
     (transposed so the tile's minor dim is the 128-aligned K — Mosaic's
     (8, 128) tiling rule).  Dequant happens on the VMEM tile: codes *
     per-block scale, broadcast along the quantization block within F.
+
+    ``masked_k``: the K tile does not divide H — select-zero the
+    out-of-range contraction rows of the last tile (a select, so NaN
+    padding cannot leak through) instead of accumulating padding garbage.
     """
     ki = pl.program_id(2)
 
@@ -76,6 +80,13 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype):
     s = s_ref[...].T  # [bk, bf/qblock]
     bk, bf = w.shape
     w = (w.reshape(bk, bf // qblock, qblock) * s[:, :, None]).reshape(bk, bf)
+    if masked_k:
+        # select-zero the out-of-range contraction rows of the partial last
+        # tile (sublane iota — the same pattern as flash's _zero_oob_rows;
+        # a select, so NaN scale padding cannot leak).  x needs no in-kernel
+        # mask: the caller zero-pads it to the tile multiple.
+        rows = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bf), 0)
+        w = jnp.where(rows < k_len, w, 0.0)
     acc[:] += jax.lax.dot_general(
         x_ref[...], w.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -103,20 +114,30 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Opt
         # transpose + dequant setup; at large m the 512 tile double-buffers
         # better (measured on v5e)
         block_k = 1024 if m <= 8 else 512
+    # prefer a tile that divides H exactly (no mask work in the kernel);
+    # otherwise take block_k with in-kernel zeroing of the partial last tile
     bk = _k_tile(h, block_k)
+    masked_k = False
+    aligned_bk = min(block_k // 128 * 128, h // 128 * 128)  # lane-aligned tile
+    if bk is not None and bk <= min(block_k, h) // 2 and aligned_bk > 0:
+        # the largest divisor is at most half the requested block (e.g.
+        # h=5632: divisor 512 vs block 1024) — masked partial tiles win on
+        # per-invocation overhead at decode
+        bk, masked_k = aligned_bk, True
+    elif bk is None and aligned_bk > 0:
+        bk, masked_k = aligned_bk, True
     if (
         qt.scheme != "int8"
         or len(qt.shape) != 2
         # the scale view needs whole q-blocks per row.  Partial *F* grid
         # tiles are fine: out-of-range columns only ever receive garbage that
-        # the clipped output write discards (the K grid, by contrast, is
-        # serial and un-masked — see bk below).
+        # the clipped output write discards; partial K tiles are select-
+        # zeroed in-kernel (masked_k).
         or f % qblock != 0
         # the in-kernel (bk, nb, qblock) dequant reshape needs a lane-width
         # minor dim — quantize with block_size % 128 == 0 for the kernel path
         or qblock % 128 != 0
-        # the serial K grid is un-masked: H must split into whole lane-aligned
-        # tiles or the last K step would accumulate padding garbage
+        # H below one lane-width has no viable K tile
         or bk is None
     ):
         w = dequantize(qt, jnp.bfloat16)
@@ -126,6 +147,13 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Opt
     out_dtype = out_dtype or x.dtype
 
     x2 = x.reshape(m, h).astype(jnp.bfloat16)
+    if masked_k:
+        # defined zeros in x's padded K columns: the kernel's partial last
+        # w tile is select-zeroed, but 0 * NaN through the dot would still
+        # poison the accumulator if x's out-of-range reads were NaN
+        pad_k = -h % bk
+        if pad_k:
+            x2 = jnp.pad(x2, ((0, 0), (0, pad_k)))
     if getattr(qt, "layout", "flat") == "k2d":
         # codes/scales are already stored in the kernel's operand layouts —
         # the decode scan body contains no per-step reshape or transpose
@@ -147,7 +175,8 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Opt
         bf = max(qblock * 8, (bf // (qblock * 8)) * qblock * 8)
 
     out = pl.pallas_call(
-        functools.partial(_qmm_kernel, qblock=qblock, out_dtype=out_dtype),
+        functools.partial(_qmm_kernel, qblock=qblock, out_dtype=out_dtype,
+                          k_len=h, masked_k=masked_k),
         grid=(pl.cdiv(m, bm), pl.cdiv(f, bf), pl.cdiv(h, bk)),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),

@@ -4,8 +4,12 @@ every rank asserts — reference tests/test_multidevice.py:52 pattern)."""
 
 import os
 
+import pytest
+
 from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
 from accelerate_tpu.test_utils import test_script_path as _script_path
+
+pytestmark = pytest.mark.slow  # multi-process self-launches, minutes
 
 
 def _clean_env(**extra):

@@ -86,6 +86,7 @@ def test_flash_gqa():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("rotate", ["allgather", "alltoall"])
 @pytest.mark.parametrize("causal", [True, False])
 def test_ring_attention_matches_native(cp_mesh, rotate, causal):
@@ -103,6 +104,7 @@ def test_ring_attention_matches_native(cp_mesh, rotate, causal):
     np.testing.assert_allclose(out, np.asarray(ref), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_gqa(cp_mesh):
     rng = np.random.default_rng(1)
     q = jnp.asarray(rng.normal(size=(1, 32, 8, 8)), jnp.float32)
@@ -115,6 +117,7 @@ def test_ring_attention_gqa(cp_mesh):
     np.testing.assert_allclose(out, np.asarray(ref), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_differentiable(cp_mesh):
     q, k, v = _qkv(t=16)
     attn = make_ring_attention(cp_mesh, rotate_method="alltoall", zigzag=False)
@@ -214,6 +217,7 @@ def test_flash_segment_ids_in_kernel():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("rotate", ["allgather", "alltoall"])
 @pytest.mark.parametrize("causal", [True, False])
 def test_flash_ring_matches_native(cp_mesh, rotate, causal):
@@ -227,6 +231,7 @@ def test_flash_ring_matches_native(cp_mesh, rotate, causal):
     np.testing.assert_allclose(out, np.asarray(ref), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_flash_ring_differentiable(cp_mesh):
     """Gradients flow through the flash blocks AND the lse combine (the
     g_lse -> delta fold in the kernel backward)."""
@@ -262,6 +267,7 @@ def test_flash_positions_and_lse():
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), atol=1e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("use_flash", [False, True])
 def test_ring_attention_gqa_no_repeat(cp_mesh, use_flash):
     """GQA KV shards travel the ring at kv-head width (no pre-repeat)."""
@@ -276,6 +282,7 @@ def test_ring_attention_gqa_no_repeat(cp_mesh, use_flash):
     np.testing.assert_allclose(out, np.asarray(ref), atol=2e-4)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("rotate", ["allgather", "alltoall"])
 @pytest.mark.parametrize("use_flash", [False, True])
 def test_ring_attention_segment_ids(cp_mesh, rotate, use_flash):
@@ -300,6 +307,7 @@ def test_ring_attention_segment_ids(cp_mesh, rotate, use_flash):
                                    err_msg=f"causal={causal}")
 
 
+@pytest.mark.slow
 def test_ring_attention_segment_ids_differentiable(cp_mesh):
     """Grads flow through the segment-masked ring path (flash in-kernel)."""
     rng = np.random.default_rng(13)

@@ -70,16 +70,54 @@ def test_non_divisible_contraction_dim_clamps_k_tile():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.3)
 
 
-def test_non_lane_aligned_contraction_dim_falls_back():
-    """H = 320 has no multiple-of-128 divisor <= block_k, so the call must
-    take the dequant+matmul fallback and stay exact."""
+def test_non_divisor_contraction_dim_masks_partial_tile():
+    """H = 320 has no multiple-of-128 divisor <= block_k: the kernel takes
+    a masked partial last K tile (select-zeroed rows) and stays exact."""
     rng = np.random.default_rng(7)
     W = rng.normal(size=(320, 1024)).astype(np.float32)
     qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
     x = jnp.asarray(rng.normal(size=(4, 320)), jnp.bfloat16)
-    out = quantized_matmul(x, qt, block_k=256, out_dtype=jnp.float32)
+    out = quantized_matmul(x, qt, block_k=256, out_dtype=jnp.float32, interpret=True)
     ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
     assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.3)
+
+
+def test_half_divisor_boundary_takes_masked_tile():
+    """When the largest divisor is exactly half the requested block (the
+    down_proj-style case), the masked full-size tile is chosen — gate is
+    <=, not < (r2 review finding) — and stays exact."""
+    rng = np.random.default_rng(9)
+    W = rng.normal(size=(1280, 512)).astype(np.float32)  # divisor 256 = 512//2
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(4, 1280)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, block_k=512, out_dtype=jnp.float32, interpret=True)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
+    # bf16 accumulation-order noise at h=1280 reaches ~0.5 on outputs of
+    # magnitude ~100; the masked tile is exact (NaN/garbage would be >>1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.6)
+
+
+def test_unaligned_block_k_request_is_aligned_down():
+    """A caller-supplied block_k that is not a multiple of 128 is aligned
+    down instead of producing a Mosaic-illegal tile (r2 review finding)."""
+    rng = np.random.default_rng(10)
+    W = rng.normal(size=(1000, 256)).astype(np.float32)
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(4, 1000)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, block_k=200, out_dtype=jnp.float32, interpret=True)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.4)
+
+
+def test_tiny_contraction_dim_falls_back():
+    """H < 128 has no viable lane-aligned K tile at all -> dequant fallback."""
+    rng = np.random.default_rng(8)
+    W = rng.normal(size=(96, 256)).astype(np.float32)
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(4, 96)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, out_dtype=jnp.float32)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.3)
 
 
