@@ -220,7 +220,20 @@ def load_accelerator_state(
         }
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(input_dir / TRAIN_STATE_DIR, item=template)
-        new_arrays = [restored.get(str(i), a) for i, a in enumerate(arrays)]
+
+        def _restore_placement(x, a):
+            # orbax restores into device memory; host-offloaded members
+            # (pinned_host masters/moments) must return to their original
+            # memory kind or the next train step mixes memory spaces
+            if isinstance(x, jax.Array) and isinstance(a, jax.Array):
+                kind = getattr(a.sharding, "memory_kind", None)
+                if kind not in (None, "device") and x.sharding.memory_kind != kind:
+                    return jax.device_put(x, a.sharding)
+            return x
+
+        new_arrays = [
+            _restore_placement(restored.get(str(i), a), a) for i, a in enumerate(arrays)
+        ]
         restored_state = jax.tree_util.tree_unflatten(treedef, new_arrays)
 
     rng_file = input_dir / RNG_STATE_NAME.format(accelerator.process_index)

@@ -43,11 +43,10 @@ def _k_tile(h: int, block_k: int):
     """Largest lane-aligned (multiple-of-128) divisor of ``h`` that fits in
     ``block_k``, or None.
 
-    The K grid dimension is serial and un-masked: a tile size that does not
-    divide H would make the last K step read unspecified padding rows and
-    accumulate them into every output element (e.g. Llama-7B's 11008
-    intermediate dim with the default block_k=512 → here 256 is chosen
-    instead, keeping the kernel path while staying exact).
+    An exact divisor tile needs no in-kernel masking; when the best divisor
+    is small relative to ``block_k`` (or none exists), the caller switches
+    to a full-size tile with a select-zeroed partial last K step instead
+    (``masked_k`` in :func:`quantized_matmul`).
     """
     for bk in range(min(block_k, h) // 128 * 128, 0, -128):
         if h % bk == 0:

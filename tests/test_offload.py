@@ -137,3 +137,28 @@ def test_offload_with_reference_accelerate_loop(  # the reference loop shape
             state, m = step(state, batch)
             losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
+
+
+def test_offload_state_checkpoint_roundtrip(tmp_path):
+    """save_state/load_state round-trips an offload-configured TrainState and
+    training continues (on TPU the restore also re-pins host-resident
+    members to pinned_host — checkpointing.py _restore_placement; memory
+    kinds degrade to device on the CPU mesh so this covers the flow)."""
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_dir=str(tmp_path),
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=0, cpu_offload=True),
+    )
+    state = acc.create_train_state(_mlp_params(), acc.prepare(optax.adamw(1e-2)))
+    step = acc.prepare_train_step(_mlp_loss)
+    for batch in _batches(n=2):
+        state, _ = step(state, batch)
+    w_before = np.asarray(state.params["dense"]["kernel"])
+    path = acc.save_state(train_state=state)
+    zeroed = state.replace(params=jax.tree_util.tree_map(jnp.zeros_like, state.params))
+    restored = acc.load_state(path, train_state=zeroed)
+    np.testing.assert_allclose(np.asarray(restored.params["dense"]["kernel"]), w_before)
+    restored, m = step(restored, _batches(n=1)[0])
+    assert np.isfinite(float(m["loss"]))

@@ -121,9 +121,11 @@ def test_tiny_contraction_dim_falls_back():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.3)
 
 
-def test_llama7b_intermediate_dim_uses_kernel_tile():
-    """11008 (Llama-7B down_proj contraction dim) % 512 != 0 — the clamp must
-    pick a divisor, not fall back and not read padding."""
+def test_k_tile_divisor_helper():
+    """_k_tile finds the largest lane-aligned divisor (None when there is
+    none).  Note the production path may override a small divisor with a
+    masked full-size tile — e.g. Llama-7B's 11008 (divisor 256) runs masked
+    bk=512; see test_half_divisor_boundary_takes_masked_tile."""
     from accelerate_tpu.ops.quantized_matmul import _k_tile
 
     assert _k_tile(11008, 512) == 256
