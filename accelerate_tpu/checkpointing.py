@@ -219,12 +219,22 @@ def load_accelerator_state(
             if a is not None
         }
         ckptr = ocp.PyTreeCheckpointer()
-        restored = ckptr.restore(input_dir / TRAIN_STATE_DIR, item=template)
+        # restore each leaf directly into the template's sharding (which
+        # carries the memory kind): host-offloaded masters/moments land in
+        # pinned host memory without first materializing in HBM — at 7B the
+        # device round trip would OOM the very configs offload exists for
+        restore_args = {
+            str(i): ocp.ArrayRestoreArgs(sharding=a.sharding)
+            for i, a in enumerate(arrays)
+            if isinstance(a, jax.Array)
+        }
+        restored = ckptr.restore(
+            input_dir / TRAIN_STATE_DIR, item=template, restore_args=restore_args
+        )
 
         def _restore_placement(x, a):
-            # orbax restores into device memory; host-offloaded members
-            # (pinned_host masters/moments) must return to their original
-            # memory kind or the next train step mixes memory spaces
+            # safety net: if a restore path ignored the sharding request,
+            # re-pin rather than letting the train step mix memory spaces
             if isinstance(x, jax.Array) and isinstance(a, jax.Array):
                 kind = getattr(a.sharding, "memory_kind", None)
                 if kind not in (None, "device") and x.sharding.memory_kind != kind:
