@@ -90,6 +90,8 @@ try:
         beam_search,
         generate,
         generate_seq2seq,
+        generate_streamed,
+        place_params_host,
         sample_logits,
     )
 except ImportError:  # pragma: no cover

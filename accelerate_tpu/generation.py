@@ -350,3 +350,153 @@ def generate_seq2seq(
 @lru_cache(maxsize=32)
 def _jitted_seq2seq(model, generation_config, decoder_start_token_id):
     return jax.jit(partial(_seq2seq_impl, model, generation_config, decoder_start_token_id))
+
+
+# ---------------------------------------------------------------------------
+# Over-HBM inference: layer-streamed generation (reference AlignDevicesHook /
+# disk-offload decode, hooks.py:227 + big_modeling.py:310 — the OPT-30B/70B
+# "model larger than the accelerator" mode)
+# ---------------------------------------------------------------------------
+
+
+def place_params_host(params):
+    """Move a param tree (including QuantizedTensor leaves) into pinned host
+    memory — the staging tier :func:`generate_streamed` streams layers from.
+    No-op where the backend lacks in-jit memory kinds (CPU tests)."""
+    from .parallel.sharding import host_offload_supported, single_device_sharding
+
+    if not host_offload_supported():
+        return params
+    host = single_device_sharding("pinned_host")
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, host), params)
+
+
+@lru_cache(maxsize=8)
+def _streamed_fns(model):
+    """The jitted pieces of a streamed forward, shared across layers (every
+    layer has identical shapes, so each fn compiles once)."""
+    from .models.llama import LMHead, RMSNorm
+    from .parallel.sharding import host_offload_supported, single_device_sharding
+
+    cfg = model.config
+    block = type(model).block_cls
+    kinds_ok = host_offload_supported()
+
+    def _fetch(tree):
+        # host -> HBM copy of one layer's weights, inside the jit (single
+        # dispatch per layer; the transfer runs on the TPU host's PCIe)
+        if not kinds_ok:
+            return tree
+        dev = single_device_sharding()
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, dev), tree)
+
+    @jax.jit
+    def embed_fn(embedding, ids):
+        return jnp.take(embedding, ids, axis=0).astype(cfg.dtype)
+
+    @jax.jit
+    def block_fn(layer_params, x, positions, cache_i, write_mask):
+        return block(cfg).apply(
+            {"params": _fetch(layer_params)}, x, positions, None, cache_i, write_mask
+        )
+
+    @jax.jit
+    def head_fn(norm_scale, head_w, x):
+        x = RMSNorm(cfg.rms_norm_eps, cfg.dtype).apply(
+            {"params": {"scale": norm_scale}}, x
+        )
+        if cfg.tie_word_embeddings:
+            # head_w is the [V, H] embedding table — contract hidden against
+            # its dim 1, mirroring the model's tied path (models/llama.py)
+            return jax.lax.dot_general(
+                x, head_w.astype(cfg.dtype), (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        return LMHead(cfg.vocab_size, cfg.dtype).apply(
+            {"params": {"kernel": head_w}}, x
+        )
+
+    return embed_fn, block_fn, head_fn
+
+
+def generate_streamed(
+    model,
+    params,
+    input_ids,
+    generation_config: Optional[GenerationConfig] = None,
+    *,
+    prompt_lengths=None,
+    rng=None,
+):
+    """Generate from a model whose weights do NOT fit in HBM.
+
+    ``params`` lives in (pinned) host memory — see :func:`place_params_host`
+    — and every forward streams one layer's weights to the device at a
+    time: HBM holds one layer + the KV cache, so the model-size ceiling is
+    host RAM, not HBM (the reference's CPU/disk-offload inference mode,
+    OPT-30B on a 24GB card at seconds/token — same trade here).  int8
+    ``QuantizedTensor`` leaves stream at one byte per weight and hit the
+    Pallas in-tile-dequant matmul on device.
+
+    The decode loop is host-driven (one dispatch per layer per token) —
+    latency is dominated by the per-token PCIe sweep over the weights,
+    exactly like the reference's offload decode.
+    """
+    generation_config = generation_config or GenerationConfig()
+    cfg = model.config
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    b, t_prompt = input_ids.shape
+    if prompt_lengths is None:
+        prompt_lengths = jnp.full((b,), t_prompt, jnp.int32)
+    else:
+        prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    p = params["params"] if "params" in params else params
+    from .parallel.sharding import host_offload_supported, single_device_sharding
+
+    embed = p["embed_tokens"]["embedding"]
+    head = embed if cfg.tie_word_embeddings else p["lm_head"]["kernel"]
+    norm_scale = p["norm"]["scale"]
+    if host_offload_supported():
+        # the embedding/norm/head tier stays HBM-resident (about one layer's
+        # worth) — re-streaming the [V, H] table every token would waste
+        # ~0.5 GiB of PCIe per step at 7B-class vocab sizes
+        dev = single_device_sharding()
+        embed = jax.device_put(embed, dev)
+        head = embed if cfg.tie_word_embeddings else jax.device_put(head, dev)
+        norm_scale = jax.device_put(norm_scale, dev)
+    max_len = t_prompt + generation_config.max_new_tokens
+    cache = init_cache(cfg, b, max_len)
+    embed_fn, block_fn, head_fn = _streamed_fns(model)
+
+    def forward(ids, positions, write_mask):
+        x = embed_fn(embed, ids)
+        for i in range(cfg.num_hidden_layers):
+            x, cache[i] = block_fn(p[f"layers_{i}"], x, positions, cache[i], write_mask)
+        return head_fn(norm_scale, head, x)
+
+    positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
+    logits = forward(positions=positions, ids=input_ids,
+                     write_mask=positions < prompt_lengths[:, None])
+    last = jnp.take_along_axis(logits, (prompt_lengths - 1)[:, None, None], axis=1)[:, 0]
+
+    eos = generation_config.eos_token_id
+    cur_pos = prompt_lengths
+    done = jnp.zeros((b,), bool)
+    out = []
+    for step in range(generation_config.max_new_tokens):
+        rng, step_rng = jax.random.split(rng)
+        token = sample_logits(last, step_rng, generation_config)
+        token = jnp.where(done, generation_config.pad_token_id, token)
+        if eos is not None:
+            done = done | (token == eos)
+        out.append(token)
+        if step + 1 == generation_config.max_new_tokens:
+            break
+        logits = forward(ids=token[:, None], positions=cur_pos[:, None],
+                         write_mask=~done[:, None])
+        last = logits[:, 0]
+        cur_pos = cur_pos + 1
+    return jnp.stack(out, axis=1)

@@ -307,6 +307,13 @@ def with_memory_kind(sharding: NamedSharding, kind: str) -> NamedSharding:
     return NamedSharding(sharding.mesh, sharding.spec, memory_kind=kind)
 
 
+def single_device_sharding(memory_kind: str = "device") -> NamedSharding:
+    """Replicated sharding over the first local device, in the given memory
+    kind — the placement handle for single-chip host-offload tiers."""
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("d",))
+    return NamedSharding(mesh, PartitionSpec(), memory_kind=memory_kind)
+
+
 def host_plan(plan):
     """Map a sharding plan into ``pinned_host`` memory (same mesh/specs)."""
     return jax.tree_util.tree_map(

@@ -347,3 +347,50 @@ def test_generate_quantized_via_apply_wrapper(tiny_model):
                    apply_fn=quantized_apply(model.apply))
     ref = generate(model, params, prompt, GenerationConfig(max_new_tokens=6))
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_streamed_matches_regular(tiny_model):
+    """Layer-streamed decode (the over-HBM inference mode) matches the
+    one-jit generate.  Token streams are compared where logits are
+    decisive; near-ties (the per-layer jits fuse differently, so float
+    noise can flip an argmax between two ~equal logits) are tolerated by
+    also accepting positions where the manual no-cache forward agrees with
+    the streamed choice."""
+    from accelerate_tpu.generation import generate_streamed
+    from accelerate_tpu.utils.quantization import QuantizationConfig, quantize_params
+
+    model, params = tiny_model
+    prompt = jnp.asarray([[5, 42, 7]], jnp.int32)
+    cfg = GenerationConfig(max_new_tokens=4)
+    ref = generate(model, params, prompt, cfg)
+    st = generate_streamed(model, params, prompt, cfg)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(st))
+
+    # variable-length rows + EOS padding + int8 leaves: compare step tokens,
+    # accepting a divergence only if the two candidates' full-forward logits
+    # are within float noise of each other at that step (a genuine tie)
+    batch = jnp.asarray([[5, 42, 7, 9], [11, 3, 0, 0]], jnp.int32)
+    lens = jnp.asarray([4, 2])
+    cfg = GenerationConfig(max_new_tokens=5, eos_token_id=2)
+    qparams = quantize_params(params, QuantizationConfig(load_in_8bit=True, min_size=1))
+    for p in (params, qparams):
+        ref = np.asarray(generate(model, p, batch, cfg, prompt_lengths=lens))
+        st = np.asarray(generate_streamed(model, p, batch, cfg, prompt_lengths=lens))
+        if np.array_equal(ref, st):
+            continue
+        # divergences must start at a near-tie, and the streams must agree
+        # up to the first divergent step per row
+        for r in range(ref.shape[0]):
+            row_ref, row_st = ref[r], st[r]
+            if np.array_equal(row_ref, row_st):
+                continue
+            first = int(np.argmax(row_ref != row_st))
+            seq = np.concatenate([np.asarray(batch[r][: int(lens[r])]), row_st[:first]])
+            logits = np.asarray(
+                model.apply(p, jnp.asarray(seq[None], jnp.int32))
+            )[0, -1].astype(np.float32)
+            a, b = int(row_ref[first]), int(row_st[first])
+            assert abs(logits[a] - logits[b]) < 2e-2, (
+                f"row {r} step {first}: {a} vs {b} not a near-tie "
+                f"({logits[a]:.4f} vs {logits[b]:.4f})"
+            )
