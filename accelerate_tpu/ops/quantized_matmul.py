@@ -118,12 +118,13 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Opt
     bk = _k_tile(h, block_k)
     masked_k = False
     aligned_bk = min(block_k // 128 * 128, h // 128 * 128)  # lane-aligned tile
-    if bk is not None and bk <= min(block_k, h) // 2 and aligned_bk > 0:
-        # the largest divisor is at most half the requested block (e.g.
-        # h=5632: divisor 512 vs block 1024) — masked partial tiles win on
-        # per-invocation overhead at decode
-        bk, masked_k = aligned_bk, True
-    elif bk is None and aligned_bk > 0:
+    if aligned_bk > 0 and (bk is None or (bk < 384 and aligned_bk > bk)):
+        # No divisor, or only a small one (the measured-bad 128/256 cases —
+        # e.g. Llama-7B's 11008): a strictly larger full-size tile with a
+        # select-zeroed partial last K step beats the many small serial
+        # steps.  Divisors >= 384 stay exact/unmasked: 512 measured better
+        # than masked-1024 on v5e decode (the per-tile select costs more
+        # than the larger tile saves), and 384 sits in that regime.
         bk, masked_k = aligned_bk, True
     if (
         qt.scheme != "int8"
