@@ -52,9 +52,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "offload"):
             raise ValueError(
-                f"remat_policy must be 'full' or 'dots', got {self.remat_policy!r}"
+                f"remat_policy must be 'full', 'dots' or 'offload', got {self.remat_policy!r}"
             )
 
     @property
@@ -337,20 +337,55 @@ class LlamaForCausalLM(nn.Module):
         )
         x = embed(input_ids)
         block = type(self).block_cls
-        if cfg.remat and cache is None:
+        offload_remat = False
+        if cfg.remat and cache is None and cfg.remat_policy == "offload":
+            from ..parallel.sharding import host_offload_supported
+
+            offload_remat = host_offload_supported()
+            if not offload_remat:  # CPU test mesh: degrade to full remat
+                block = nn.remat(block, policy=jax.checkpoint_policies.nothing_saveable)
+        elif cfg.remat and cache is None:
             policy = {
                 "full": jax.checkpoint_policies.nothing_saveable,
                 "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             }[cfg.remat_policy]
             block = nn.remat(block, policy=policy)
         new_cache = [] if cache is not None else None
-        for i in range(cfg.num_hidden_layers):
-            layer = block(cfg, name=f"layers_{i}")
-            if cache is not None:
-                x, layer_cache = layer(x, positions, segment_ids, cache[i], cache_write_mask)
-                new_cache.append(layer_cache)
-            else:
-                x = layer(x, positions, segment_ids)
+        if offload_remat:
+            # Activation offload (the ALST/Ulysses long-context enabler,
+            # reference sequence_parallelism.md): one remat region over the
+            # whole stack whose only saved values — the inter-block
+            # activations — are offloaded to pinned host memory.  HBM holds
+            # a couple of boundaries in flight instead of one per layer
+            # (~6 GiB at 128k tokens); backward fetches them back over PCIe.
+            from jax.ad_checkpoint import checkpoint_name
+
+            # nested remat: the inner per-block remat keeps each block's
+            # recomputed intermediates block-local during backward (without
+            # it, XLA overlaps several layers' recomputes and the 1GiB MLP
+            # intermediates stack up — measured OOM at 128k)
+            inner = nn.remat(block, policy=jax.checkpoint_policies.nothing_saveable)
+
+            def _stack(mdl, x, positions, segment_ids):
+                for i in range(cfg.num_hidden_layers):
+                    x = inner(cfg, name=f"layers_{i}")(x, positions, segment_ids)
+                    x = checkpoint_name(x, "block_boundary")
+                return x
+
+            offload_policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=["block_boundary"],
+                offload_src="device", offload_dst="pinned_host",
+            )
+            x = nn.remat(_stack, policy=offload_policy)(self, x, positions, segment_ids)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                layer = block(cfg, name=f"layers_{i}")
+                if cache is not None:
+                    x, layer_cache = layer(x, positions, segment_ids, cache[i], cache_write_mask)
+                    new_cache.append(layer_cache)
+                else:
+                    x = layer(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         if output_hidden:
             # pre-head states for the fused linear+CE loss path (the vocab

@@ -208,12 +208,15 @@ def main():
         # logits out of HBM, full activations fit in 16G, worth +7% step
         # time over remat_policy="dots" (measured on v5e)
         long_ctx = seq > 4096
-        # ~600M decoder: fits one v5e chip with fp32 Adam state at seq 2048
+        # ~600M decoder: fits one v5e chip with fp32 Adam state at seq 2048.
+        # Past ~96k the remat boundary activations alone exceed HBM — the
+        # "offload" policy parks them in pinned host memory
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
             max_position_embeddings=seq, attn_implementation="flash",
             remat=long_ctx, dtype=jnp.bfloat16,
+            remat_policy="offload" if seq > 98304 else "full",
         )
         # batch 10 is the HBM sweet spot without remat (8: -4%, 12: OOM)
         batch = args.batch or (1 if long_ctx else 10)
@@ -287,8 +290,9 @@ def main():
         extra_report["offload"] = "pinned_host"
     # fused linear+CE keeps the [B,T,V] logits out of HBM, which is what lets
     # the cheaper "dots" remat policy fit on a 16G chip; 4 vocab chunks
-    # measured best on v5e (vs 8: +1%, vs 16: +1.2%); long context wants 16
-    chunks = (16 if seq > 4096 else 4) if on_tpu else None
+    # measured best on v5e (vs 8: +1%, vs 16: +1.2%); long context needs the
+    # per-chunk fp32 logits [B, T/chunks, V] bounded (~250MB at 128k/64)
+    chunks = (max(16, seq // 2048) if seq > 4096 else 4) if on_tpu else None
     # global-norm clipping is an all-grads barrier; at 7B-on-one-chip the
     # full grad tree cannot be resident at once, so the 7B config trains
     # unclipped (per-leaf norm metric still reported)

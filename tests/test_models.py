@@ -324,3 +324,35 @@ def test_t5_remat_matches_plain():
     g2 = jax.grad(make_t5_loss_fn(remat))(params, batch)
     for a, b in zip(jax.tree_util.tree_leaves(g1), jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_offload_remat_policy_degrades_and_trains(monkeypatch):
+    """remat_policy="offload" (activation boundaries in pinned host memory
+    on TPU) keeps param paths and numerics; on the CPU mesh it degrades to
+    full remat, so this pins structure + gradient flow + loss parity — and
+    then forces the real _stack branch (host_offload_supported patched
+    True) to pin its param-path parity too."""
+    from accelerate_tpu.models import make_llama_loss_fn
+
+    cfg = LlamaConfig.tiny(remat=True, remat_policy="offload")
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.ones((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    assert "layers_0" in params["params"] and "layers_1" in params["params"]
+    loss_fn = make_llama_loss_fn(model)
+    loss, grads = jax.value_and_grad(loss_fn)(params, {"input_ids": ids, "labels": ids})
+    assert all(bool(jnp.isfinite(g).all()) for g in jax.tree_util.tree_leaves(grads))
+    ref_cfg = LlamaConfig.tiny(remat=True, remat_policy="full")
+    ref = make_llama_loss_fn(LlamaForCausalLM(ref_cfg))(params, {"input_ids": ids, "labels": ids})
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+    # the real offload branch (the nn.remat'd _stack function) must produce
+    # the same param structure — a scoping regression would otherwise only
+    # surface on TPU hardware at checkpoint load
+    monkeypatch.setattr(
+        "accelerate_tpu.parallel.sharding.host_offload_supported", lambda: True
+    )
+    params_stack = model.init(jax.random.PRNGKey(0), ids)
+    assert jax.tree_util.tree_structure(params_stack) == jax.tree_util.tree_structure(params)
+    loss_stack = loss_fn(params, {"input_ids": ids, "labels": ids})
+    np.testing.assert_allclose(float(loss_stack), float(ref), rtol=1e-5)
