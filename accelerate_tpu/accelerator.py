@@ -632,6 +632,17 @@ class Accelerator:
         # storage stays in device memory but the host-compute update region
         # is still exercised, so numerics are pinned by the CPU suite.
         kinds_ok = offload_opt and host_offload_supported()
+        if kinds_ok and mode == "across_steps" and accum_steps > 1:
+            # across_steps carries the fp32 grad_accum tree in HBM between
+            # steps (it feeds a lax.cond, which cannot mix memory spaces), so
+            # the 'HBM never holds the fp32 grad tree' offload invariant does
+            # not hold in this mode — at 7B that tree alone exceeds a v5e.
+            logger.warning(
+                "gradient accumulation mode='across_steps' keeps the fp32 "
+                "accumulation tree resident in device memory, defeating part "
+                "of the cpu_offload memory budget; use mode='in_step' (the "
+                "default) for offload configs sized against HBM."
+            )
 
         def _stored_params_shardings():
             ss = self._state_sharding
@@ -793,7 +804,12 @@ class Accelerator:
                 def microbatch(carry, mb):
                     grads_acc, loss_acc, _prev_aux = carry
                     loss, aux, grads = compute_grads(params_c, mb, use_rng, state.loss_scale)
-                    grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                    # the carry accumulates in fp32 regardless of the grad
+                    # wire dtype: summing accum_steps microbatches in bf16
+                    # would lose ~log2(accum_steps) mantissa bits
+                    grads_acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+                    )
                     # aux rides the carry (overwritten each microbatch) so only
                     # one copy is live — stacking it as scan output would cost
                     # accum_steps× the aux memory.
@@ -824,6 +840,12 @@ class Accelerator:
                     microbatch, (zeros, jnp.float32(0.0), aux0), micro
                 )
                 grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+                if kinds_ok and not policy.needs_loss_scaling:
+                    # one downcast of the accumulated mean before the D2H
+                    # stream: the host region upcasts again before touching
+                    # the fp32 moments/masters, so this halves the wire bytes
+                    # without giving up fp32 accumulation across microbatches
+                    grads = policy.cast_to_compute(grads)
                 loss = loss_sum / accum_steps
                 new_state, metrics = apply_update(state.replace(rng=rng), grads, loss)
                 if has_aux:

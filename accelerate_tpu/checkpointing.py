@@ -213,21 +213,25 @@ def load_accelerator_state(
     restored_state = None
     if train_state is not None:
         arrays, treedef = jax.tree_util.tree_flatten(train_state)
-        template = {
-            str(i): ocp.utils.to_shape_dtype_struct(a) if isinstance(a, jax.Array) else a
-            for i, a in enumerate(arrays)
-            if a is not None
-        }
+        # template and restore_args are built in one pass so their key sets
+        # cannot drift (orbax raises a tree-structure mismatch if they do).
+        # jax.Array leaves restore directly into the template's sharding
+        # (which carries the memory kind): host-offloaded masters/moments
+        # land in pinned host memory without first materializing in HBM — at
+        # 7B the device round trip would OOM the very configs offload exists
+        # for.  Non-jax.Array leaves (e.g. numpy stats in opt_state) get a
+        # plain RestoreArgs entry.
+        template, restore_args = {}, {}
+        for i, a in enumerate(arrays):
+            if a is None:
+                continue
+            if isinstance(a, jax.Array):
+                template[str(i)] = ocp.utils.to_shape_dtype_struct(a)
+                restore_args[str(i)] = ocp.ArrayRestoreArgs(sharding=a.sharding)
+            else:
+                template[str(i)] = a
+                restore_args[str(i)] = ocp.RestoreArgs()
         ckptr = ocp.PyTreeCheckpointer()
-        # restore each leaf directly into the template's sharding (which
-        # carries the memory kind): host-offloaded masters/moments land in
-        # pinned host memory without first materializing in HBM — at 7B the
-        # device round trip would OOM the very configs offload exists for
-        restore_args = {
-            str(i): ocp.ArrayRestoreArgs(sharding=a.sharding)
-            for i, a in enumerate(arrays)
-            if isinstance(a, jax.Array)
-        }
         restored = ckptr.restore(
             input_dir / TRAIN_STATE_DIR, item=template, restore_args=restore_args
         )

@@ -196,14 +196,38 @@ def _quantize_int8_on_device(arr: jax.Array, block: int) -> QuantizedTensor:
                            layout="k2d" if k2d else "flat")
 
 
-def quantize(arr, config: QuantizationConfig) -> QuantizedTensor:
-    if (
-        isinstance(arr, jax.Array)
-        and config.scheme == "int8"
-        and arr.is_fully_addressable  # single-process arrays only
-        and jax.devices()[0].platform != "cpu"
-    ):
-        return _quantize_int8_on_device(arr, config.block_size)
+def _accelerator_backed(arr) -> bool:
+    """True only when ``arr`` already lives in accelerator device memory —
+    quantizing a host/numpy-backed leaf on device would transiently stage the
+    full-precision tensor in HBM, which host-staged flows exist to avoid."""
+    if not isinstance(arr, jax.Array):
+        return False
+    if getattr(arr.sharding, "memory_kind", None) not in (None, "device"):
+        return False
+    try:
+        return all(d.platform != "cpu" for d in arr.devices())
+    except Exception:
+        return False
+
+
+def quantize(arr, config: QuantizationConfig, on_device: Optional[bool] = None) -> QuantizedTensor:
+    explicit = on_device is not None
+    if on_device is None:
+        on_device = _accelerator_backed(arr)
+    if on_device and config.scheme == "int8" and jax.devices()[0].platform != "cpu":
+        if not isinstance(arr, jax.Array) and explicit:
+            # explicit opt-in: the caller accepts staging the leaf in HBM
+            arr = jnp.asarray(arr)
+        if isinstance(arr, jax.Array) and arr.is_fully_addressable:  # single-process arrays only
+            return _quantize_int8_on_device(arr, config.block_size)
+    if on_device and explicit:
+        import warnings
+
+        warnings.warn(
+            "quantize(on_device=True) could not take the on-device path "
+            f"(scheme={config.scheme!r}, platform="
+            f"{jax.devices()[0].platform!r}); falling back to the host path."
+        )
     np_arr = np.asarray(jax.device_get(arr) if isinstance(arr, jax.Array) else arr)
     orig_dtype = np_arr.dtype
     if config.scheme == "int8":

@@ -61,6 +61,30 @@ def test_save_load_roundtrip(tmp_path):
     assert float(restored.opt_state[0].mu["a"]) != 0.0
 
 
+def test_roundtrip_with_non_jax_array_leaf(tmp_path):
+    """restore_args must cover every template key: a numpy leaf inside the
+    state (e.g. host-side stats in opt_state) previously made orbax raise a
+    tree-structure mismatch instead of restoring."""
+    acc, dl, state, step = _setup(tmp_path)
+    state = state.replace(opt_state=(state.opt_state, np.arange(3, dtype=np.float32)))
+
+    def _unwrap_step(st, batch):
+        inner = st.replace(opt_state=st.opt_state[0])
+        new_inner, m = step(inner, batch)
+        return new_inner.replace(opt_state=(new_inner.opt_state, st.opt_state[1])), m
+
+    for batch in dl:
+        state, _ = _unwrap_step(state, batch)
+    ckpt_dir = acc.save_state(train_state=state)
+    a_saved = float(state.params["a"])
+
+    template = acc.create_train_state(regression_init_params(), optax.adam(0.05))
+    template = template.replace(opt_state=(template.opt_state, np.zeros(3, dtype=np.float32)))
+    restored = acc.load_state(ckpt_dir, train_state=template)
+    assert float(restored.params["a"]) == a_saved
+    np.testing.assert_allclose(np.asarray(restored.opt_state[1]), np.arange(3, dtype=np.float32))
+
+
 def test_automatic_naming_and_retention(tmp_path):
     acc, dl, state, step = _setup(tmp_path)
     for i in range(3):

@@ -35,6 +35,23 @@ def test_int8_roundtrip_accuracy():
     assert rel < 0.01, rel
 
 
+def test_quantize_placement_gate():
+    """The on-device fast path engages only for accelerator-backed arrays:
+    host/numpy-backed inputs must not be jit-committed to the default device
+    (which would transiently stage the full-precision leaf in HBM)."""
+    from accelerate_tpu.utils.quantization import _accelerator_backed
+
+    w = _weight()
+    assert not _accelerator_backed(w)  # numpy
+    if jax.default_backend() == "cpu":
+        assert not _accelerator_backed(jnp.asarray(w))  # CPU-device jax.Array
+    # explicit opt-out works regardless of placement
+    qt = quantize(jnp.asarray(w), QuantizationConfig(load_in_8bit=True), on_device=False)
+    assert isinstance(qt.data, np.ndarray) or not isinstance(qt.data, jax.Array)
+    back = np.asarray(dequantize(qt, jnp.float32))
+    assert np.abs(back - w).max() / np.abs(w).max() < 0.01
+
+
 def test_nf4_roundtrip_accuracy():
     w = _weight()
     qt = quantize(w, QuantizationConfig(load_in_4bit=True))
