@@ -23,6 +23,9 @@ from .tpu import tpu_command_parser
 
 
 def main():
+    # importing installs rich tracebacks iff ACCELERATE_ENABLE_RICH is set
+    from ..utils import rich as _rich  # noqa: F401
+
     parser = argparse.ArgumentParser(
         "accelerate-tpu",
         usage="accelerate-tpu <command> [<args>]",

@@ -59,6 +59,33 @@ class Policy:
         return self.compute_dtype == jnp.float16
 
 
+def fp8_hardware_supported() -> bool:
+    """Whether the local accelerator has native fp8 matmul paths.
+
+    TPU generations before v6 (Trillium) have no fp8 MXU: ``fp8_dot``'s
+    quantize/descale work is pure overhead there (measured −7% vs bf16 on
+    v5e — benchmarks/README.md).  The reference's fp8 backend auto-pick
+    degrades gracefully on unsupported hardware (reference
+    accelerator.py:480-503); this is the capability probe behind the
+    equivalent gate here."""
+    try:
+        dev = jax.devices()[0]
+    except RuntimeError:  # pragma: no cover - no backend
+        return False
+    if dev.platform == "tpu":
+        return _tpu_kind_has_fp8(getattr(dev, "device_kind", ""))
+    if dev.platform == "gpu":  # pragma: no cover - no GPU in CI
+        return True  # XLA:GPU lowers fp8 dots natively on Ada/Hopper+
+    return False
+
+
+def _tpu_kind_has_fp8(device_kind: str) -> bool:
+    import re
+
+    m = re.search(r"v(\d+)", device_kind.lower())
+    return bool(m and int(m.group(1)) >= 6)
+
+
 def get_policy(mixed_precision: str | MixedPrecisionType) -> Policy:
     """Map the reference's ``mixed_precision`` strings to a Policy
     (reference AcceleratorState precision resolution state.py:940-985)."""

@@ -1,0 +1,41 @@
+"""Rich console helpers (reference ``utils/rich.py``: traceback install
+gated on availability; opt-in via ``ACCELERATE_ENABLE_RICH``, reference
+``utils/imports.py:289``).
+
+Importing this module with rich installed activates pretty tracebacks for
+the current process — launcher workers opt in by exporting
+``ACCELERATE_ENABLE_RICH=true`` (see ``commands/launch.py``).
+"""
+
+from .environment import parse_flag_from_env
+from .imports import is_rich_available
+
+
+def rich_enabled() -> bool:
+    """rich is installed *and* the user opted in via env."""
+    return is_rich_available() and parse_flag_from_env("ACCELERATE_ENABLE_RICH")
+
+
+def install_rich_tracebacks(show_locals: bool = False) -> bool:
+    """Install rich's traceback formatter; returns whether it engaged."""
+    if not is_rich_available():
+        return False
+    from rich.traceback import install
+
+    install(show_locals=show_locals)
+    return True
+
+
+def get_console():
+    """A rich Console for pretty CLI output (raises if rich is missing)."""
+    if not is_rich_available():
+        raise ModuleNotFoundError(
+            "rich is not installed; install it or unset ACCELERATE_ENABLE_RICH"
+        )
+    from rich.console import Console
+
+    return Console()
+
+
+if rich_enabled():  # pragma: no cover - env-dependent side effect
+    install_rich_tracebacks()

@@ -176,3 +176,17 @@ def test_tqdm_main_process_only():
 
     with pytest.raises(ValueError, match="main_process_only"):
         tqdm(True, range(3))
+
+
+def test_rich_helpers(monkeypatch):
+    from accelerate_tpu.utils import rich as rich_mod
+
+    # opt-in is env-gated (reference utils/imports.py:289)
+    monkeypatch.delenv("ACCELERATE_ENABLE_RICH", raising=False)
+    assert not rich_mod.rich_enabled()
+    monkeypatch.setenv("ACCELERATE_ENABLE_RICH", "true")
+    assert rich_mod.rich_enabled() == rich_mod.is_rich_available()
+    if rich_mod.is_rich_available():
+        assert rich_mod.install_rich_tracebacks() is True
+        console = rich_mod.get_console()
+        assert hasattr(console, "print")
