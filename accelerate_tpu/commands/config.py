@@ -51,6 +51,9 @@ class LaunchConfig:
     mixed_precision: str = "no"  # no | bf16 | fp16 | fp8
     gradient_accumulation_steps: int = 1
     debug: bool = False
+    # gang restarts after a worker crash (torchrun-elasticity analog for the
+    # local spawner; crashed state is recovered via checkpoint-resume)
+    max_restarts: int = 0
     # -- parallelism axes (PARALLELISM_CONFIG_* transport) -----------------
     dp_replicate_size: int = 1
     dp_shard_size: int = -1  # -1: infer remainder at runtime
@@ -126,13 +129,12 @@ def _ask(prompt: str, default, cast=str):
 
 
 def _ask_choice(prompt: str, choices: tuple, default):
-    """Re-prompt until the answer is one of ``choices`` (reference cluster.py
-    `_ask_options` menu behavior, as a validated free-text prompt)."""
-    while True:
-        raw = _ask(f"{prompt} ({'/'.join(choices)})", default)
-        if raw in choices:
-            return raw
-        print(f"  -> {raw!r} is not one of {choices}")
+    """Choice question: arrow-key bullet menu on a TTY (reference
+    ``commands/menu/`` `_ask_options` UI), validated numbered prompt
+    otherwise (pipes/CI)."""
+    from .menu import select
+
+    return select(prompt, choices, default)
 
 
 def _ask_pos_int(prompt: str, default: int) -> int:

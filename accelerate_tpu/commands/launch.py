@@ -53,6 +53,9 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--main_process_port", type=int, default=None, help="Coordinator port.")
     parser.add_argument("--multi_host", action="store_true",
                         help="This invocation is one worker of a multi-host launch (needs --machine_rank).")
+    parser.add_argument("--max_restarts", type=int, default=None,
+                        help="Restart the whole local worker gang up to N times after a "
+                             "crash (workers resume from their last checkpoint).")
     # execution
     parser.add_argument("--cpu", action="store_true", help="Force CPU platform (fake-mesh testing).")
     parser.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
@@ -84,7 +87,7 @@ def _merge_args_into_config(args, config: LaunchConfig) -> LaunchConfig:
     """CLI flag > YAML file > default (reference launch.py:1196)."""
     direct = (
         "num_processes", "num_machines", "machine_rank", "main_process_ip", "main_process_port",
-        "mixed_precision", "gradient_accumulation_steps",
+        "mixed_precision", "gradient_accumulation_steps", "max_restarts",
         "use_fsdp", "fsdp_sharding_strategy", "fsdp_offload_params",
         "fsdp_activation_checkpointing", *_PARALLEL_FLAGS,
     )
@@ -127,7 +130,7 @@ def _validate(config: LaunchConfig):
         )
 
 
-def _spawn_local_workers(cmd, args, config) -> int:
+def _run_worker_gang(cmd, args, config) -> int:
     """Spawn N local worker processes, wait, propagate first failure
     (reference simple_launcher :986-995 exit-code handling)."""
     import time
@@ -155,6 +158,31 @@ def _spawn_local_workers(cmd, args, config) -> int:
         if live:
             time.sleep(0.2)
     return code
+
+
+def _spawn_local_workers(cmd, args, config) -> int:
+    """Run the worker gang, restarting it up to ``max_restarts`` times after
+    a crash (the torchrun-elasticity analog, reference launch.py:1023 —
+    jax.distributed cannot survive losing a member, so like torchrun's
+    default policy a single worker failure restarts the WHOLE gang; workers
+    recover position via checkpoint-resume, see docs/checkpointing.md)."""
+    max_restarts = getattr(config, "max_restarts", 0) or 0
+    # an auto-picked coordinator port is re-picked per attempt (the old one
+    # may linger in TIME_WAIT); an explicit port is the user's to keep
+    auto_port = config.main_process_port is None
+    attempt = 0
+    while True:
+        code = _run_worker_gang(cmd, args, config)
+        if code == 0 or attempt >= max_restarts:
+            return code
+        attempt += 1
+        print(
+            f"restarting all {config.num_processes} workers "
+            f"(attempt {attempt}/{max_restarts}) after exit code {code}",
+            file=sys.stderr,
+        )
+        if auto_port:
+            config.main_process_port = None
 
 
 def launch_command(args) -> None:

@@ -1,0 +1,100 @@
+"""Arrow-key selection menu for the interactive config questionnaire
+(reference ``commands/menu/`` cursor-based selection UI, re-implemented
+for this CLI).
+
+``select(prompt, choices, default)`` renders a bullet list driven by
+up/down (or j/k) + enter on a real terminal and degrades to a validated
+free-text prompt on non-TTY stdin (pipes, CI, tests) — the questionnaire
+works identically either way.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Sequence
+
+_UP = ("\x1b[A", "k")
+_DOWN = ("\x1b[B", "j")
+_ENTER = ("\r", "\n")
+_INTERRUPT = ("\x03", "\x1b")  # ctrl-c, bare escape
+
+try:
+    import termios as _termios
+
+    _TERMIOS_ERROR: type = _termios.error
+except ImportError:  # pragma: no cover - non-POSIX
+    _TERMIOS_ERROR = OSError
+
+
+def _read_key() -> str:
+    """One keypress in raw mode, with escape sequences collapsed."""
+    import select as _select
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setraw(fd)
+        ch = sys.stdin.read(1)
+        if ch == "\x1b":
+            # Only consume an escape-sequence tail that is already pending:
+            # a bare Esc press has no tail, and blocking on read(2) would
+            # freeze the menu until two more keys arrive.
+            if _select.select([sys.stdin], [], [], 0.05)[0]:
+                ch += sys.stdin.read(2)
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+    return ch
+
+
+def _tty_select(prompt: str, choices: Sequence[str], default_idx: int) -> str:
+    write = sys.stdout.write
+    current = default_idx
+    write(f"{prompt}\n")
+    n = len(choices)
+
+    def draw(first: bool = False):
+        if not first:
+            write(f"\x1b[{n}A")  # cursor up n lines
+        for i, choice in enumerate(choices):
+            marker = "➔ " if i == current else "  "
+            write(f"\x1b[2K{marker}{choice}\n")
+        sys.stdout.flush()
+
+    draw(first=True)
+    while True:
+        key = _read_key()
+        if key in _UP:
+            current = (current - 1) % n
+        elif key in _DOWN:
+            current = (current + 1) % n
+        elif key in _ENTER:
+            return choices[current]
+        elif key in _INTERRUPT:
+            raise KeyboardInterrupt
+        elif key.isdigit() and int(key) < n:
+            current = int(key)
+        draw()
+
+
+def select(prompt: str, choices: Sequence[str], default: str) -> str:
+    """Menu selection with non-TTY fallback (validated numbered prompt)."""
+    choices = list(choices)
+    default_idx = choices.index(default) if default in choices else 0
+    if sys.stdin.isatty() and sys.stdout.isatty():
+        try:
+            return _tty_select(prompt, choices, default_idx)
+        except (ImportError, OSError, _TERMIOS_ERROR):
+            pass  # no termios, or raw-mode setup failed (restricted pty)
+    # fallback: numbered free-text prompt, re-asked until valid
+    numbered = ", ".join(f"{i}={c}" for i, c in enumerate(choices))
+    while True:
+        raw = input(f"{prompt} ({numbered}) [{default}]: ").strip()
+        if not raw:
+            return default
+        if raw in choices:
+            return raw
+        if raw.isdigit() and int(raw) < len(choices):
+            return choices[int(raw)]
+        print(f"  -> {raw!r} is not one of {choices}")

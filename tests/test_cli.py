@@ -427,3 +427,33 @@ def test_from_accelerate_cli_end_to_end(tmp_path):
     assert "dropped gpu_ids" in result.stdout
     cfg = LaunchConfig.load(out)
     assert cfg.use_fsdp and cfg.num_processes == 4
+
+
+def test_menu_select_fallback_paths(monkeypatch):
+    """Non-TTY select(): accepts a name, an index, empty (default), and
+    re-prompts on junk (the menu UI degrades to this in pipes/CI)."""
+    from accelerate_tpu.commands import menu
+
+    answers = iter(["", "bf16", "3", "junk", "1"])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    choices = ("no", "bf16", "fp16", "fp8")
+    assert menu.select("precision", choices, "bf16") == "bf16"   # default
+    assert menu.select("precision", choices, "no") == "bf16"     # by name
+    assert menu.select("precision", choices, "no") == "fp8"      # by index
+    assert menu.select("precision", choices, "no") == "bf16"     # junk -> re-ask
+
+
+def test_menu_tty_select_keys(monkeypatch):
+    """Arrow-key path: down/up/jk wrap, digits jump, enter confirms."""
+    from accelerate_tpu.commands import menu
+
+    keys = iter(["\x1b[B", "\x1b[B", "\x1b[A", "\r"])  # down down up enter
+    monkeypatch.setattr(menu, "_read_key", lambda: next(keys))
+    out = menu._tty_select("pick", ["a", "b", "c"], 0)
+    assert out == "b"
+    keys = iter(["2", "\n"])
+    monkeypatch.setattr(menu, "_read_key", lambda: next(keys))
+    assert menu._tty_select("pick", ["a", "b", "c"], 0) == "c"
+    keys = iter(["k", "\r"])  # wrap upward from 0
+    monkeypatch.setattr(menu, "_read_key", lambda: next(keys))
+    assert menu._tty_select("pick", ["a", "b", "c"], 0) == "c"

@@ -82,6 +82,46 @@ def test_launch_propagates_failure(tmp_path):
         raise AssertionError("launch should have propagated the non-zero exit")
 
 
+def test_launch_max_restarts_recovers_crashed_worker(tmp_path):
+    """--max_restarts: worker 1 crashes on the first gang run (sentinel not
+    yet present); the launcher restarts the WHOLE gang env-identically and
+    the second run succeeds (VERDICT r2 next #9; torchrun-elasticity analog,
+    reference commands/launch.py:1023)."""
+    sentinel = tmp_path / "crashed_once"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, pathlib\n"
+        f"sentinel = pathlib.Path({str(sentinel)!r})\n"
+        "first_run = not sentinel.exists()\n"
+        "if first_run and os.environ['ACCELERATE_PROCESS_ID'] == '1':\n"
+        "    sentinel.write_text('x')\n"
+        "    raise SystemExit(7)\n"
+        "from accelerate_tpu import PartialState\n"
+        "state = PartialState()\n"
+        "assert state.num_processes == 2\n"
+        "state.print('RECOVERED OK' if sentinel.exists() else 'NO CRASH?')\n"
+    )
+    cmd = get_launch_command(num_processes=2, num_cpu_devices=1, max_restarts=1) + [str(script)]
+    result = execute_subprocess(cmd, env=_clean_env())
+    assert "RECOVERED OK" in result.stdout
+    assert "restarting all 2 workers (attempt 1/1)" in result.stderr
+
+
+def test_launch_max_restarts_exhausted_propagates(tmp_path):
+    """A persistently-crashing worker exhausts the restart budget and the
+    original exit code still propagates."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("raise SystemExit(3)\n")
+    cmd = get_launch_command(num_processes=2, num_cpu_devices=1, max_restarts=2) + [str(bad)]
+    try:
+        execute_subprocess(cmd, env=_clean_env())
+    except RuntimeError as e:
+        assert "code 3" in str(e)
+        assert "attempt 2/2" in str(e)
+    else:
+        raise AssertionError("launch should have propagated the non-zero exit")
+
+
 def test_launch_child_importable_without_pythonpath(tmp_path):
     """An uninstalled source checkout must stay importable in launched
     workers: the parent resolves the package via cwd (`python -m` from the
