@@ -112,6 +112,83 @@ def _tree_zeros_like(tree, dtype=jnp.float32):
     return jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, dtype), tree)
 
 
+# -- chunked host-update helpers ---------------------------------------------
+# The ZeRO-offload optimizer update runs as XLA host compute.  A monolithic
+# region materializes the whole tree's transients at once (fp32 grad upcasts +
+# moment temps — at 7B with adamw that working set crashes the TPU worker
+# host).  These helpers split params/grads/opt_state into leaf groups of
+# bounded fp32 bytes so each compute_on region touches one group; per-leaf
+# optimizers (adamw/lion/sgd/…) are bit-exact under the split.
+
+
+def _host_update_groups(params, chunk_bytes: int) -> list[list[int]]:
+    """Partition the params' leaf indices into contiguous groups whose fp32
+    footprint stays under ``chunk_bytes`` (one oversized leaf = own group)."""
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    size = 0
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
+        n = int(np.prod(leaf.shape)) * 4 if hasattr(leaf, "shape") else 4
+        if cur and size + n > chunk_bytes:
+            groups.append(cur)
+            cur, size = [], 0
+        cur.append(i)
+        size += n
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _is_congruent_to(treedef):
+    def check(node):
+        try:
+            return jax.tree_util.tree_structure(node) == treedef
+        except Exception:  # pragma: no cover - exotic nodes
+            return False
+
+    return check
+
+
+def _slice_congruent(tree, treedef, idxs: list[int]):
+    """Replace every params-congruent subtree of ``tree`` (per-leaf optimizer
+    moments, or the params tree itself) by the tuple of its selected leaves;
+    scalars and other leaves pass through.  The result is a valid optax state
+    for an update over the matching sliced params tuple."""
+    check = _is_congruent_to(treedef)
+    return jax.tree_util.tree_map(
+        lambda sub: (
+            tuple(jax.tree_util.tree_leaves(sub)[i] for i in idxs)
+            if check(sub)
+            else sub  # shared scalar (e.g. adam count) — passes whole
+        ),
+        tree,
+        is_leaf=check,
+    )
+
+
+def _merge_congruent(template, group_outs: list, treedef, groups: list[list[int]]):
+    """Inverse of :func:`_slice_congruent` across all groups: rebuild each
+    congruent subtree from the per-group output tuples; non-congruent leaves
+    (shared scalars like adam's count — every group advances it identically)
+    come from group 0."""
+
+    def merge(orig_sub, *outs):
+        if _is_congruent_to(treedef)(orig_sub):
+            leaves: list = [None] * treedef.num_leaves
+            for idxs, out in zip(groups, outs):
+                out_leaves = (
+                    list(out) if isinstance(out, tuple) else jax.tree_util.tree_leaves(out)
+                )
+                for j, i in enumerate(idxs):
+                    leaves[i] = out_leaves[j]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        return outs[0]
+
+    return jax.tree_util.tree_map(
+        merge, template, *group_outs, is_leaf=_is_congruent_to(treedef)
+    )
+
+
 def global_norm(tree) -> jax.Array:
     leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree_util.tree_leaves(tree)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves))) if leaves else jnp.float32(0.0)
@@ -632,6 +709,26 @@ class Accelerator:
         # storage stays in device memory but the host-compute update region
         # is still exercised, so numerics are pinned by the CPU suite.
         kinds_ok = offload_opt and host_offload_supported()
+        chunk_bytes = (
+            int(self.fsdp_plugin.host_update_chunk_gib * 2**30)
+            if offload_opt
+            and self.fsdp_plugin is not None
+            and self.fsdp_plugin.host_update_chunk_gib
+            else None
+        )
+        if chunk_bytes is not None:
+            # per-group updates cannot be detected as wrong for cross-leaf
+            # transforms (clip_by_global_norm's state is empty), so say it
+            # loudly once per prepared step
+            logger.warning(
+                "host_update_chunk_gib=%s splits the optimizer update into "
+                "per-leaf-group host regions. The optax chain must be "
+                "per-leaf independent (adamw/lion/sgd/...); a cross-leaf "
+                "transform like optax.clip_by_global_norm would silently use "
+                "per-GROUP statistics — pass max_grad_norm to "
+                "prepare_train_step for global clipping instead.",
+                self.fsdp_plugin.host_update_chunk_gib,
+            )
         if kinds_ok and mode == "across_steps" and accum_steps > 1:
             # across_steps carries the fp32 grad_accum tree in HBM between
             # steps (it feeds a lax.cond, which cannot mix memory spaces), so
@@ -757,19 +854,76 @@ class Accelerator:
                         finite_in = jax.device_put(
                             finite, NamedSharding(self.mesh, PartitionSpec(), memory_kind="pinned_host")
                         )
-                with compute_on("device_host"):
-                    if kinds_ok:
-                        # grads crossed PCIe at compute width; the host
-                        # upcasts before touching the fp32 moments/masters
-                        grads_in = jax.tree_util.tree_map(
-                            lambda g: g.astype(jnp.float32), grads_in
-                        )
+                if chunk_bytes is not None:
+                    # Chunked host update: one compute_on region per leaf
+                    # group bounds the host's transient working set (fp32
+                    # grad upcasts + moment temps) — the monolithic region's
+                    # whole-tree transients crash the worker host at 7B+adamw.
+                    treedef = jax.tree_util.tree_structure(params_master)
+                    groups = _host_update_groups(params_master, chunk_bytes)
                     if gnorm_on_host:
-                        gnorm = global_norm(grads_in)
-                        if max_grad_norm is not None:
+                        with compute_on("device_host"):
+                            gnorm = global_norm(grads_in)
                             clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                            grads_in = jax.tree_util.tree_map(lambda g: g * clip, grads_in)
-                    new_params, new_opt = run_update(grads_in, state.opt_state, params_master, finite_in)
+                    group_outs = []
+                    token = None
+                    for idxs in groups:
+                        g_grads = _slice_congruent(grads_in, treedef, idxs)
+                        g_params = _slice_congruent(params_master, treedef, idxs)
+                        g_opt = _slice_congruent(state.opt_state, treedef, idxs)
+                        with compute_on("device_host"):
+                            if token is not None:
+                                # serialize the regions: without a data
+                                # dependency the scheduler may overlap groups,
+                                # re-creating the unbounded working set
+                                # chunking exists to avoid.  The barrier MUST
+                                # live inside the host region — outside it is
+                                # a device op, and bouncing every grad leaf
+                                # through HBM at 7B re-creates the OOM
+                                # (measured 59G) offload exists to avoid.
+                                g_grads = tuple(
+                                    jax.lax.optimization_barrier((g, token))[0]
+                                    for g in g_grads
+                                )
+                            if kinds_ok:
+                                g_grads = tuple(g.astype(jnp.float32) for g in g_grads)
+                            if gnorm_on_host:
+                                g_grads = tuple(g * clip for g in g_grads)
+                            g_new_params, g_new_opt = run_update(
+                                g_grads, g_opt, g_params, finite_in
+                            )
+                            # token touches every output so the next group
+                            # cannot start until this one's writes finished
+                            deps = [
+                                leaf.ravel()[0]
+                                for leaf in (
+                                    list(g_new_params)
+                                    + jax.tree_util.tree_leaves(g_new_opt)
+                                )
+                                if hasattr(leaf, "ravel") and getattr(leaf, "size", 0)
+                            ]
+                            token = sum(deps) if deps else None
+                        group_outs.append((g_new_params, g_new_opt))
+                    new_params = _merge_congruent(
+                        params_master, [o[0] for o in group_outs], treedef, groups
+                    )
+                    new_opt = _merge_congruent(
+                        state.opt_state, [o[1] for o in group_outs], treedef, groups
+                    )
+                else:
+                    with compute_on("device_host"):
+                        if kinds_ok:
+                            # grads crossed PCIe at compute width; the host
+                            # upcasts before touching the fp32 moments/masters
+                            grads_in = jax.tree_util.tree_map(
+                                lambda g: g.astype(jnp.float32), grads_in
+                            )
+                        if gnorm_on_host:
+                            gnorm = global_norm(grads_in)
+                            if max_grad_norm is not None:
+                                clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                                grads_in = jax.tree_util.tree_map(lambda g: g * clip, grads_in)
+                        new_params, new_opt = run_update(grads_in, state.opt_state, params_master, finite_in)
                 if kinds_ok and psh is not None:
                     # pin the host-execute outputs back to their storage
                     # spaces — libtpu's host-compute alias assigner aborts on

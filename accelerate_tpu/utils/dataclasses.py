@@ -248,6 +248,17 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     offload_params: Optional[bool] = None   # also keep the fp32 master params host-side
                                             # (default: follows cpu_offload, matching FSDP
                                             # CPUOffload(offload_params=True) semantics)
+    host_update_chunk_gib: Optional[float] = None
+                                            # split the host-compute optimizer update into
+                                            # per-leaf-group regions of at most this many
+                                            # GiB of fp32 params each, bounding the host's
+                                            # transient working set (upcasts + moment temps)
+                                            # — what lets adamw run at 7B on one chip.
+                                            # Requires a per-leaf-independent optimizer
+                                            # chain (adamw/lion/sgd/...; NOT
+                                            # clip_by_global_norm inside tx — use the
+                                            # train step's max_grad_norm instead).
+                                            # None = one monolithic region.
     activation_checkpointing: Optional[bool] = None  # jax.checkpoint on remat-policy blocks
     remat_policy: str = "nothing_saveable"  # name of a jax.checkpoint policy
     use_orig_params: bool = True            # API parity; always true under GSPMD

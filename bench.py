@@ -70,6 +70,133 @@ def selftest(report: dict) -> None:
     report["selftest"] = "ok"
 
 
+def _grad_close(f_test, f_ref, args, name, rtol=2e-2, grtol=5e-2):
+    """value_and_grad parity of two scalar functions on the real chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    argnums = tuple(range(len(args)))
+    lt, gt = jax.jit(jax.value_and_grad(f_test, argnums=argnums))(*args)
+    lr, gr = jax.jit(jax.value_and_grad(f_ref, argnums=argnums))(*args)
+    np.testing.assert_allclose(float(lt), float(lr), rtol=rtol, err_msg=name)
+    for i, (a, c) in enumerate(zip(gt, gr)):
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32) - c.astype(jnp.float32))))
+        ref = float(jnp.max(jnp.abs(c.astype(jnp.float32)))) + 1e-6
+        assert err / ref < grtol, f"{name} grad[{i}] mismatch: rel {err / ref:.4f}"
+
+
+def selftest_kernels(report: dict) -> None:
+    """Widened on-chip kernel parity matrix (VERDICT r2 weak #4): every
+    masking variant the long-context suite uses interpret-mode on CPU is
+    checked against its XLA-native reference on the real device, plus the
+    int8 matmul and the fused linear+CE.  A Mosaic lowering bug in any of
+    these paths fails the bench loudly instead of shipping behind green
+    CPU tests."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.ops.flash_attention import flash_attention
+    from accelerate_tpu.models.llama import native_attention
+
+    checks = {}
+    b, t, h, hkv, d = 1, 512, 4, 2, 64
+    k1, k2, k3 = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(k1, (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(k2, (b, t, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(k3, (b, t, hkv, d), jnp.bfloat16)
+
+    def msq(x):
+        return jnp.mean(x.astype(jnp.float32) ** 2)
+
+    # 1. non-causal (bidirectional encoder shape)
+    _grad_close(
+        lambda q, k, v: msq(flash_attention(q, k, v, causal=False)),
+        lambda q, k, v: msq(native_attention(q, k, v, causal=False)),
+        (q, k, v), "flash_noncausal",
+    )
+    checks["flash_noncausal"] = "ok"
+
+    # 2. packed-sequence segment ids (uneven split, causal)
+    seg = jnp.asarray(
+        np.concatenate([np.zeros((b, 192), np.int32), np.ones((b, t - 192), np.int32)], 1)
+    )
+    _grad_close(
+        lambda q, k, v: msq(flash_attention(q, k, v, causal=True, segment_ids=seg)),
+        lambda q, k, v: msq(native_attention(q, k, v, causal=True, segment_ids=seg)),
+        (q, k, v), "flash_segment_ids",
+    )
+    checks["flash_segment_ids"] = "ok"
+
+    # 3. explicit global positions (the ring-CP zigzag layout: this shard
+    # holds non-contiguous global chunks, so the causal mask must key on
+    # positions, not array index)
+    half = t // 2
+    pos = jnp.asarray(
+        np.concatenate([np.arange(half), np.arange(2 * t - half, 2 * t)])[None].repeat(b, 0)
+    ).astype(jnp.int32)
+
+    def native_positioned(q, k, v):
+        scores = jnp.einsum("bthd,bshd->bhts",
+                            q, jnp.repeat(k, h // hkv, axis=2)).astype(jnp.float32) / np.sqrt(d)
+        mask = pos[:, :, None] >= pos[:, None, :]
+        scores = jnp.where(mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhts,bshd->bthd", probs, jnp.repeat(v, h // hkv, axis=2))
+
+    _grad_close(
+        lambda q, k, v: msq(flash_attention(q, k, v, causal=True, positions=pos, kv_positions=pos)),
+        lambda q, k, v: msq(native_positioned(q, k, v)),
+        (q, k, v), "flash_positions",
+    )
+    checks["flash_positions"] = "ok"
+
+    # 4. int8 in-tile-dequant matmul vs dequantize-then-matmul
+    from accelerate_tpu.ops.quantized_matmul import quantized_matmul
+    from accelerate_tpu.utils.quantization import QuantizationConfig, dequantize, quantize
+
+    # m=64 -> the tiled (M, F, K) kernel; m=1 -> the whole-F-resident decode
+    # kernel (its own Mosaic-sensitive constructs: K-only grid, in-kernel
+    # chunked dequant, masked partial K for non-divisor H like 7B's 11008/4)
+    for mm, hh2, ff2, label in [
+        (64, 512, 1024, "int8_matmul"),
+        (1, 2048, 5632, "int8_decode"),
+        (1, 2752, 1024, "int8_decode_masked_k"),
+    ]:
+        w = (np.random.default_rng(5).standard_normal((hh2, ff2)) * 0.02).astype(np.float32)
+        x = jax.random.normal(jax.random.key(12), (mm, hh2), jnp.bfloat16)
+        qt = quantize(jax.device_put(jnp.asarray(w)), QuantizationConfig(load_in_8bit=True))
+        got = np.asarray(jax.jit(quantized_matmul)(x, qt).astype(jnp.float32))
+        want = np.asarray(x.astype(jnp.float32) @ dequantize(qt, jnp.float32))
+        err = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
+        assert err < 2e-2, f"{label} mismatch: rel {err:.4f}"
+        checks[label] = "ok"
+
+    # 5. fused linear+CE (chunked, logits never materialized) vs naive CE
+    from accelerate_tpu.ops.fused_xent import fused_causal_lm_loss
+
+    bb, tt, hh, vv = 2, 256, 256, 1024
+    hid = jax.random.normal(jax.random.key(13), (bb, tt, hh), jnp.bfloat16)
+    wv = jax.random.normal(jax.random.key(14), (vv, hh), jnp.float32) * 0.02
+    labels = jnp.asarray(np.random.default_rng(6).integers(0, vv, (bb, tt)), jnp.int32)
+
+    def naive(hid, wv):
+        logits = (hid.astype(jnp.float32)[:, :-1] @ wv.T).reshape(-1, vv)
+        lab = labels[:, 1:].reshape(-1)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, lab[:, None], axis=1)[:, 0]
+        return jnp.mean(lse - picked)
+
+    _grad_close(
+        lambda hid, wv: fused_causal_lm_loss(hid, wv, labels, vocab_major=True, num_chunks=4),
+        naive, (hid, wv), "fused_ce", rtol=1e-2, grtol=5e-2,
+    )
+    checks["fused_ce"] = "ok"
+
+    report["kernels"] = checks
+
+
 def _7b_config(jnp, seq):
     from accelerate_tpu.models import LlamaConfig
 
@@ -239,6 +366,9 @@ def main():
     ap.add_argument("--optimizer", choices=["lion", "adamw"], default="lion",
                     help="7b mode only: lion (bf16 momentum, ~13.5GiB host state) "
                          "or adamw (full m+v, needs ~67GiB host RAM)")
+    ap.add_argument("--chunk-gib", type=float, default=None,
+                    help="host-update chunk size in GiB (bounds the host's transient "
+                         "working set; default 1.0 under --offload/7b, 0 = monolithic)")
     ap.add_argument("--plan", type=int, default=None, metavar="N",
                     help="print the abstract per-device memory plan for an N-chip mesh and exit")
     ap.add_argument("--plan-task", choices=["train", "infer"], default="train",
@@ -276,6 +406,7 @@ def main():
     extra_report = {}
     if on_tpu and not args.no_selftest:
         selftest(extra_report)
+        selftest_kernels(extra_report)
     if on_tpu and args.model == "7b":
         # Llama-2-7B on ONE 16GiB chip: only possible with ZeRO-offload
         # (bf16 params alone are 12.6GiB; masters + moments live host-side)
@@ -300,6 +431,10 @@ def main():
             max_position_embeddings=seq, attn_implementation="flash",
             remat=long_ctx, dtype=jnp.bfloat16,
             remat_policy="offload" if seq > 98304 else "full",
+            # scanned stack: inside lax.scan the offloaded boundaries
+            # actually leave HBM (unrolled, the scheduler parks ~5GiB of
+            # them — the r2 131k blocker)
+            scan_layers=seq > 98304,
         )
         # batch 10 is the HBM sweet spot without remat (8: -4%, 12: OOM)
         batch = args.batch or (1 if long_ctx else 10)
@@ -314,7 +449,14 @@ def main():
     if args.offload:
         from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
 
-        fsdp_plugin = FullyShardedDataParallelPlugin(cpu_offload=True)
+        # chunked host update by default: per-leaf-group compute_on regions
+        # bound the host's transient working set (monolithic adamw at 7B
+        # crashed the worker host); 0 restores the monolithic region
+        chunk = 1.0 if args.chunk_gib is None else args.chunk_gib
+        fsdp_plugin = FullyShardedDataParallelPlugin(
+            cpu_offload=True, host_update_chunk_gib=chunk or None
+        )
+        extra_report["host_update_chunk_gib"] = chunk or None
     acc = Accelerator(
         parallelism_config=ParallelismConfig(dp_shard_size=n_dev),
         mixed_precision=args.precision,
