@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 
+from .cloud import cloud_command_parser
 from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
@@ -42,6 +43,7 @@ def main():
     merge_command_parser(subparsers)
     tpu_command_parser(subparsers)
     from_accelerate_command_parser(subparsers)
+    cloud_command_parser(subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

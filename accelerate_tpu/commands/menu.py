@@ -27,7 +27,13 @@ except ImportError:  # pragma: no cover - non-POSIX
 
 
 def _read_key() -> str:
-    """One keypress in raw mode, with escape sequences collapsed."""
+    """One keypress in raw mode, with escape sequences collapsed.
+
+    Reads via ``os.read`` on the raw fd — ``sys.stdin.read`` would buffer an
+    arrow key's full 3-byte sequence inside the TextIOWrapper, leaving the fd
+    empty so a ``select`` probe for the tail would misread Up/Down as a bare
+    Esc."""
+    import os
     import select as _select
     import termios
     import tty
@@ -36,13 +42,13 @@ def _read_key() -> str:
     old = termios.tcgetattr(fd)
     try:
         tty.setraw(fd)
-        ch = sys.stdin.read(1)
+        ch = os.read(fd, 1).decode(errors="replace")
         if ch == "\x1b":
             # Only consume an escape-sequence tail that is already pending:
-            # a bare Esc press has no tail, and blocking on read(2) would
-            # freeze the menu until two more keys arrive.
-            if _select.select([sys.stdin], [], [], 0.05)[0]:
-                ch += sys.stdin.read(2)
+            # a bare Esc press has no tail, and blocking would freeze the
+            # menu until two more keys arrive.
+            if _select.select([fd], [], [], 0.05)[0]:
+                ch += os.read(fd, 2).decode(errors="replace")
     finally:
         termios.tcsetattr(fd, termios.TCSADRAIN, old)
     return ch

@@ -35,19 +35,11 @@ def _script_cmd(args) -> list[str]:
     return cmd
 
 
-def _base_env(args, config) -> dict[str, str]:
-    """Env vars common to every launch mode.  ``config`` is a
-    :class:`~accelerate_tpu.commands.config.LaunchConfig` already merged with
-    CLI flags (flag > file > default)."""
-    env = os.environ.copy()
-    # An uninstalled source checkout must stay importable in workers: the
-    # child runs the user script by path (sys.path[0] = script dir), so the
-    # package root rides PYTHONPATH (reference installs; we may not be).
-    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (pkg_root, env.get("PYTHONPATH")) if p
-    )
-    env.update({str(k): str(v) for k, v in (config.env or {}).items()})
+def config_env(config) -> dict[str, str]:
+    """The framework env transport derived from ``config`` ALONE — no ambient
+    environ mixed in (cloud manifests must not inherit the operator shell's
+    ACCELERATE_* residue)."""
+    env = {str(k): str(v) for k, v in (config.env or {}).items()}
     env["ACCELERATE_MIXED_PRECISION"] = str(config.mixed_precision)
     env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(config.gradient_accumulation_steps)
     if config.use_cpu:
@@ -65,6 +57,22 @@ def _base_env(args, config) -> dict[str, str]:
 
     for field in AXIS_SIZE_FIELDS:
         env[f"PARALLELISM_CONFIG_{field.upper()}"] = str(getattr(config, field))
+    return env
+
+
+def _base_env(args, config) -> dict[str, str]:
+    """Env vars common to every launch mode.  ``config`` is a
+    :class:`~accelerate_tpu.commands.config.LaunchConfig` already merged with
+    CLI flags (flag > file > default)."""
+    env = os.environ.copy()
+    # An uninstalled source checkout must stay importable in workers: the
+    # child runs the user script by path (sys.path[0] = script dir), so the
+    # package root rides PYTHONPATH (reference installs; we may not be).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (pkg_root, env.get("PYTHONPATH")) if p
+    )
+    env.update(config_env(config))
     return env
 
 

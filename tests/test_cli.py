@@ -457,3 +457,66 @@ def test_menu_tty_select_keys(monkeypatch):
     keys = iter(["k", "\r"])  # wrap upward from 0
     monkeypatch.setattr(menu, "_read_key", lambda: next(keys))
     assert menu._tty_select("pick", ["a", "b", "c"], 0) == "c"
+
+
+def test_cloud_launch_renders_jobset(tmp_path, capsys, monkeypatch):
+    """cloud-launch (the managed-cloud job surface; reference SageMaker
+    launcher analog, launch.py:1176): renders a GKE JobSet with the full env
+    transport, indexed completions as machine rank, and the worker command."""
+    for k in list(__import__("os").environ):
+        if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_")):
+            monkeypatch.delenv(k, raising=False)
+    from accelerate_tpu.commands.cloud import cloud_command_parser
+
+    parser = cloud_command_parser()
+    args = parser.parse_args([
+        "--backend", "gke", "--num_machines", "4", "--mixed_precision", "bf16",
+        "--tpu_type", "tpu-v5-lite-podslice", "--image", "my/image:1",
+        "train.py", "--lr", "3e-4",
+    ])
+    from accelerate_tpu.commands.cloud import cloud_launch_command
+
+    cloud_launch_command(args)
+    out = capsys.readouterr().out
+    assert "kind: JobSet" in out
+    assert "parallelism: 4" in out and "completions: 4" in out
+    assert "completionMode: Indexed" in out
+    assert "ACCELERATE_MIXED_PRECISION" in out and "'bf16'" in out
+    assert "PARALLELISM_CONFIG_TP_SIZE" in out
+    assert "job-completion-index" in out          # rank from the index
+    assert "'python', 'train.py', '--lr', '3e-4'" in out
+    assert "google.com/tpu: 4" in out
+    assert "gke-tpu-topology: 2x4" in out      # a real topology label, never 'auto'
+    assert "maxRestarts" in out                # whole-gang JobSet failurePolicy
+    # the operator shell's residue must never leak into a manifest
+    assert "ACCELERATE_USE_CPU" not in out
+
+
+def test_cloud_launch_renders_queued_resource(capsys, monkeypatch):
+    for k in list(__import__("os").environ):
+        if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_")):
+            monkeypatch.delenv(k, raising=False)
+    from accelerate_tpu.commands.cloud import cloud_command_parser, cloud_launch_command
+
+    parser = cloud_command_parser()
+    args = parser.parse_args([
+        "--backend", "queued-resources", "--tpu_type", "v5litepod-16",
+        "--zone", "us-west4-a", "train.py",
+    ])
+    cloud_launch_command(args)
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus queued-resources create" in out
+    assert "--accelerator-type=v5litepod-16" in out
+    assert "--zone=us-west4-a" in out
+    assert "ACCELERATE_MIXED_PRECISION" in out and "python train.py" in out
+
+
+def test_cloud_launch_rejects_non_python_script():
+    from accelerate_tpu.commands.cloud import cloud_command_parser, cloud_launch_command
+
+    parser = cloud_command_parser()
+    args = parser.parse_args(["run.sh"])
+    import pytest
+
+    with pytest.raises(ValueError, match="python training script"):
+        cloud_launch_command(args)
