@@ -139,6 +139,45 @@ def _host_update_groups(params, chunk_bytes: int) -> list[list[int]]:
     return groups
 
 
+def _host_constant_hoist(fn, host_sharding, *example_args):
+    """Make ``fn`` safe to call inside a ``compute_on("device_host")`` region
+    by hoisting its jaxpr constants into explicit arguments pinned to host
+    memory.
+
+    Some optimizer updates materialize constant *arrays* at trace time
+    (adafactor's ``jnp.where`` fills / factored-moment eps broadcasts);
+    under host-compute lowering those constants default to device space and
+    the elementwise ops that consume them fail as mixed-memory-space
+    (ROADMAP r2 "adafactor under host offload").  Tracing the update to a
+    jaxpr surfaces exactly those constants (``jax.closure_convert`` is not
+    enough — it hoists only closed-over *tracers*, while these are concrete
+    arrays born at trace time); pinning them to ``host_sharding`` restores a
+    single memory space inside the region.  Per-leaf optimizers without
+    array constants (adamw/lion/sgd) hoist nothing and pass through
+    untouched."""
+    flat, in_tree = jax.tree_util.tree_flatten(example_args)
+
+    def flat_fn(*flat_args):
+        return fn(*jax.tree_util.tree_unflatten(in_tree, flat_args))
+
+    closed, out_shape = jax.make_jaxpr(flat_fn, return_shape=True)(*flat)
+    if not any(hasattr(c, "dtype") for c in closed.consts):
+        return fn
+    out_tree = jax.tree_util.tree_structure(out_shape)
+    consts = [
+        jax.device_put(c, host_sharding) if hasattr(c, "dtype") else c
+        for c in closed.consts
+    ]
+
+    def call(*args):
+        outs = jax.core.eval_jaxpr(
+            closed.jaxpr, consts, *jax.tree_util.tree_leaves(args)
+        )
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    return call
+
+
 def _is_congruent_to(treedef):
     def check(node):
         try:
@@ -854,6 +893,9 @@ class Accelerator:
                         finite_in = jax.device_put(
                             finite, NamedSharding(self.mesh, PartitionSpec(), memory_kind="pinned_host")
                         )
+                host_rep = NamedSharding(
+                    self.mesh, PartitionSpec(), memory_kind="pinned_host"
+                ) if kinds_ok else None
                 if chunk_bytes is not None:
                     # Chunked host update: one compute_on region per leaf
                     # group bounds the host's transient working set (fp32
@@ -867,10 +909,30 @@ class Accelerator:
                             clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                     group_outs = []
                     token = None
+                    # Probe the FULL tree once: per-group const presence can
+                    # vary with the group's leaf shapes (adafactor's factored-
+                    # moment constants only exist for >=2-D leaves), but every
+                    # group's consts arise from update math the full-tree
+                    # trace also contains — so a const-free full trace proves
+                    # all groups const-free, and const-free optimizers
+                    # (adamw/lion/sgd) skip the per-group probe traces.
+                    needs_hoist = (
+                        kinds_ok
+                        and psh is not None
+                        and _host_constant_hoist(
+                            run_update, host_rep,
+                            params_master, state.opt_state, params_master, finite_in,
+                        ) is not run_update
+                    )
                     for idxs in groups:
                         g_grads = _slice_congruent(grads_in, treedef, idxs)
                         g_params = _slice_congruent(params_master, treedef, idxs)
                         g_opt = _slice_congruent(state.opt_state, treedef, idxs)
+                        upd = run_update
+                        if needs_hoist:
+                            upd = _host_constant_hoist(
+                                run_update, host_rep, g_params, g_opt, g_params, finite_in
+                            )
                         with compute_on("device_host"):
                             if token is not None:
                                 # serialize the regions: without a data
@@ -889,7 +951,7 @@ class Accelerator:
                                 g_grads = tuple(g.astype(jnp.float32) for g in g_grads)
                             if gnorm_on_host:
                                 g_grads = tuple(g * clip for g in g_grads)
-                            g_new_params, g_new_opt = run_update(
+                            g_new_params, g_new_opt = upd(
                                 g_grads, g_opt, g_params, finite_in
                             )
                             # token touches every output so the next group
@@ -911,6 +973,15 @@ class Accelerator:
                         state.opt_state, [o[1] for o in group_outs], treedef, groups
                     )
                 else:
+                    # hoist only when operands were actually moved to host
+                    # space (kinds_ok AND psh) — pinned-host consts against
+                    # device-resident operands would themselves mix spaces
+                    upd = (
+                        _host_constant_hoist(
+                            run_update, host_rep,
+                            params_master, state.opt_state, params_master, finite_in,
+                        ) if kinds_ok and psh is not None else run_update
+                    )
                     with compute_on("device_host"):
                         if kinds_ok:
                             # grads crossed PCIe at compute width; the host
@@ -923,7 +994,7 @@ class Accelerator:
                             if max_grad_norm is not None:
                                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                                 grads_in = jax.tree_util.tree_map(lambda g: g * clip, grads_in)
-                        new_params, new_opt = run_update(grads_in, state.opt_state, params_master, finite_in)
+                        new_params, new_opt = upd(grads_in, state.opt_state, params_master, finite_in)
                 if kinds_ok and psh is not None:
                     # pin the host-execute outputs back to their storage
                     # spaces — libtpu's host-compute alias assigner aborts on

@@ -49,6 +49,14 @@ class LlamaConfig:
     # (minimum memory), "dots" saves matmul outputs (recompute only the cheap
     # elementwise ops — more memory, less recompute)
     remat_policy: str = "full"
+    # lax.scan over the (homogeneous) layer stack instead of unrolling.
+    # Param leaves gain a leading num_hidden_layers dim under "layers_scan".
+    # This is what makes remat_policy="offload" actually pay: inside the
+    # scan's sequential structure XLA transfers each boundary out of HBM
+    # before the next iteration, where the unrolled stack's scheduler parks
+    # ~5GiB of in-flight boundary buffers (the r2 131k blocker).  Also cuts
+    # compile time at deep stacks (the body traces/compiles once).
+    scan_layers: bool = False
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -294,6 +302,26 @@ class LlamaBlock(nn.Module):
         return out
 
 
+class _ScanBody(nn.Module):
+    """One scan iteration over the homogeneous layer stack: carry is the
+    hidden state, positions/segment_ids are broadcast.  The carry-in is
+    tagged ``block_boundary`` so ``remat_policy="offload"`` can park the
+    per-iteration residual in pinned host memory (under scan the stacked
+    residual buffer itself lives host-side — the unrolled path's in-flight
+    HBM pile-up cannot happen)."""
+
+    config: Any
+    block_cls: Any
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids):
+        from jax.ad_checkpoint import checkpoint_name
+
+        x = checkpoint_name(x, "block_boundary")
+        y = self.block_cls(self.config, name="block")(x, positions, segment_ids)
+        return y, None
+
+
 class LMHead(nn.Module):
     """Vocab projection with params at ``lm_head/kernel`` (TP rule + ckpt
     path), computed in ``dtype`` with fp32 accumulation."""
@@ -342,16 +370,54 @@ class LlamaForCausalLM(nn.Module):
             from ..parallel.sharding import host_offload_supported
 
             offload_remat = host_offload_supported()
-            if not offload_remat:  # CPU test mesh: degrade to full remat
+            if not offload_remat and not cfg.scan_layers:  # CPU mesh: full remat
                 block = nn.remat(block, policy=jax.checkpoint_policies.nothing_saveable)
-        elif cfg.remat and cache is None:
+        elif cfg.remat and cache is None and not cfg.scan_layers:
             policy = {
                 "full": jax.checkpoint_policies.nothing_saveable,
                 "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             }[cfg.remat_policy]
             block = nn.remat(block, policy=policy)
         new_cache = [] if cache is not None else None
-        if offload_remat:
+        if cfg.scan_layers and cache is not None:
+            raise ValueError(
+                "scan_layers=True has no cached-decode path (the KV cache is "
+                "per-layer). For generation, convert once: "
+                "params = unstack_layer_params(params) and rebuild the model "
+                "with dataclasses.replace(cfg, scan_layers=False)."
+            )
+        if cfg.scan_layers and cache is None:
+            # lax.scan over the stack: params stack under "layers_scan" with
+            # a leading L dim (the sharding planner shifts TP rule dims for
+            # this prefix).  With remat, the scan body is rematted with the
+            # boundary-offload policy on TPU (MaxText-style: the stacked
+            # boundary residuals live in pinned host memory) or
+            # nothing_saveable/dots elsewhere.
+            body = _ScanBody
+            if cfg.remat:
+                if offload_remat:
+                    policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+                        names_which_can_be_saved=[],
+                        names_which_can_be_offloaded=["block_boundary"],
+                        offload_src="device", offload_dst="pinned_host",
+                    )
+                else:
+                    policy = {
+                        "full": jax.checkpoint_policies.nothing_saveable,
+                        "offload": jax.checkpoint_policies.nothing_saveable,
+                        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    }[cfg.remat_policy]
+                body = nn.remat(body, policy=policy, prevent_cse=False)
+            stack = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.num_hidden_layers,
+                in_axes=(nn.broadcast, nn.broadcast),
+                metadata_params={nn.PARTITION_NAME: None},
+            )
+            x, _ = stack(cfg, block, name="layers_scan")(x, positions, segment_ids)
+        elif offload_remat:
             # Activation offload (the ALST/Ulysses long-context enabler,
             # reference sequence_parallelism.md): one remat region over the
             # whole stack whose only saved values — the inter-block
@@ -470,6 +536,48 @@ def make_llama_loss_fn(model: LlamaForCausalLM, fused_vocab_chunks: Optional[int
 
 def count_params(params) -> int:
     return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+
+_LAYER_KEY = r"layers_(\d+)"
+
+
+def stack_layer_params(params):
+    """Convert unrolled per-layer params (``layers_0..layers_{L-1}``) to the
+    ``scan_layers=True`` layout (``layers_scan/block/...`` with a leading L
+    dim).  Accepts the tree with or without the flax ``params`` wrapper;
+    checkpoints saved in either layout load into either model via this pair
+    (reference parity: to-fsdp2-style state-dict converters)."""
+    import re
+
+    if "params" in params and isinstance(params["params"], dict):
+        return {**params, "params": stack_layer_params(params["params"])}
+    layer_keys = sorted(
+        (k for k in params if re.fullmatch(_LAYER_KEY, k)),
+        key=lambda k: int(k.rsplit("_", 1)[1]),
+    )
+    if not layer_keys:
+        return params
+    out = {k: v for k, v in params.items() if not re.fullmatch(_LAYER_KEY, k)}
+    out["layers_scan"] = {
+        "block": jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *[params[k] for k in layer_keys]
+        )
+    }
+    return out
+
+
+def unstack_layer_params(params):
+    """Inverse of :func:`stack_layer_params`."""
+    if "params" in params and isinstance(params["params"], dict):
+        return {**params, "params": unstack_layer_params(params["params"])}
+    if "layers_scan" not in params:
+        return params
+    stacked = params["layers_scan"]["block"]
+    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    out = {k: v for k, v in params.items() if k != "layers_scan"}
+    for i in range(n):
+        out[f"layers_{i}"] = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+    return out
 
 
 def flops_per_token(cfg: LlamaConfig, seq_len: int) -> float:

@@ -96,18 +96,95 @@ def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype, k_len, ma
         o_ref[...] = acc[:].astype(out_dtype)
 
 
+def _qmm_wholef_kernel(x_ref, w_ref, s_ref, o_ref, acc, *, qblock, out_dtype,
+                       k_len, masked_k):
+    """Decode-shape variant: grid (M_tiles, K_tiles) with the FULL F dim
+    resident per w tile.
+
+    Why whole-F: the tiled kernel's w block [bk, bf=512] is, in the
+    row-major [H, F] codes array, ``bk`` strided segments of only ``bf``
+    bytes each — the DMA engine sustains ~230 GB/s on that pattern at batch
+    1 (the r2 measured bound).  A [bk, F] block is ``bk`` *whole contiguous
+    rows* — one dense HBM segment — and cuts grid invocations from
+    F/bf x H/bk to H/bk.
+
+    Why scale-on-x: out[m,f] = Σ_h x[m,h]·codes[h,f]·s[fb,h] regroups as
+    (x·s[fb,:]) @ codes[:, fb-block] per quantization block fb, so the VPU
+    touches each *weight* element exactly once (the mandatory int8→bf16
+    convert feeding the MXU) instead of three times (fp32 convert, scale
+    multiply, bf16 downcast) — at decode the kernel is VPU-bound on that
+    per-element work, not DMA-bound, measured 1.3x bf16 with the dequant-
+    in-fp32 form.  The tiny [bm, bk] x re-scales per block are noise, and
+    the fp32 dequant intermediate disappears from VMEM entirely.  Decode-
+    only (m <= 8): at larger m the [bm, F] accumulator stops fitting and
+    the MXU-bound tiled kernel double-buffers better.
+    """
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    bk, f = w_ref.shape
+    x32 = x_ref[...].astype(jnp.float32)  # [bm, bk]
+    s = s_ref[...]  # [f/qblock, bk] fp32
+    if masked_k:
+        # zero the scales of out-of-range contraction rows in the partial
+        # last K tile (a select, so NaN scale padding cannot leak; x's own
+        # padding is caller-zeroed)
+        rows = ki * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows < k_len, s, 0.0)
+    for b in range(f // qblock):
+        xs = (x32 * s[b:b + 1, :]).astype(jnp.bfloat16)
+        acc[:, b * qblock:(b + 1) * qblock] += jax.lax.dot_general(
+            xs, w_ref[:, b * qblock:(b + 1) * qblock].astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == pl.num_programs(1) - 1)
+    def _done():
+        o_ref[...] = acc[:].astype(out_dtype)
+
+
+# Whole-F w tiles stream in [bk, F] int8 blocks; bound them to ~4 MiB so the
+# double-buffered pair (plus x/scale/accumulator, all small) stays inside
+# ~16 MiB VMEM.
+_WHOLEF_TILE_BYTES = 4 * 1024 * 1024
+
+
+def _wholef_tiles(h: int, f: int):
+    """(bk, masked_k) for the whole-F decode kernel, or None when no
+    lane-aligned K tile fits the VMEM budget at this F."""
+    budget = min(1024, _WHOLEF_TILE_BYTES // f, h) // 128 * 128
+    if budget < 128:
+        return None
+    bk = _k_tile(h, budget)
+    masked_k = False
+    if bk is None or (bk < 384 and budget > bk):
+        # same divisor-vs-masked policy as the tiled kernel: a small exact
+        # divisor loses to a full-budget tile with one select-zeroed tail
+        bk, masked_k = budget, True
+    return bk, masked_k
+
+
 def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Optional[int] = None,
-                     block_f: int = 512, out_dtype=None, interpret=None):
+                     block_f: int = 512, out_dtype=None, interpret=None,
+                     wholef: Optional[bool] = None):
     """``x @ W`` where W is an int8 :class:`QuantizedTensor` of shape [H, F].
 
     x: [..., H].  Falls back to ``dequantize + matmul`` for nf4 codes or
     layouts whose quantization block does not divide F (the kernel needs the
-    [H, F/block] scale view).
+    [H, F/block] scale view).  ``wholef``: None auto-picks the whole-F
+    contiguous-row decode kernel at m <= 8 (True forces it for tests, False
+    pins the tiled kernel); explicit ``block_k``/``block_f`` also pin tiled.
     """
     h, f = qt.shape[-2], qt.shape[-1]
     qblock = qt.block_size
     lead = x.shape[:-1]
     m = int(np.prod(lead)) if lead else 1
+    if wholef is None:
+        wholef = m <= 8 and block_k is None and block_f == 512
     if block_k is None:
         # decode (tiny m): larger K tiles amortize the per-invocation scale
         # transpose + dequant setup; at large m the 512 tile double-buffers
@@ -146,6 +223,10 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Opt
         interpret = not _on_tpu()
     out_dtype = out_dtype or x.dtype
 
+    wf = _wholef_tiles(h, f) if wholef else None
+    if wf is not None:
+        bk, masked_k = wf
+
     x2 = x.reshape(m, h).astype(jnp.bfloat16)
     if masked_k:
         # defined zeros in x's padded K columns: the kernel's partial last
@@ -164,6 +245,25 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Opt
         scales = qt.scale.reshape(h, f // qblock).T
 
     bm = min(block_m, max(8, m))
+    if wf is not None:
+        out = pl.pallas_call(
+            functools.partial(_qmm_wholef_kernel, qblock=qblock,
+                              out_dtype=out_dtype, k_len=h, masked_k=masked_k),
+            grid=(pl.cdiv(m, bm), pl.cdiv(h, bk)),
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda i, k: (i, k)),
+                pl.BlockSpec((bk, f), lambda i, k: (k, 0)),
+                pl.BlockSpec((f // qblock, bk), lambda i, k: (0, k)),
+            ],
+            out_specs=pl.BlockSpec((bm, f), lambda i, k: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((m, f), out_dtype),
+            scratch_shapes=[pltpu.VMEM((bm, f), jnp.float32)] if _HAS_PLTPU else [],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            ) if _HAS_PLTPU else None,
+            interpret=interpret,
+        )(x2, codes, scales)
+        return out.reshape(*lead, f)
     # The transposed-scale block's sublane dim (bf/qblock) must be divisible
     # by 8 or equal the full array dim (Mosaic lowering rule).  Partial last
     # F tiles are fine — their out-of-range columns land in the clipped

@@ -69,10 +69,16 @@ def _spec_for_leaf(
     ndim = len(shape)
     spec: list = [None] * ndim
 
+    # Scanned layer stacks (models' scan_layers=True) carry a leading
+    # num_layers dim under the "layers_scan" module — TP rules written for
+    # the per-layer shapes shift right by one
+    offset = 1 if "layers_scan" in path else 0
+
     # 1. TP rules first (they own specific dims)
     for pattern, rule_spec in tp_rules:
         if re.search(pattern, path):
             for d, entry in enumerate(rule_spec):
+                d += offset
                 if d >= ndim or entry is None:
                     continue
                 size = _axis_size(mesh, entry)

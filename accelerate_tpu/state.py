@@ -297,6 +297,23 @@ class AcceleratorState:
                     "call AcceleratorState._reset_state() first (test hygiene, reference testing.py:650)."
                 )
             return
+        # Everything below may raise (bad mixed_precision, invalid mesh
+        # config).  ``initialized`` is true as soon as ``_partial`` lands, so
+        # a failed construction must roll the borg dicts back — otherwise the
+        # next (corrected) AcceleratorState returns the poisoned state early
+        # or rejects it as "already initialized with a different
+        # parallelism_config".  PartialState rolls back only if THIS call
+        # created it (a pre-existing one is the user's, and valid).
+        partial_preexisting = bool(PartialState._shared_state)
+        try:
+            self._init_validated(mixed_precision, cpu, parallelism_config, kwargs)
+        except Exception:
+            self._shared_state.clear()
+            if not partial_preexisting:
+                PartialState._reset_state()
+            raise
+
+    def _init_validated(self, mixed_precision, cpu, parallelism_config, kwargs):
         self._partial = PartialState(cpu=cpu, **kwargs)
         mixed_precision = (
             parse_choice_from_env("ACCELERATE_MIXED_PRECISION", "no")
@@ -331,6 +348,11 @@ class AcceleratorState:
             parallelism_config = ParallelismConfig.from_env()
         self.parallelism_config = parallelism_config
         self._mesh: Optional[jax.sharding.Mesh] = None
+        if parallelism_config is not None:
+            # surface mesh-shape errors at construction (same check the lazy
+            # mesh build runs) so they hit the rollback above instead of
+            # poisoning the singleton from inside the first .mesh access
+            parallelism_config._validate(self.num_devices)
 
     # Delegate the PartialState surface ------------------------------------
 

@@ -1,0 +1,117 @@
+"""On-chip verification probes that the CPU suite cannot exercise
+(memory-kind placement is TPU-only).  Each probe prints one JSON line.
+
+Run on a real chip:  python benchmarks/onchip_checks.py [--probe NAME]
+
+Probes:
+  adafactor_offload  — optax.adafactor under the ZeRO-offload host-compute
+                       update (VERDICT r2 weak #7: its trace-time constant
+                       arrays used to lower into the host region in device
+                       memory space and fail; _host_constant_hoist pins
+                       them to pinned_host).
+  scan_offload       — scan_layers=True + remat_policy="offload" trains a
+                       small stack with finite loss (the 131k enabler).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def probe_adafactor_offload():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=1),
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=0, cpu_offload=True),
+    )
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {
+        "dense": {"kernel": jax.random.normal(k1, (256, 512)) * 0.05,
+                  "bias": jnp.zeros((512,))},
+        "out": {"kernel": jax.random.normal(k2, (512, 8)) * 0.05,
+                "bias": jnp.zeros((8,))},
+    }
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch["x"] @ p["dense"]["kernel"] + p["dense"]["bias"])
+        pred = h @ p["out"]["kernel"] + p["out"]["bias"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    tx = acc.prepare(optax.adafactor(1e-3))
+    state = acc.create_train_state(params, tx)
+    step = acc.prepare_train_step(loss_fn, max_grad_norm=None)
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(4):
+        batch = {"x": jnp.asarray(rng.normal(size=(16, 256)), jnp.float32),
+                 "y": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32)}
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    ok = all(np.isfinite(losses)) and losses[-1] < losses[0]
+    print(json.dumps({"probe": "adafactor_offload", "ok": bool(ok),
+                      "losses": [round(l, 5) for l in losses]}))
+    return ok
+
+
+def probe_scan_offload():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=512,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512, remat=True, remat_policy="offload",
+        scan_layers=True, attn_implementation="flash",
+    )
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 512, (2, 512)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    loss_fn = make_llama_loss_fn(model)
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(p, o, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    t0, losses = time.perf_counter(), []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, {"input_ids": ids, "labels": ids})
+        losses.append(float(loss))
+    ok = all(np.isfinite(losses)) and losses[-1] < losses[0]
+    print(json.dumps({"probe": "scan_offload", "ok": bool(ok),
+                      "losses": [round(l, 4) for l in losses],
+                      "wall_s": round(time.perf_counter() - t0, 1)}))
+    return ok
+
+
+PROBES = {
+    "adafactor_offload": probe_adafactor_offload,
+    "scan_offload": probe_scan_offload,
+}
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--probe", choices=sorted(PROBES), default=None)
+    args = ap.parse_args()
+    names = [args.probe] if args.probe else sorted(PROBES)
+    results = [PROBES[n]() for n in names]  # run ALL probes; no short-circuit
+    raise SystemExit(0 if all(results) else 1)

@@ -356,3 +356,77 @@ def test_offload_remat_policy_degrades_and_trains(monkeypatch):
     assert jax.tree_util.tree_structure(params_stack) == jax.tree_util.tree_structure(params)
     loss_stack = loss_fn(params, {"input_ids": ids, "labels": ids})
     np.testing.assert_allclose(float(loss_stack), float(ref), rtol=1e-5)
+
+
+def test_scan_layers_matches_unrolled():
+    """scan_layers=True computes the same function as the unrolled stack:
+    init the unrolled model, stack its per-layer params into the scan
+    layout, and require identical logits + loss gradients (remat on, the
+    131k-config shape: remat_policy degrades to full on CPU)."""
+    from accelerate_tpu.models.llama import stack_layer_params, unstack_layer_params
+
+    cfg = LlamaConfig.tiny(remat=True, remat_policy="offload", dtype=jnp.float32)
+    scan_cfg = LlamaConfig.tiny(remat=True, remat_policy="offload", scan_layers=True,
+                                dtype=jnp.float32)
+    model, scan_model = LlamaForCausalLM(cfg), LlamaForCausalLM(scan_cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 255, (2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    stacked = stack_layer_params(params)
+    k = stacked["params"]["layers_scan"]["block"]["self_attn"]["q_proj"]["kernel"]
+    assert k.shape[0] == cfg.num_hidden_layers
+
+    np.testing.assert_allclose(
+        np.asarray(model.apply(params, ids)),
+        np.asarray(scan_model.apply(stacked, ids)), rtol=2e-5, atol=2e-5)
+
+    loss_fn = make_llama_loss_fn(model)
+    scan_loss_fn = make_llama_loss_fn(scan_model)
+    batch = {"input_ids": ids, "labels": ids}
+    loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    s_loss, s_grads = jax.value_and_grad(scan_loss_fn)(stacked, batch)
+    np.testing.assert_allclose(float(loss), float(s_loss), rtol=1e-5)
+    # grads in the scan layout unstack back to the unrolled layout
+    for (pa, ga), (pb, gb) in zip(
+        sorted(jax.tree_util.tree_flatten_with_path(grads)[0], key=lambda t: str(t[0])),
+        sorted(jax.tree_util.tree_flatten_with_path(unstack_layer_params(s_grads))[0],
+               key=lambda t: str(t[0])),
+    ):
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=5e-3, atol=2e-4,
+                                   err_msg=str(pa))
+
+    # round-trip
+    rt = unstack_layer_params(stacked)
+    assert jax.tree_util.tree_structure(rt) == jax.tree_util.tree_structure(params)
+
+
+def test_scan_layers_init_and_tp_sharding():
+    """Direct init in the scan layout + the sharding planner's shifted TP
+    rules: the stacked q_proj kernel [L, H, H'] shards 'tp' on its LAST dim."""
+    cfg = LlamaConfig.tiny(scan_layers=True)
+    model = LlamaForCausalLM(cfg)
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=4, tp_size=2))
+    ids = jnp.ones((4, 16), jnp.int32)
+    params = model.init(jax.random.key(0), ids)
+    import optax as _optax
+
+    state = acc.create_train_state(params, _optax.sgd(1e-3))
+    k = state.params["params"]["layers_scan"]["block"]["self_attn"]["q_proj"]["kernel"]
+    assert k.ndim == 3
+    assert "tp" in str(k.sharding.spec)
+    assert k.sharding.spec[2] == "tp" or k.sharding.spec[-1] == "tp"
+    logits = model.apply(state.params, ids)
+    assert logits.shape == (4, 16, cfg.vocab_size)
+
+
+def test_scan_layers_cached_decode_raises():
+    """scan_layers has no cached-decode path; the error must say how to
+    convert (unstack + scan_layers=False) instead of a scope lookup crash."""
+    from accelerate_tpu.models.llama import init_cache
+
+    cfg = LlamaConfig.tiny(scan_layers=True)
+    model = LlamaForCausalLM(cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), ids)
+    cache = init_cache(cfg, 1, 16)
+    with pytest.raises(ValueError, match="unstack_layer_params"):
+        model.apply(params, ids, cache=cache)

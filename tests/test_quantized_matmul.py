@@ -133,6 +133,60 @@ def test_k_tile_divisor_helper():
     assert _k_tile(256, 512) == 256
 
 
+def test_wholef_decode_kernel_matches_dequant_matmul():
+    """The whole-F contiguous-row decode kernel (auto-picked at m <= 8) is
+    exact vs dequantize+matmul at a decode shape with a divisor K tile."""
+    rng = np.random.default_rng(11)
+    W = rng.normal(size=(2048, 1408)).astype(np.float32)
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(1, 2048)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, out_dtype=jnp.float32, interpret=True,
+                           wholef=True)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
+    # kernel accumulates fp32 while the bf16 reference rounds per-output;
+    # at h=2048 that honest gap reaches ~1 on outputs of magnitude ~90
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.02,
+                               atol=1.0)
+
+
+def test_wholef_masked_k_tail():
+    """Whole-F path with H that has only a small lane divisor (Llama-7B
+    down_proj-style): masked full-budget K tile, still exact."""
+    rng = np.random.default_rng(12)
+    W = rng.normal(size=(1408, 512)).astype(np.float32)  # 1408 = 128 * 11
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(4, 1408)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, out_dtype=jnp.float32, interpret=True,
+                           wholef=True)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.6)
+
+
+def test_wholef_partial_last_chunk():
+    """F not a multiple of the dequant chunk: the static chunk loop's last
+    slice is a partial (but whole-q-block) chunk."""
+    rng = np.random.default_rng(13)
+    W = rng.normal(size=(512, 640)).astype(np.float32)  # 640 = 5 q-blocks
+    qt = quantize(W, QuantizationConfig(load_in_8bit=True, block_size=128))
+    x = jnp.asarray(rng.normal(size=(2, 512)), jnp.bfloat16)
+    out = quantized_matmul(x, qt, out_dtype=jnp.float32, interpret=True,
+                           wholef=True)
+    ref = jnp.matmul(x, dequantize(qt, jnp.bfloat16)).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=0.4)
+
+
+def test_wholef_tile_planner():
+    from accelerate_tpu.ops.quantized_matmul import (
+        _WHOLEF_TILE_BYTES, _wholef_tiles)
+
+    bk, masked = _wholef_tiles(2048, 5632)
+    assert not masked and 2048 % bk == 0 and bk * 5632 <= _WHOLEF_TILE_BYTES
+    bk, masked = _wholef_tiles(11008, 4096)  # divisor only 256
+    assert masked and bk == 1024
+    assert _wholef_tiles(96, 1024) is None  # H below one lane width
+
+
 def test_nf4_falls_back():
     rng = np.random.default_rng(4)
     W = rng.normal(size=(64, 256)).astype(np.float32)

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+from accelerate_tpu.parallelism_config import ParallelismConfig
 from accelerate_tpu.utils.dataclasses import DistributedType, GradientAccumulationPlugin
 
 
@@ -98,3 +99,19 @@ def test_gradient_accumulation_plugin_validation():
         GradientAccumulationPlugin(num_steps=0)
     with pytest.raises(ValueError):
         GradientAccumulationPlugin(mode="bogus")
+
+
+def test_failed_init_does_not_poison_singleton():
+    """A construction that fails validation must roll the borg state back:
+    the user's corrected retry gets a clean init, not 'already initialized
+    with a different parallelism_config' (or a silently skipped
+    mixed_precision check)."""
+    AcceleratorState._reset_state(reset_partial_state=True)
+    with pytest.raises(ValueError):
+        AcceleratorState(parallelism_config=ParallelismConfig(cp_size=2, sp_size=2))
+    with pytest.raises(ValueError, match="mixed_precision"):
+        AcceleratorState(mixed_precision="fp4")
+    # corrected retry succeeds with the requested config
+    st = AcceleratorState(parallelism_config=ParallelismConfig(dp_shard_size=4, tp_size=2))
+    assert st.mesh.shape["tp"] == 2
+    AcceleratorState._reset_state(reset_partial_state=True)
