@@ -148,30 +148,61 @@ def _host_constant_hoist(fn, host_sharding, *example_args):
     (adafactor's ``jnp.where`` fills / factored-moment eps broadcasts);
     under host-compute lowering those constants default to device space and
     the elementwise ops that consume them fail as mixed-memory-space
-    (ROADMAP r2 "adafactor under host offload").  Tracing the update to a
-    jaxpr surfaces exactly those constants (``jax.closure_convert`` is not
-    enough — it hoists only closed-over *tracers*, while these are concrete
-    arrays born at trace time); pinning them to ``host_sharding`` restores a
-    single memory space inside the region.  Per-leaf optimizers without
-    array constants (adamw/lion/sgd) hoist nothing and pass through
+    (ROADMAP r2 "adafactor under host offload").  Two mechanisms combine —
+    ``jax.closure_convert`` alone is not enough, it hoists only closed-over
+    *tracers*:
+
+    1. jaxpr consts: concrete arrays captured at trace time.
+    2. literal-born arrays: ``jnp.where(c, x, 0.0)`` broadcasts its scalar
+       inside the traced computation, and that broadcast output has no
+       host-space operand to inherit from (measured on-chip:
+       ``select_n ... f32<host>[512] vs f32[512]``).  Partial evaluation
+       with every input unknown splits the jaxpr into a const-only known
+       part (the broadcasts) and an unknown part consuming them as
+       residual *arguments* — which we pin to ``host_sharding``.
+
+    The traced fn is inlined (``disable_jit``) so nested ``jit[_where]``
+    calls expose their literals to the split.  Per-leaf optimizers without
+    constant arrays (adamw/lion/sgd) hoist nothing and pass through
     untouched."""
+    from jax._src.interpreters import partial_eval as pe
+
     flat, in_tree = jax.tree_util.tree_flatten(example_args)
+    # trace on space-free avals: the example operands carry <host> memory
+    # spaces, and the very mixed-space select_n error this hoist prevents
+    # would otherwise fire during this trace
+    flat = [
+        jax.ShapeDtypeStruct(np.shape(x), getattr(x, "dtype", np.result_type(x)))
+        for x in flat
+    ]
 
     def flat_fn(*flat_args):
         return fn(*jax.tree_util.tree_unflatten(in_tree, flat_args))
 
-    closed, out_shape = jax.make_jaxpr(flat_fn, return_shape=True)(*flat)
-    if not any(hasattr(c, "dtype") for c in closed.consts):
+    # trace under the SAME compute context the replay runs in: eval_jaxpr
+    # re-enters each eqn's recorded context manager, and a no-context eqn
+    # replayed inside compute_on("device_host") raises the compute_on
+    # nesting NotImplementedError
+    with jax.disable_jit(), compute_on("device_host"):
+        closed, out_shape = jax.make_jaxpr(flat_fn, return_shape=True)(*flat)
+    known, unknown, _, res_avals = pe.partial_eval_jaxpr_nounits(
+        closed, [True] * len(closed.jaxpr.invars), instantiate=True
+    )
+    if not res_avals and not any(hasattr(c, "dtype") for c in unknown.consts):
         return fn
     out_tree = jax.tree_util.tree_structure(out_shape)
-    consts = [
-        jax.device_put(c, host_sharding) if hasattr(c, "dtype") else c
-        for c in closed.consts
-    ]
+
+    def pin(v):
+        return jax.device_put(v, host_sharding) if hasattr(v, "dtype") else v
+
+    # the const-only subcomputation runs once at wrap time (outside the host
+    # region); its residuals enter the region as host-pinned arguments
+    residuals = [pin(r) for r in jax.core.eval_jaxpr(known.jaxpr, known.consts)]
+    consts = [pin(c) for c in unknown.consts]
 
     def call(*args):
         outs = jax.core.eval_jaxpr(
-            closed.jaxpr, consts, *jax.tree_util.tree_leaves(args)
+            unknown.jaxpr, consts, *residuals, *jax.tree_util.tree_leaves(args)
         )
         return jax.tree_util.tree_unflatten(out_tree, outs)
 

@@ -57,6 +57,13 @@ class LlamaConfig:
     # ~5GiB of in-flight boundary buffers (the r2 131k blocker).  Also cuts
     # compile time at deep stacks (the body traces/compiles once).
     scan_layers: bool = False
+    # layers per scan iteration: >1 offloads only every Nth boundary (the
+    # blocks inside an iteration re-remat individually on backward), cutting
+    # the pinned-host residual buffer by N at ~(N-1)/(2N) extra forward
+    # recompute — the lever when the *host's* pinned allocation is the
+    # ceiling (131k: 6.4 GiB of boundaries crashed the worker; stride 2
+    # halves it).  Must divide num_hidden_layers.
+    scan_block_size: int = 1
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
@@ -64,6 +71,15 @@ class LlamaConfig:
             raise ValueError(
                 f"remat_policy must be 'full', 'dots' or 'offload', got {self.remat_policy!r}"
             )
+        if self.scan_block_size != 1:
+            if not self.scan_layers:
+                raise ValueError("scan_block_size > 1 requires scan_layers=True "
+                                 "(the unrolled stack never consults it)")
+            if self.scan_block_size < 1 or self.num_hidden_layers % self.scan_block_size:
+                raise ValueError(
+                    f"scan_block_size={self.scan_block_size} must divide "
+                    f"num_hidden_layers={self.num_hidden_layers}"
+                )
 
     @property
     def head_dim(self) -> int:
@@ -317,9 +333,25 @@ class _ScanBody(nn.Module):
     def __call__(self, x, positions, segment_ids):
         from jax.ad_checkpoint import checkpoint_name
 
+        cfg = self.config
         x = checkpoint_name(x, "block_boundary")
-        y = self.block_cls(self.config, name="block")(x, positions, segment_ids)
-        return y, None
+        bs = getattr(cfg, "scan_block_size", 1)
+        if bs == 1:
+            return self.block_cls(cfg, name="block")(x, positions, segment_ids), None
+        # multi-block iteration: only the iteration boundary offloads; each
+        # block re-remats individually on backward so the recompute peak
+        # stays one block deep, honoring the configured remat granularity
+        blk = self.block_cls
+        if cfg.remat:
+            policy = {
+                "full": jax.checkpoint_policies.nothing_saveable,
+                "offload": jax.checkpoint_policies.nothing_saveable,
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[cfg.remat_policy]
+            blk = nn.remat(blk, policy=policy)
+        for j in range(bs):
+            x = blk(cfg, name=f"block_{j}")(x, positions, segment_ids)
+        return x, None
 
 
 class LMHead(nn.Module):
@@ -412,7 +444,7 @@ class LlamaForCausalLM(nn.Module):
                 body,
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
-                length=cfg.num_hidden_layers,
+                length=cfg.num_hidden_layers // cfg.scan_block_size,
                 in_axes=(nn.broadcast, nn.broadcast),
                 metadata_params={nn.PARTITION_NAME: None},
             )
@@ -541,42 +573,66 @@ def count_params(params) -> int:
 _LAYER_KEY = r"layers_(\d+)"
 
 
-def stack_layer_params(params):
+def stack_layer_params(params, scan_block_size: int = 1):
     """Convert unrolled per-layer params (``layers_0..layers_{L-1}``) to the
-    ``scan_layers=True`` layout (``layers_scan/block/...`` with a leading L
-    dim).  Accepts the tree with or without the flax ``params`` wrapper;
+    ``scan_layers=True`` layout: ``layers_scan/block/...`` with a leading L
+    dim (or ``layers_scan/block_j/...`` with a leading L/bs dim when
+    ``scan_block_size=bs>1`` — global layer i maps to iteration i//bs, slot
+    i%bs).  Accepts the tree with or without the flax ``params`` wrapper;
     checkpoints saved in either layout load into either model via this pair
     (reference parity: to-fsdp2-style state-dict converters)."""
     import re
 
     if "params" in params and isinstance(params["params"], dict):
-        return {**params, "params": stack_layer_params(params["params"])}
+        return {**params, "params": stack_layer_params(params["params"], scan_block_size)}
     layer_keys = sorted(
         (k for k in params if re.fullmatch(_LAYER_KEY, k)),
         key=lambda k: int(k.rsplit("_", 1)[1]),
     )
     if not layer_keys:
         return params
+    bs = scan_block_size
+    if len(layer_keys) % bs:
+        raise ValueError(f"{len(layer_keys)} layers not divisible by scan_block_size={bs}")
     out = {k: v for k, v in params.items() if not re.fullmatch(_LAYER_KEY, k)}
-    out["layers_scan"] = {
-        "block": jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *[params[k] for k in layer_keys]
-        )
-    }
+    if bs == 1:
+        out["layers_scan"] = {
+            "block": jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *[params[k] for k in layer_keys]
+            )
+        }
+    else:
+        out["layers_scan"] = {
+            f"block_{j}": jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *[params[k] for k in layer_keys[j::bs]]
+            )
+            for j in range(bs)
+        }
     return out
 
 
 def unstack_layer_params(params):
-    """Inverse of :func:`stack_layer_params`."""
+    """Inverse of :func:`stack_layer_params` (block size inferred from the
+    stacked layout)."""
     if "params" in params and isinstance(params["params"], dict):
         return {**params, "params": unstack_layer_params(params["params"])}
     if "layers_scan" not in params:
         return params
-    stacked = params["layers_scan"]["block"]
-    n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    scan = params["layers_scan"]
     out = {k: v for k, v in params.items() if k != "layers_scan"}
-    for i in range(n):
-        out[f"layers_{i}"] = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+    if "block" in scan:
+        stacked = scan["block"]
+        n = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        for i in range(n):
+            out[f"layers_{i}"] = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+        return out
+    bs = len(scan)
+    n_iter = jax.tree_util.tree_leaves(scan["block_0"])[0].shape[0]
+    for it in range(n_iter):
+        for j in range(bs):
+            out[f"layers_{it * bs + j}"] = jax.tree_util.tree_map(
+                lambda x, it=it: x[it], scan[f"block_{j}"]
+            )
     return out
 
 

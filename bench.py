@@ -361,6 +361,8 @@ def main():
     ap.add_argument("--no-selftest", action="store_true",
                     help="skip the on-chip flash-vs-native parity check")
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--scan-block", type=int, default=None,
+                    help="override scan_block_size (layers per scan iteration)")
     ap.add_argument("--precision", choices=["bf16", "fp8"], default="bf16",
                     help="mixed_precision for the train step (fp8: scaled-e4m3 matmuls)")
     ap.add_argument("--optimizer", choices=["lion", "adamw"], default="lion",
@@ -433,8 +435,14 @@ def main():
             remat_policy="offload" if seq > 98304 else "full",
             # scanned stack: inside lax.scan the offloaded boundaries
             # actually leave HBM (unrolled, the scheduler parks ~5GiB of
-            # them — the r2 131k blocker)
+            # them — the r2 131k blocker).  Past 112k the WORKER HOST's
+            # pinned allocation becomes the ceiling (6.4GiB of boundaries
+            # at 131k crashed it); pair iterations halve the offloaded
+            # boundary count for ~25% extra recompute.
             scan_layers=seq > 98304,
+            scan_block_size=(
+                args.scan_block or (2 if seq > 114688 else 1)
+            ) if seq > 98304 else 1,
         )
         # batch 10 is the HBM sweet spot without remat (8: -4%, 12: OOM)
         batch = args.batch or (1 if long_ctx else 10)

@@ -430,3 +430,43 @@ def test_scan_layers_cached_decode_raises():
     cache = init_cache(cfg, 1, 16)
     with pytest.raises(ValueError, match="unstack_layer_params"):
         model.apply(params, ids, cache=cache)
+
+
+def test_scan_block_size_matches_unrolled():
+    """scan_block_size=2 (pair iterations, halved offload boundaries)
+    computes the same function as the unrolled stack; converters map
+    global layer i to (iteration i//bs, slot i%bs) and round-trip."""
+    from accelerate_tpu.models.llama import stack_layer_params, unstack_layer_params
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, remat=True, remat_policy="offload",
+                           dtype=jnp.float32)
+    scan_cfg = LlamaConfig.tiny(num_hidden_layers=4, remat=True, remat_policy="offload",
+                                scan_layers=True, scan_block_size=2, dtype=jnp.float32)
+    model, scan_model = LlamaForCausalLM(cfg), LlamaForCausalLM(scan_cfg)
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 255, (2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)
+    stacked = stack_layer_params(params, scan_block_size=2)
+    blk = stacked["params"]["layers_scan"]
+    assert set(blk) == {"block_0", "block_1"}
+    assert blk["block_0"]["self_attn"]["q_proj"]["kernel"].shape[0] == 2
+
+    np.testing.assert_allclose(
+        np.asarray(model.apply(params, ids)),
+        np.asarray(scan_model.apply(stacked, ids)), rtol=2e-5, atol=2e-5)
+
+    loss_fn, s_loss_fn = make_llama_loss_fn(model), make_llama_loss_fn(scan_model)
+    batch = {"input_ids": ids, "labels": ids}
+    loss = loss_fn(params, batch)
+    s_loss, s_grads = jax.value_and_grad(s_loss_fn)(stacked, batch)
+    np.testing.assert_allclose(float(loss), float(s_loss), rtol=1e-5)
+    assert all(bool(jnp.isfinite(g).all()) for g in jax.tree_util.tree_leaves(s_grads))
+
+    rt = unstack_layer_params(stacked)
+    assert jax.tree_util.tree_structure(rt) == jax.tree_util.tree_structure(params)
+    for a, b in zip(jax.tree_util.tree_leaves(rt), jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="scan_block_size"):
+        LlamaConfig.tiny(num_hidden_layers=4, scan_layers=True, scan_block_size=3)
+    with pytest.raises(ValueError, match="requires scan_layers"):
+        LlamaConfig.tiny(num_hidden_layers=4, scan_block_size=2)
