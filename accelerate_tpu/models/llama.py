@@ -59,10 +59,11 @@ class LlamaConfig:
     scan_layers: bool = False
     # layers per scan iteration: >1 offloads only every Nth boundary (the
     # blocks inside an iteration re-remat individually on backward), cutting
-    # the pinned-host residual buffer by N at ~(N-1)/(2N) extra forward
-    # recompute — the lever when the *host's* pinned allocation is the
-    # ceiling (131k: 6.4 GiB of boundaries crashed the worker; stride 2
-    # halves it).  Must divide num_hidden_layers.
+    # the pinned-host residual buffer by N.  Cost is quadratic in N: block
+    # j's backward recomputes the chain 0..j from the iteration boundary,
+    # i.e. (N-1)/2 extra forwards per block on average (measured: N=4 ran
+    # 3x slower than N=1 at 112k) — use the smallest N that fits.  Must
+    # divide num_hidden_layers.
     scan_block_size: int = 1
     dtype: Any = jnp.bfloat16
 
