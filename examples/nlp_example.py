@@ -17,6 +17,7 @@ Run::
 """
 
 import argparse
+import time
 
 import jax
 import jax.numpy as jnp
@@ -81,8 +82,12 @@ def training_function(args):
     )
 
     for epoch in range(args.num_epochs):
+        t0, n_steps = time.perf_counter(), 0
         for batch in train_dl:
             state, metrics = train_step(state, batch)
+            n_steps += 1
+        float(metrics["loss"])  # sync (scalar fetch — reliable on all platforms)
+        epoch_s = time.perf_counter() - t0
         correct = total = 0
         for batch in eval_dl:
             preds = eval_step(state.params, batch)
@@ -91,7 +96,9 @@ def training_function(args):
             total += len(np.asarray(refs))
         accelerator.print(
             f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
-            f"accuracy {correct / max(total, 1):.3f}"
+            f"accuracy {correct / max(total, 1):.3f} "
+            f"({1e3 * epoch_s / max(n_steps, 1):.1f} ms/step"
+            f"{' incl. compile' if epoch == 0 else ''})"
         )
     return correct / max(total, 1)
 

@@ -470,3 +470,21 @@ def test_scan_block_size_matches_unrolled():
         LlamaConfig.tiny(num_hidden_layers=4, scan_layers=True, scan_block_size=3)
     with pytest.raises(ValueError, match="requires scan_layers"):
         LlamaConfig.tiny(num_hidden_layers=4, scan_block_size=2)
+
+
+def test_mixtral_scan_layers_parity():
+    """scan_layers composes with the MoE block family (MixtralConfig
+    subclasses LlamaConfig; blocks are homogeneous so the stacked scan
+    applies unchanged)."""
+    from accelerate_tpu.models import MixtralConfig, MixtralForCausalLM
+    from accelerate_tpu.models.llama import stack_layer_params
+
+    cfg = MixtralConfig.tiny(dtype=jnp.float32)
+    scfg = MixtralConfig.tiny(dtype=jnp.float32, scan_layers=True)
+    m, sm = MixtralForCausalLM(cfg), MixtralForCausalLM(scfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 255, (2, 16)), jnp.int32)
+    params = m.init(jax.random.PRNGKey(0), ids)
+    np.testing.assert_allclose(
+        np.asarray(m.apply(params, ids)),
+        np.asarray(sm.apply(stack_layer_params(params), ids)),
+        rtol=2e-5, atol=2e-5)
