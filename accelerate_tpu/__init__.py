@@ -41,7 +41,23 @@ try:
 except ImportError:  # pragma: no cover
     pass
 try:
-    from .big_modeling import init_empty_weights, load_checkpoint_and_dispatch
+    from .big_modeling import (
+        abstract_init,
+        cpu_offload,
+        disk_offload,
+        dispatch_model,
+        infer_auto_device_map,
+        infer_auto_placement,
+        init_empty_weights,
+        load_checkpoint_and_dispatch,
+        load_checkpoint_in_model,
+        offload_state_dict,
+        offloaded_apply,
+    )
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .utils.memory import find_executable_batch_size
 except ImportError:  # pragma: no cover
     pass
 try:

@@ -361,7 +361,7 @@ def _lookup_placement(key: str, normalized: Mapping[str, Any]):
         hit = normalized.get("/".join(parts[:depth]))
         if hit is not None:
             return hit
-    return None
+    return normalized.get("")  # root catch-all ({"": "cpu"} = whole tree)
 
 
 def _iter_checkpoint_tensors(checkpoint_path: Union[str, os.PathLike]):
@@ -401,6 +401,7 @@ def load_checkpoint_in_model(
     offload_folder: Optional[str] = None,
     strict: bool = False,
     key_map: Optional[Callable[[str], str]] = None,
+    tensor_map: Optional[Callable[[str, np.ndarray], np.ndarray]] = None,
 ):
     """Stream a checkpoint directly into (sharded) device arrays.
 
@@ -408,6 +409,12 @@ def load_checkpoint_in_model(
     real arrays; ``sharding_plan``: matching pytree of NamedSharding (e.g.
     from make_sharding_plan).  Tensors assigned to 'cpu'/'disk' by
     ``offload_placement`` stay on host / in an OffloadStore.
+
+    ``key_map``/``tensor_map`` adapt FOREIGN checkpoint layouts at stream
+    time: key_map renames (return None to skip a tensor), tensor_map
+    receives (our_key, array) and may transpose/reshape — e.g. torch
+    ``Linear.weight`` [out, in] into a flax kernel [in, out]; see
+    ``models/hf_interop.py`` for the HuggingFace-format maps.
 
     Returns (params pytree, OffloadStore|None).  reference:
     load_checkpoint_in_model modeling.py:1788 + set_module_tensor_to_device
@@ -430,18 +437,22 @@ def load_checkpoint_in_model(
     loaded: dict[str, Any] = {}
     unexpected = []
 
-    def _normalize(name: str) -> str:
+    def _normalize(name: str) -> Optional[str]:
         name = key_map(name) if key_map else name
-        return name.replace(".", "/")
+        return None if name is None else name.replace(".", "/")
 
     try:
         for name, tensor in _iter_checkpoint_tensors(checkpoint):
             key = _normalize(name)
+            if key is None:  # key_map skip (e.g. HF rotary inv_freq buffers)
+                continue
             if key not in flat_abstract:
                 unexpected.append(name)
                 continue
             target_dtype = dtype or flat_abstract[key].dtype
             tensor = np.asarray(tensor)
+            if tensor_map is not None:
+                tensor = np.asarray(tensor_map(key, tensor))
             if tuple(tensor.shape) != tuple(flat_abstract[key].shape):
                 raise ValueError(
                     f"shape mismatch for {name}: checkpoint {tensor.shape} vs model {flat_abstract[key].shape}"
@@ -497,10 +508,14 @@ def load_checkpoint_and_dispatch(
     offload_folder: Optional[str] = None,
     dtype=None,
     strict: bool = False,
+    key_map: Optional[Callable[[str], str]] = None,
+    tensor_map: Optional[Callable[[str, np.ndarray], np.ndarray]] = None,
 ):
     """One-call UX (reference load_checkpoint_and_dispatch big_modeling.py:513):
     abstract-init the module, plan sharding/offload, stream the checkpoint
-    into final placement.  Returns (params, offload_store)."""
+    into final placement.  Returns (params, offload_store).
+    ``key_map``/``tensor_map`` adapt foreign checkpoint layouts (see
+    load_checkpoint_in_model)."""
     rng = rng if rng is not None else jax.random.key(0)
     abstract = abstract_init(module, rng, *sample_args, **(sample_kwargs or {}))
 
@@ -525,6 +540,7 @@ def load_checkpoint_and_dispatch(
     return load_checkpoint_in_model(
         abstract, checkpoint, sharding_plan=plan, dtype=dtype,
         offload_placement=placement, offload_folder=offload_folder, strict=strict,
+        key_map=key_map, tensor_map=tensor_map,
     )
 
 
@@ -555,6 +571,35 @@ def dispatch_model(params, placement: dict[str, Union[int, str]], offload_folder
         if store is not None:
             store.flush()
     return placed, store
+
+
+def cpu_offload(params, apply_fn: Optional[Callable] = None, execution_device=None):
+    """Whole-tree host offload (reference big_modeling.py:cpu_offload:175):
+    every leaf moves to host memory; with ``apply_fn`` given, also returns a
+    wrapped apply that ships leaves to ``execution_device`` just-in-time per
+    call and frees them after.  For layer-granular streaming at generation
+    time, prefer :func:`accelerate_tpu.generation.generate_streamed`."""
+    placed, _ = dispatch_model(params, {"": "cpu"})
+    if apply_fn is None:
+        return placed
+    return placed, offloaded_apply(apply_fn, execution_device)
+
+
+def disk_offload(params, offload_dir: Union[str, os.PathLike],
+                 apply_fn: Optional[Callable] = None, execution_device=None):
+    """Whole-tree disk offload (reference big_modeling.py:disk_offload:226):
+    leaves are written to ``offload_dir`` and rebound as memory-maps; with
+    ``apply_fn`` given, also returns the just-in-time wrapped apply."""
+    placed, _store = dispatch_model(params, {"": "disk"}, offload_folder=str(offload_dir))
+    if apply_fn is None:
+        return placed
+    return placed, offloaded_apply(apply_fn, execution_device)
+
+
+# Reference-name alias (reference modeling.py:infer_auto_device_map:1278):
+# same planner, TPU-native semantics — GSPMD sharding handles *splitting*,
+# this handles *capacity overflow* into host/disk tiers.
+infer_auto_device_map = infer_auto_placement
 
 
 def offloaded_apply(apply_fn: Callable, device=None):

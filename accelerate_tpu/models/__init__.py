@@ -1,4 +1,5 @@
 from .bert import BertConfig, BertForSequenceClassification, make_bert_loss_fn
+from .hf_interop import hf_llama_key_map, hf_llama_tensor_map, load_hf_llama
 from .llama import (
     LlamaConfig,
     LlamaForCausalLM,

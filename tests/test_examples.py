@@ -31,7 +31,7 @@ def _run(script, *extra, timeout=420):
 def test_nlp_example():
     out = _run(EXAMPLES / "nlp_example.py", "--num_epochs", "2")
     assert "accuracy" in out
-    acc = float(out.strip().splitlines()[-1].rsplit("accuracy ", 1)[1])
+    acc = float(out.strip().splitlines()[-1].rsplit("accuracy ", 1)[1].split()[0])
     assert acc > 0.8, out  # signal-token task is nearly separable
 
 
