@@ -364,8 +364,13 @@ def _lookup_placement(key: str, normalized: Mapping[str, Any]):
     return normalized.get("")  # root catch-all ({"": "cpu"} = whole tree)
 
 
-def _iter_checkpoint_tensors(checkpoint_path: Union[str, os.PathLike]):
-    """Yield (name, numpy array (possibly lazy)) from a file or sharded dir."""
+def _iter_checkpoint_tensors(checkpoint_path):
+    """Yield (name, numpy array (possibly lazy)) from a file, a sharded dir,
+    or — for stream adapters like hf_interop's expert stacking — any
+    already-built iterable of (name, array) pairs, passed through."""
+    if not isinstance(checkpoint_path, (str, os.PathLike)):
+        yield from checkpoint_path
+        return
     p = Path(checkpoint_path)
     files: list[Path]
     if p.is_dir():

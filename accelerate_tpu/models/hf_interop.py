@@ -36,9 +36,19 @@ _LLAMA_RULES: list[tuple[str, str]] = [
     (r"^model\.norm\.weight$", r"params.norm.scale"),
     (r"^lm_head\.weight$", r"params.lm_head.kernel"),
 ]
+
 # Mixtral's HF layout stores per-expert w1/w2/w3 tensors while this
-# framework keeps experts STACKED [E, d, f] (GShard dispatch) — streaming
-# them needs an E-way accumulation pass, tracked in ROADMAP.
+# framework keeps experts STACKED [E, d, f] (GShard dispatch); the router
+# renames directly, the experts go through the E-way stacking pass in
+# :func:`load_hf_mixtral`.
+_MIXTRAL_ROUTER_RULE = (
+    r"^model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight$",
+    r"params.layers_\1.block_sparse_moe.router.kernel",
+)
+_MIXTRAL_EXPERT_RE = re.compile(
+    r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w([123])\.weight$"
+)
+_EXPERT_PROJ = {"1": "gate_proj", "2": "down_proj", "3": "up_proj"}
 
 # HF buffers with no param here (recomputed from config at trace time)
 _SKIP = re.compile(r"rotary_emb\.inv_freq$")
@@ -47,8 +57,9 @@ _SKIP = re.compile(r"rotary_emb\.inv_freq$")
 def hf_llama_key_map(name: str) -> Optional[str]:
     """HF **Llama-family** ``state_dict`` name -> this framework's param
     path (dot-separated, as load_checkpoint_in_model normalizes), or None
-    for buffers that should be skipped.  Mixtral's per-expert tensors need
-    the E-way stacking pass tracked in ROADMAP and are NOT covered."""
+    for buffers that should be skipped.  Mixtral checkpoints go through
+    :func:`load_hf_mixtral`, which adds the router rename and the E-way
+    expert stacking pass."""
     if _SKIP.search(name):
         return None
     for pattern, template in _LLAMA_RULES:
@@ -88,4 +99,80 @@ def load_hf_llama(model, checkpoint, *, mesh=None, dtype=None, rng=None,
         model, checkpoint, rng=rng, sample_args=sample_args, mesh=mesh,
         dtype=dtype, strict=strict,
         key_map=hf_llama_key_map, tensor_map=hf_llama_tensor_map, **kwargs,
+    )
+
+
+def hf_mixtral_key_map(name: str) -> Optional[str]:
+    """Like :func:`hf_llama_key_map` plus the MoE router and the synthetic
+    ``experts_stacked`` names that :func:`_stack_expert_stream` emits."""
+    m = re.match(
+        r"^model\.layers\.(\d+)\.block_sparse_moe\.experts_stacked\.(\w+)$", name
+    )
+    if m:
+        return f"params.layers_{m.group(1)}.block_sparse_moe.experts.{m.group(2)}"
+    if re.match(_MIXTRAL_ROUTER_RULE[0], name):
+        return re.sub(*_MIXTRAL_ROUTER_RULE, name)
+    return hf_llama_key_map(name)
+
+
+def _stack_expert_stream(checkpoint, num_experts: int):
+    """Adapt a raw HF Mixtral tensor stream: per-expert w1/w2/w3 [out, in]
+    tensors are transposed and buffered per (layer, proj); as soon as all
+    ``num_experts`` arrive, ONE stacked [E, ...] tensor is yielded under a
+    synthetic ``experts_stacked`` name and the buffer entry is freed (HF
+    files are layer-ordered, so at most ~one layer's projections are ever
+    buffered).  Non-expert tensors pass through untouched, so the normal
+    loader applies sharding plans / placement / dtype / strictness
+    uniformly in a single read of the checkpoint."""
+    from ..big_modeling import _iter_checkpoint_tensors
+
+    buf: dict[tuple[str, str], dict[int, np.ndarray]] = {}
+    for name, tensor in _iter_checkpoint_tensors(checkpoint):
+        m = _MIXTRAL_EXPERT_RE.match(name)
+        if not m:
+            yield name, tensor
+            continue
+        layer, eidx, w = m.group(1), int(m.group(2)), m.group(3)
+        key = (layer, _EXPERT_PROJ[w])
+        buf.setdefault(key, {})[eidx] = np.asarray(tensor).T
+        if len(buf[key]) == num_experts:
+            group = buf.pop(key)
+            yield (
+                f"model.layers.{layer}.block_sparse_moe.experts_stacked.{key[1]}",
+                np.stack([group[i] for i in range(num_experts)]),
+            )
+    if buf:
+        raise ValueError(
+            "incomplete expert groups in checkpoint: "
+            + ", ".join(
+                f"layer {l} {p}: have {sorted(g)} of {num_experts}"
+                for (l, p), g in buf.items()
+            )
+        )
+
+
+def load_hf_mixtral(model, checkpoint, *, mesh=None, dtype=None, rng=None,
+                    sample_args=(), strict: bool = True, **kwargs):
+    """Stream an HF-format Mixtral checkpoint in one pass: shared weights
+    stream like Llama; per-expert w1/w2/w3 tensors are transposed and
+    stacked into this framework's [E, d, f] / [E, f, d] expert arrays by a
+    stream adapter, so mesh sharding plans, device_map placement, dtype
+    casting, and ``strict`` checking all apply to the experts exactly as to
+    every other weight.  Returns (params, offload_store)."""
+    import jax.numpy as jnp
+
+    from ..big_modeling import load_checkpoint_and_dispatch
+
+    if getattr(model.config, "scan_layers", False):
+        raise ValueError(
+            "load_hf_mixtral needs the unrolled layout; load with "
+            "scan_layers=False, then convert via stack_layer_params."
+        )
+    if not sample_args:
+        sample_args = (jnp.ones((1, 8), jnp.int32),)
+    stream = _stack_expert_stream(checkpoint, model.config.num_local_experts)
+    return load_checkpoint_and_dispatch(
+        model, stream, rng=rng, sample_args=sample_args, mesh=mesh,
+        dtype=dtype, strict=strict,
+        key_map=hf_mixtral_key_map, tensor_map=hf_llama_tensor_map, **kwargs,
     )

@@ -77,3 +77,71 @@ def test_load_hf_llama_scan_layers_guard(hf_checkpoint):
     cfg = LlamaConfig.tiny(scan_layers=True)
     with pytest.raises(ValueError, match="stack_layer_params"):
         load_hf_llama(LlamaForCausalLM(cfg), path)
+
+
+@pytest.fixture(scope="module")
+def hf_mixtral_checkpoint(tmp_path_factory):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    hf_model = transformers.MixtralForCausalLM(hf_cfg).eval()
+    path = tmp_path_factory.mktemp("hf_mixtral") / "model.safetensors"
+    safetensors_torch.save_file(
+        {k: v.contiguous() for k, v in hf_model.state_dict().items()}, str(path)
+    )
+    return hf_model, path
+
+
+def test_hf_mixtral_logits_parity(hf_mixtral_checkpoint):
+    """Expert stacking pass: per-expert w1/w2/w3 land transposed in the
+    stacked [E, d, f] arrays; logits match transformers' Mixtral (capacity
+    set high enough that the GShard dispatch drops no tokens, matching
+    HF's drop-free routing)."""
+    from accelerate_tpu.models import MixtralConfig, MixtralForCausalLM
+    from accelerate_tpu.models.hf_interop import load_hf_mixtral
+
+    hf_model, path = hf_mixtral_checkpoint
+    cfg = MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2, capacity_factor=8.0,
+        max_position_embeddings=128, dtype=jnp.float32,
+    )
+    model = MixtralForCausalLM(cfg)
+    params, _ = load_hf_mixtral(model, path, dtype=jnp.float32)
+
+    ids = np.random.default_rng(1).integers(0, 256, (2, 12))
+    ours = np.asarray(model.apply(params, jnp.asarray(ids, jnp.int32)))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-4)
+
+
+def test_hf_mixtral_sharded_load(hf_mixtral_checkpoint):
+    """With a mesh, the stacked expert tensors land in their PLANNED shards
+    like every other weight (the stream adapter feeds the normal loader —
+    r3 review finding: a bolt-on second pass bypassed the sharding plan)."""
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import MixtralConfig, MixtralForCausalLM
+    from accelerate_tpu.models.hf_interop import load_hf_mixtral
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8))
+    _, path = hf_mixtral_checkpoint
+    cfg = MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=128, dtype=jnp.float32,
+    )
+    params, _ = load_hf_mixtral(MixtralForCausalLM(cfg), path, mesh=acc.mesh)
+    leaf = params["params"]["layers_0"]["block_sparse_moe"]["experts"]["gate_proj"]
+    assert leaf.shape == (4, 64, 128)
+    assert hasattr(leaf.sharding, "mesh")  # NamedSharding from the plan
