@@ -134,6 +134,19 @@ def generate(
     the compile cache keys on its identity.
     """
     generation_config = generation_config or GenerationConfig()
+    if getattr(getattr(model, "config", None), "scan_layers", False):
+        # cached decode needs the unrolled layout; convert transparently so
+        # a scan_layers-trained state generates without manual steps (the
+        # unstack is host-side slicing, done once per call — for a hot
+        # serving loop convert once via unstack_layer_params and rebuild)
+        import dataclasses
+
+        from .models.llama import unstack_layer_params
+
+        model = type(model)(
+            dataclasses.replace(model.config, scan_layers=False, scan_block_size=1)
+        )
+        params = unstack_layer_params(params)
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, t_prompt = input_ids.shape
     if prompt_lengths is None:

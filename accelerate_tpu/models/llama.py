@@ -415,7 +415,8 @@ class LlamaForCausalLM(nn.Module):
         if cfg.scan_layers and cache is not None:
             raise ValueError(
                 "scan_layers=True has no cached-decode path (the KV cache is "
-                "per-layer). For generation, convert once: "
+                "per-layer). generation.generate() converts automatically; "
+                "for direct cached apply, convert once: "
                 "params = unstack_layer_params(params) and rebuild the model "
                 "with dataclasses.replace(cfg, scan_layers=False)."
             )

@@ -394,3 +394,21 @@ def test_generate_streamed_matches_regular(tiny_model):
                 f"row {r} step {first}: {a} vs {b} not a near-tie "
                 f"({logits[a]:.4f} vs {logits[b]:.4f})"
             )
+
+
+def test_generate_from_scan_layout_params():
+    """A scan_layers-trained state generates directly: generate() converts
+    to the unrolled layout transparently (unstack + config replace)."""
+    import dataclasses
+
+    from accelerate_tpu.models.llama import stack_layer_params
+
+    cfg = LlamaConfig.tiny(scan_layers=True)
+    model = LlamaForCausalLM(cfg)
+    un_model = LlamaForCausalLM(dataclasses.replace(cfg, scan_layers=False))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 255, (1, 8)), jnp.int32)
+    un_params = un_model.init(jax.random.PRNGKey(0), ids)
+    out_scan = generate(model, stack_layer_params(un_params), ids,
+                        GenerationConfig(max_new_tokens=4))
+    out_ref = generate(un_model, un_params, ids, GenerationConfig(max_new_tokens=4))
+    np.testing.assert_array_equal(np.asarray(out_scan), np.asarray(out_ref))
