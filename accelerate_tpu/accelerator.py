@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import contextlib
 import inspect
-import logging
 import math
 from dataclasses import dataclass, field
 from functools import partial
@@ -74,9 +73,10 @@ from .utils.dataclasses import (
     SequenceParallelConfig,
     TensorParallelConfig,
 )
+from .logging import get_logger
 from .utils.environment import parse_flag_from_env
 
-logger = logging.getLogger(__name__)
+logger = get_logger(__name__)
 
 try:
     import flax.struct
@@ -164,7 +164,29 @@ def _host_constant_hoist(fn, host_sharding, *example_args):
     The traced fn is inlined (``disable_jit``) so nested ``jit[_where]``
     calls expose their literals to the split.  Per-leaf optimizers without
     constant arrays (adamw/lion/sgd) hoist nothing and pass through
-    untouched."""
+    untouched.
+
+    The split leans on non-public JAX machinery (``partial_eval``,
+    ``eval_jaxpr`` replay of recorded eqn contexts), tested against jax
+    0.9.x; if a JAX upgrade breaks it we fall back to the unhoisted ``fn``
+    with a loud warning rather than crashing every host-offload config —
+    const-free optimizers keep working, const-bearing ones (adafactor) will
+    fail at lowering with the mixed-memory-space error this hoist exists to
+    prevent."""
+    try:
+        return _host_constant_hoist_unsafe(fn, host_sharding, *example_args)
+    except Exception as e:  # pragma: no cover - only fires on JAX API drift
+        logger.warning_once(
+            "Constant hoisting for host-compute optimizer updates is unavailable "
+            f"on jax {jax.__version__} ({type(e).__name__}: {e}). Optimizers that "
+            "materialize constant arrays at trace time (e.g. adafactor) are "
+            "unsupported with cpu_offload on this JAX version; adamw/lion/sgd "
+            "are unaffected."
+        )
+        return fn
+
+
+def _host_constant_hoist_unsafe(fn, host_sharding, *example_args):
     from jax._src.interpreters import partial_eval as pe
 
     flat, in_tree = jax.tree_util.tree_flatten(example_args)
@@ -1412,16 +1434,30 @@ class Accelerator:
 
     @contextlib.contextmanager
     def profile(self, profile_handler: Optional[ProfileKwargs] = None):
-        """jax.profiler trace context (reference profile :4168)."""
+        """Step-scheduled profiler context (reference profile :4168; the
+        ProfileKwargs schedule semantics of reference dataclasses.py:484).
+
+        Yields a :class:`~accelerate_tpu.utils.profiler.TPUProfiler`; call
+        ``profiler.step()`` once per training step and exactly the
+        ``active`` steps of each wait/warmup/active cycle are traced.
+        Without ``step()`` calls the whole block is one active window::
+
+            with accelerator.profile(ProfileKwargs(wait=1, warmup=1,
+                                                   active=3,
+                                                   output_trace_dir=d)) as p:
+                for batch in loader:
+                    train_step(batch)
+                    p.step()
+        """
+        from .utils.profiler import TPUProfiler
+
         handler = profile_handler or self.profile_kwargs
-        trace_dir = handler.output_trace_dir
-        if trace_dir is None:
-            yield
-            return
-        with jax.profiler.trace(trace_dir, create_perfetto_link=handler.create_perfetto_link):
-            yield
-        if handler.on_trace_ready is not None:
-            handler.on_trace_ready(trace_dir)
+        profiler = TPUProfiler(handler)
+        profiler._enter()
+        try:
+            yield profiler
+        finally:
+            profiler._exit()
 
     # -- misc lifecycle ----------------------------------------------------
 

@@ -136,17 +136,8 @@ def generate(
     generation_config = generation_config or GenerationConfig()
     if getattr(getattr(model, "config", None), "scan_layers", False):
         # cached decode needs the unrolled layout; convert transparently so
-        # a scan_layers-trained state generates without manual steps (the
-        # unstack is host-side slicing, done once per call — for a hot
-        # serving loop convert once via unstack_layer_params and rebuild)
-        import dataclasses
-
-        from .models.llama import unstack_layer_params
-
-        model = type(model)(
-            dataclasses.replace(model.config, scan_layers=False, scan_block_size=1)
-        )
-        params = unstack_layer_params(params)
+        # a scan_layers-trained state generates without manual steps
+        model, params = _unrolled_view(model, params)
     input_ids = jnp.asarray(input_ids, jnp.int32)
     b, t_prompt = input_ids.shape
     if prompt_lengths is None:
@@ -162,6 +153,39 @@ def generate(
     return _jitted_generate(model, generation_config, apply_fn)(
         params, input_ids, prompt_lengths, rng, max_cache_len
     )
+
+
+# scan-layout -> unrolled-layout conversion, memoized so repeat generate()
+# calls on the same state skip the host-side unstack.  The entry is validated
+# leaf-by-leaf (weakrefs + `is` checks), so in-place updates to nested leaves
+# miss and reconvert, and it holds NO strong refs to the stacked tree — when
+# the caller drops the state the sentinel weakrefs die and the entry is
+# evicted rather than pinning two full param trees.
+_UNROLL_MEMO: dict = {}  # "entry" -> (leaf_weakrefs, converted_tree)
+
+
+def _unrolled_view(model, params):
+    """Return ``(model, params)`` rebuilt in the unrolled (per-layer) layout
+    from a ``scan_layers`` state.  ``Module.clone`` keeps any extra attributes
+    a model subclass may carry; only the config is swapped."""
+    import weakref
+
+    from .models.llama import unstack_layer_params
+
+    cfg = dataclasses.replace(model.config, scan_layers=False, scan_block_size=1)
+    new_model = model.clone(config=cfg) if hasattr(model, "clone") else type(model)(cfg)
+    leaves = jax.tree_util.tree_leaves(params)
+    entry = _UNROLL_MEMO.get("entry")
+    if entry is not None:
+        refs, converted = entry
+        if len(refs) == len(leaves) and all(r() is l for r, l in zip(refs, leaves)):
+            return new_model, converted
+    converted = unstack_layer_params(params)
+    try:
+        _UNROLL_MEMO["entry"] = ([weakref.ref(l) for l in leaves], converted)
+    except TypeError:  # a leaf type without weakref support: skip memoization
+        _UNROLL_MEMO.pop("entry", None)
+    return new_model, converted
 
 
 @lru_cache(maxsize=32)

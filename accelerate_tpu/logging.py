@@ -1,58 +1,121 @@
 """Multi-process-aware logging.
 
-Port of reference ``logging.py`` (126 LoC): ``MultiProcessAdapter`` (:23)
-gates records on ``main_process_only`` and supports ``in_order`` rank-by-rank
-emission (barrier-sequenced)."""
+Fills the role of reference ``logging.py`` (``MultiProcessAdapter``,
+``get_logger``) with the same call contract —
+``logger.info(msg, main_process_only=True)`` / ``in_order=True`` — on a
+different engine: a plain wrapper that resolves *which ranks emit, and in
+what order* up front (:func:`_emission_turns`), then plays those turns.
+
+Under a JAX multi-process run every process executes the same program, so
+unguarded logging prints N copies of everything; the wrapper defaults to
+rank-0-only and offers barrier-sequenced per-rank emission for debugging
+rank-dependent state.
+"""
 
 from __future__ import annotations
 
-import functools
 import logging
 import os
 
+_LEVEL_ENV = "ACCELERATE_LOG_LEVEL"
 
-class MultiProcessAdapter(logging.LoggerAdapter):
-    """reference logging.py:23 — same kwargs contract:
-    ``logger.info(msg, main_process_only=True)`` or ``in_order=True``."""
 
-    @staticmethod
-    def _should_log(main_process_only):
-        from .state import PartialState
+def _emission_turns(main_process_only: bool, in_order: bool):
+    """Yield once per moment this process should emit the record.
 
-        return not main_process_only or PartialState().is_main_process
+    - ``main_process_only``: a single immediate turn on rank 0, none elsewhere.
+    - ``in_order``: every rank gets a turn, sequenced by barriers so the
+      records interleave rank-by-rank across processes.
+    - otherwise: one immediate turn on every rank.
+    """
+    from .state import PartialState
+
+    state = PartialState()
+    if main_process_only:
+        if state.is_main_process:
+            yield
+        return
+    if not in_order or state.num_processes == 1:
+        yield
+        return
+    for turn in range(state.num_processes):
+        if turn == state.process_index:
+            yield
+        state.wait_for_everyone()
+
+
+class MultiProcessAdapter:
+    """Process-aware façade over a stdlib logger.
+
+    Exposes the standard level methods (``debug``/``info``/.../``critical``)
+    plus the reference's two extra kwargs on each: ``main_process_only``
+    (default True) and ``in_order``.  ``warning_once`` deduplicates by
+    message content per adapter instance.
+    """
+
+    def __init__(self, logger: logging.Logger, extra: dict | None = None):
+        self.logger = logger
+        self.extra = extra or {}
+        self._warned: set = set()
+
+    def process(self, msg, kwargs):
+        if self.extra:
+            kwargs.setdefault("extra", self.extra)
+        return msg, kwargs
 
     def log(self, level, msg, *args, **kwargs):
-        if int(os.environ.get("ACCELERATE_LOG_LEVEL", -1)) >= 0:
-            self.logger.setLevel(int(os.environ["ACCELERATE_LOG_LEVEL"]))
+        env_level = os.environ.get(_LEVEL_ENV)
+        if env_level is not None and env_level.lstrip("-").isdigit() and int(env_level) >= 0:
+            self.logger.setLevel(int(env_level))
         main_process_only = kwargs.pop("main_process_only", True)
         in_order = kwargs.pop("in_order", False)
-        kwargs.setdefault("stacklevel", 2)
+        kwargs.setdefault("stacklevel", 3)
+        if not self.logger.isEnabledFor(level):
+            return
+        for _ in _emission_turns(main_process_only, in_order):
+            out_msg, out_kwargs = self.process(msg, dict(kwargs))
+            self.logger.log(level, out_msg, *args, **out_kwargs)
 
-        if self.isEnabledFor(level):
-            if self._should_log(main_process_only):
-                msg, kwargs = self.process(msg, kwargs)
-                self.logger.log(level, msg, *args, **kwargs)
-            elif in_order:
-                from .state import PartialState
+    def debug(self, msg, *args, **kwargs):
+        self.log(logging.DEBUG, msg, *args, **kwargs)
 
-                state = PartialState()
-                for i in range(state.num_processes):
-                    if i == state.process_index:
-                        msg, kwargs = self.process(msg, kwargs)
-                        self.logger.log(level, msg, *args, **kwargs)
-                    state.wait_for_everyone()
+    def info(self, msg, *args, **kwargs):
+        self.log(logging.INFO, msg, *args, **kwargs)
 
-    @functools.lru_cache(None)
-    def warning_once(self, *args, **kwargs):
-        self.warning(*args, **kwargs)
+    def warning(self, msg, *args, **kwargs):
+        self.log(logging.WARNING, msg, *args, **kwargs)
+
+    def error(self, msg, *args, **kwargs):
+        self.log(logging.ERROR, msg, *args, **kwargs)
+
+    def critical(self, msg, *args, **kwargs):
+        self.log(logging.CRITICAL, msg, *args, **kwargs)
+
+    def exception(self, msg, *args, **kwargs):
+        kwargs.setdefault("exc_info", True)
+        self.log(logging.ERROR, msg, *args, **kwargs)
+
+    def warning_once(self, msg, *args, **kwargs):
+        key = (str(msg), args)
+        if key not in self._warned:
+            self._warned.add(key)
+            kwargs.setdefault("stacklevel", 4)  # skip the extra frame
+            self.warning(msg, *args, **kwargs)
+
+    def isEnabledFor(self, level) -> bool:
+        return self.logger.isEnabledFor(level)
+
+    def setLevel(self, level) -> None:
+        self.logger.setLevel(level)
 
 
 def get_logger(name: str, log_level: str = None) -> MultiProcessAdapter:
-    """reference get_logger (logging.py:84)."""
+    """Named process-aware logger (the reference ``get_logger`` contract);
+    ``log_level`` falls back to the ``ACCELERATE_LOG_LEVEL`` env var."""
     if log_level is None:
-        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+        log_level = os.environ.get(_LEVEL_ENV)
     logger = logging.getLogger(name)
     if log_level is not None:
         logger.setLevel(log_level.upper())
         logger.root.setLevel(log_level.upper())
-    return MultiProcessAdapter(logger, {})
+    return MultiProcessAdapter(logger)

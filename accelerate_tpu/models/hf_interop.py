@@ -95,11 +95,30 @@ def load_hf_llama(model, checkpoint, *, mesh=None, dtype=None, rng=None,
         import jax.numpy as jnp
 
         sample_args = (jnp.ones((1, 8), jnp.int32),)
-    return load_checkpoint_and_dispatch(
-        model, checkpoint, rng=rng, sample_args=sample_args, mesh=mesh,
-        dtype=dtype, strict=strict,
-        key_map=hf_llama_key_map, tensor_map=hf_llama_tensor_map, **kwargs,
-    )
+    key_map = hf_llama_key_map
+    if getattr(model.config, "tie_word_embeddings", False):
+        # tied model: the head reuses embed_tokens, so the param tree has no
+        # lm_head leaf — a stored lm_head.weight (some exporters keep one)
+        # would otherwise surface as `unexpected` under strict
+        def key_map(name):
+            return None if name == "lm_head.weight" else hf_llama_key_map(name)
+
+    try:
+        return load_checkpoint_and_dispatch(
+            model, checkpoint, rng=rng, sample_args=sample_args, mesh=mesh,
+            dtype=dtype, strict=strict,
+            key_map=key_map, tensor_map=hf_llama_tensor_map, **kwargs,
+        )
+    except ValueError as e:
+        if "missing" in str(e) and "lm_head" in str(e):
+            raise ValueError(
+                "This checkpoint stores no lm_head.weight — it was saved with "
+                "tied word embeddings (tie_word_embeddings=True, e.g. "
+                "TinyLlama/Gemma-style exports). Build the model with "
+                "tie_word_embeddings=True so the head reuses embed_tokens, or "
+                "pass strict=False to leave lm_head abstract."
+            ) from e
+        raise
 
 
 def hf_mixtral_key_map(name: str) -> Optional[str]:

@@ -44,11 +44,47 @@ def is_array_like(x: Any) -> bool:
     )
 
 
+def _container_spec(node) -> Optional[tuple]:
+    """One level of pytree structure: ``(children, rebuild)`` for a container
+    node, ``None`` for a leaf.
+
+    ``rebuild`` is a closure that reassembles the *same* container type from a
+    list of (possibly transformed) children — namedtuples via positional
+    construction, Mappings via their own constructor with insertion order kept.
+    This is the pytree registry the host-level ops run on; it mirrors what
+    ``jax.tree_util`` does for jit-side trees but also accepts arbitrary
+    ``Mapping`` subclasses (e.g. ``transformers.BatchEncoding``) that JAX's
+    registry treats as opaque leaves.
+    """
+    if isinstance(node, Mapping):
+        keys = list(node.keys())
+        return [node[k] for k in keys], lambda vals: type(node)(dict(zip(keys, vals)))
+    if isinstance(node, (list, tuple)):
+        children = list(node)
+        if hasattr(node, "_fields"):  # namedtuple: positional ctor
+            return children, lambda vals: type(node)(*vals)
+        return children, lambda vals: type(node)(vals)
+    return None
+
+
+def map_pytree(on_leaf: Callable[[Any], Any], node: Any) -> Any:
+    """Depth-first structural map over list/tuple/namedtuple/Mapping nests,
+    calling ``on_leaf`` on everything else and rebuilding containers with
+    their original types via :func:`_container_spec`."""
+    spec = _container_spec(node)
+    if spec is None:
+        return on_leaf(node)
+    children, rebuild = spec
+    return rebuild([map_pytree(on_leaf, child) for child in children])
+
+
 def honor_type(obj, generator):
-    """Rebuild ``obj``'s container type from ``generator``
-    (reference operations.py:62-74 — preserves namedtuples)."""
-    if isinstance(obj, tuple) and hasattr(obj, "_fields"):  # namedtuple
-        return type(obj)(*list(generator))
+    """Rebuild ``obj``'s container type holding ``generator``'s values
+    (kept for the reference's public-API contract, operations.py:62):
+    namedtuples construct positionally, everything else — list/tuple/set,
+    and dicts from a generator of pairs — through its own constructor."""
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        return type(obj)(*generator)
     return type(obj)(generator)
 
 
@@ -62,37 +98,24 @@ def recursively_apply(
 ):
     """Map ``func`` over every array leaf of a nested list/tuple/dict pytree.
 
-    The engine every collective is built on (reference operations.py:85-133) —
-    same traversal semantics: containers are rebuilt with their own type,
-    non-array leaves pass through unless ``error_on_other_type``.
+    The engine every host-level collective is built on (the role of reference
+    operations.py:85): leaves matching ``test_type`` get ``func`` applied;
+    other leaves pass through untouched, or raise when
+    ``error_on_other_type`` — collectives set it so a stray non-array in a
+    gathered pytree fails loudly instead of desyncing ranks.
     """
-    if isinstance(data, (tuple, list)):
-        return honor_type(
-            data,
-            (
-                recursively_apply(
-                    func, o, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
-                )
-                for o in data
-            ),
-        )
-    if isinstance(data, Mapping):
-        return type(data)(
-            {
-                k: recursively_apply(
-                    func, v, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
-                )
-                for k, v in data.items()
-            }
-        )
-    if test_type(data):
-        return func(data, *args, **kwargs)
-    if error_on_other_type:
-        raise TypeError(
-            f"Unsupported type {type(data)} passed to {getattr(func, '__name__', func)}; only nested "
-            "list/tuple/dict of arrays are supported."
-        )
-    return data
+
+    def on_leaf(leaf):
+        if test_type(leaf):
+            return func(leaf, *args, **kwargs)
+        if error_on_other_type:
+            raise TypeError(
+                f"Unsupported type {type(leaf)} passed to {getattr(func, '__name__', func)}; only nested "
+                "list/tuple/dict of arrays are supported."
+            )
+        return leaf
+
+    return map_pytree(on_leaf, data)
 
 
 # ---------------------------------------------------------------------------

@@ -169,7 +169,7 @@ def _wholef_tiles(h: int, f: int):
 
 
 def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Optional[int] = None,
-                     block_f: int = 512, out_dtype=None, interpret=None,
+                     block_f: Optional[int] = None, out_dtype=None, interpret=None,
                      wholef: Optional[bool] = None):
     """``x @ W`` where W is an int8 :class:`QuantizedTensor` of shape [H, F].
 
@@ -184,7 +184,11 @@ def quantized_matmul(x, qt: QuantizedTensor, *, block_m: int = 128, block_k: Opt
     lead = x.shape[:-1]
     m = int(np.prod(lead)) if lead else 1
     if wholef is None:
-        wholef = m <= 8 and block_k is None and block_f == 512
+        # None = unset: an *explicitly* passed block_f/block_k pins the tiled
+        # kernel even at the default values
+        wholef = m <= 8 and block_k is None and block_f is None
+    if block_f is None:
+        block_f = 512
     if block_k is None:
         # decode (tiny m): larger K tiles amortize the per-invocation scale
         # transpose + dequant setup; at large m the 512 tile double-buffers

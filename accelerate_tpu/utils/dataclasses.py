@@ -177,10 +177,19 @@ class InitProcessGroupKwargs(KwargsHandler):
 
 @dataclass
 class ProfileKwargs(KwargsHandler):
-    """Declarative profiler config → ``jax.profiler`` trace
-    (reference ProfileKwargs :484 builds torch.profiler.profile).
+    """Declarative profiler config → a step-scheduled ``jax.profiler`` trace
+    (reference ProfileKwargs :484 builds torch.profiler.profile; engine:
+    ``utils/profiler.py``).
 
-    schedule: wait/warmup/active step counts, like torch.profiler.schedule.
+    ``wait``/``warmup``/``active`` define the per-cycle step schedule —
+    each cycle traces exactly steps ``[wait+warmup, wait+warmup+active)``
+    as counted by ``profiler.step()`` calls; ``repeat`` bounds the number
+    of cycles (0 = cycle until the block ends, each cycle under
+    ``cycle_<i>/``).  ``profile_memory`` reports device memory deltas over
+    the active window in ``profiler.summary['memory']``; ``with_flops``
+    accumulates :meth:`TPUProfiler.flops_estimate` results into
+    ``summary['flops']``.  ``on_trace_ready(trace_dir)`` fires at the end
+    of every cycle.
     """
 
     wait: int = 0

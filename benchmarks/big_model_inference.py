@@ -234,6 +234,9 @@ if __name__ == "__main__":
     if _args.ab and _args.load_in_8bit:
         p.error("--ab measures bf16-then-int8 itself; drop --load_in_8bit "
                 "(combining them would compare int8 against int8)")
+    if _args.ab and _args.over_hbm:
+        p.error("--ab has no effect with --over_hbm (the layer-streamed path "
+                "has its own quantization scheme); drop one of them")
     if _args.over_hbm:
         _args.prompt_len = _args.prompt_len or 32
         _args.new_tokens = _args.new_tokens or 4

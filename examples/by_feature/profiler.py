@@ -1,8 +1,11 @@
 """Profiling a training loop (reference examples/by_feature/profiler.py).
 
-``accelerator.profile`` wraps ``jax.profiler.trace`` — the trace directory
-gets an xplane/TensorBoard-compatible profile of every step inside the
-context (reference ProfileKwargs -> torch.profiler, SURVEY §2.9).
+``accelerator.profile`` yields a step-scheduled profiler: with
+``ProfileKwargs(wait=1, warmup=1, active=2)`` and one ``profiler.step()``
+per training step, exactly steps [2, 4) of each cycle land in the trace
+(reference ProfileKwargs -> torch.profiler schedule, SURVEY §2.9).
+``profile_memory`` reports device-memory deltas over the active window and
+``with_flops`` exposes compiled-cost FLOPs accounting.
 """
 
 import argparse
@@ -21,17 +24,35 @@ from accelerate_tpu.test_utils.training import (
 
 def main(args):
     with tempfile.TemporaryDirectory() as trace_dir:
-        acc = Accelerator(kwargs_handlers=[ProfileKwargs(output_trace_dir=trace_dir)])
+        handler = ProfileKwargs(
+            wait=1, warmup=1, active=2, repeat=1,
+            output_trace_dir=trace_dir, profile_memory=True, with_flops=True,
+        )
+        acc = Accelerator(kwargs_handlers=[handler])
         dl = acc.prepare(make_regression_loader(batch_size=16))
         state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
         step = acc.prepare_train_step(regression_loss_fn)
 
-        with acc.profile():
+        with acc.profile() as profiler:
             for batch in dl:
                 state, metrics = step(state, batch)
+                if "flops" in profiler.summary and not profiler.summary["flops"]:
+                    profiler.flops_estimate(
+                        lambda s, b: step(s, b)[1]["loss"], state, batch
+                    )
+                profiler.step()
 
+        summary = profiler.summary
+        assert summary["traced_steps"] == [2, 3], summary["traced_steps"]
+        assert "memory" in summary and "peak_bytes_in_use" in summary["memory"]
+        assert summary["flops"] > 0
         produced = list(Path(trace_dir).rglob("*"))
-        acc.print(f"profile wrote {len(produced)} artifacts to {trace_dir}")
+        acc.print(
+            f"profile traced steps {summary['traced_steps']} "
+            f"({summary['flops']:.0f} flops/step, "
+            f"peak {summary['memory']['peak_bytes_in_use']} bytes), "
+            f"{len(produced)} artifacts in {trace_dir}"
+        )
 
 
 if __name__ == "__main__":

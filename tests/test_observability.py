@@ -110,3 +110,78 @@ def test_profile_context(tmp_path):
     with acc.profile(handler):
         jax.numpy.ones(8).sum()
     assert (tmp_path / "trace").exists()
+
+
+def _windowed_profiler(monkeypatch, handler):
+    """TPUProfiler with trace start/stop spied into an event list."""
+    from accelerate_tpu.utils import profiler as prof_mod
+
+    events = []
+    monkeypatch.setattr(
+        prof_mod.jax.profiler, "start_trace",
+        lambda d, **kw: events.append(("start", d)),
+    )
+    monkeypatch.setattr(
+        prof_mod.jax.profiler, "stop_trace", lambda: events.append(("stop", None))
+    )
+    return prof_mod.TPUProfiler(handler), events
+
+
+def test_profile_schedule_exact_window(monkeypatch, tmp_path):
+    """Exactly steps [wait+warmup, wait+warmup+active) are traced."""
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    handler = ProfileKwargs(wait=2, warmup=1, active=3, repeat=1,
+                            output_trace_dir=str(tmp_path))
+    profiler, events = _windowed_profiler(monkeypatch, handler)
+    profiler._enter()
+    for _ in range(10):
+        profiler.step()
+    profiler._exit()
+    assert profiler.summary["traced_steps"] == [3, 4, 5]
+    assert events == [("start", str(tmp_path)), ("stop", None)]
+    assert profiler.summary["cycles"] == 1
+
+
+def test_profile_schedule_repeat_cycles(monkeypatch, tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    ready_dirs = []
+    handler = ProfileKwargs(wait=1, warmup=0, active=1, repeat=2,
+                            output_trace_dir=str(tmp_path),
+                            on_trace_ready=ready_dirs.append)
+    profiler, events = _windowed_profiler(monkeypatch, handler)
+    profiler._enter()
+    for _ in range(6):
+        profiler.step()
+    profiler._exit()
+    # cycle length 2: active steps are 1 and 3; repeat=2 stops after cycle 2
+    assert profiler.summary["traced_steps"] == [1, 3]
+    assert [e[0] for e in events] == ["start", "stop", "start", "stop"]
+    assert ready_dirs == [str(tmp_path / "cycle_0"), str(tmp_path / "cycle_1")]
+
+
+def test_profile_bare_block_traces_whole_region(monkeypatch, tmp_path):
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    handler = ProfileKwargs(output_trace_dir=str(tmp_path))
+    profiler, events = _windowed_profiler(monkeypatch, handler)
+    profiler._enter()
+    profiler._exit()
+    assert [e[0] for e in events] == ["start", "stop"]
+    assert profiler.summary["traced_steps"] == [0]
+
+
+def test_profile_memory_and_flops():
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+    from accelerate_tpu.utils.profiler import TPUProfiler
+
+    handler = ProfileKwargs(profile_memory=True, with_flops=True)  # no trace dir
+    profiler = TPUProfiler(handler)
+    profiler._enter()
+    flops = profiler.flops_estimate(lambda x: x @ x, np.ones((32, 32), np.float32))
+    profiler._exit()
+    assert flops > 0
+    assert profiler.summary["flops"] == flops
+    mem = profiler.summary["memory"]
+    assert {"bytes_in_use", "bytes_delta", "peak_bytes_in_use", "bytes_limit"} <= set(mem)
