@@ -855,10 +855,26 @@ class Accelerator:
             )
 
         use_fp8 = str(self.mixed_precision) == "fp8"
+        compute_width_grads = self.grad_sync_kwargs.grad_dtype is not None
+        if compute_width_grads:
+            if self.grad_sync_kwargs.grad_dtype != "bf16" or policy.needs_loss_scaling:
+                raise ValueError(
+                    "GradSyncKwargs.grad_dtype supports only 'bf16' without loss "
+                    "scaling (fp16 grads must be unscaled in fp32); got "
+                    f"grad_dtype={self.grad_sync_kwargs.grad_dtype!r} with "
+                    f"mixed_precision={self.mixed_precision!r}"
+                )
 
         def compute_grads(params, batch, rng, loss_scale):
+            if compute_width_grads:
+                # differentiate wrt the compute-width copy: every grad leaf is
+                # born bf16 and the fp32 grad tree never exists in HBM — the
+                # lever that lets a ~1B resident config keep cheap remat
+                params = policy.cast_to_compute(params)
+
             def scaled_loss(p, mb):
-                p = policy.cast_to_compute(p)
+                if not compute_width_grads:
+                    p = policy.cast_to_compute(p)
                 mb_args = (p, mb, rng) if wants_rng else (p, mb)
                 if use_fp8:
                     # trace the model under the fp8 region: QuantizableDense
@@ -878,6 +894,10 @@ class Accelerator:
             (loss, aux), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params, batch)
             if comm_dtype is not None:
                 grads = jax.tree_util.tree_map(lambda g: g.astype(comm_dtype), grads)
+            if compute_width_grads:
+                # stay compute-width; per-leaf optimizer math promotes
+                # against its fp32 state transiently
+                return loss, aux, grads
             if not kinds_ok or policy.needs_loss_scaling:
                 # fp16 loss scaling must unscale in fp32 — dividing fp16
                 # grads by ~2^16 first would flush small gradients to zero,
@@ -912,7 +932,10 @@ class Accelerator:
                 gnorm = global_norm(grads)
                 if max_grad_norm is not None:
                     clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                    grads = jax.tree_util.tree_map(lambda g: g * clip, grads)
+                    # clip in each grad's own width: a fp32 scalar would
+                    # promote a bf16 tree back to fp32 (the very tree
+                    # grad_dtype="bf16" keeps out of HBM)
+                    grads = jax.tree_util.tree_map(lambda g: g * clip.astype(g.dtype), grads)
 
             def run_update(grads, opt_state, params, finite):
                 updates, new_opt = state.tx.update(grads, opt_state, params)

@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .logging import get_logger
+from .utils.constants import SAFE_WEIGHTS_INDEX_NAME
 
 logger = get_logger(__name__)
 
@@ -374,7 +375,7 @@ def _iter_checkpoint_tensors(checkpoint_path):
     p = Path(checkpoint_path)
     files: list[Path]
     if p.is_dir():
-        index = p / "model.safetensors.index.json"
+        index = p / SAFE_WEIGHTS_INDEX_NAME
         if index.exists():
             names = sorted(set(json.loads(index.read_text())["weight_map"].values()))
             files = [p / n for n in names]

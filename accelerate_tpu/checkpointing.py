@@ -38,13 +38,21 @@ import numpy as np
 
 from .utils.imports import is_torch_available
 
-MODEL_NAME = "model"
-TRAIN_STATE_DIR = "train_state"
-RNG_STATE_NAME = "random_states_{}.pkl"
-CUSTOM_STATES_NAME = "custom_checkpoint_{}.pkl"
-SAMPLER_STATES_NAME = "sampler_states.json"
-SCHEDULER_STATES_NAME = "scheduler_states.json"
-METADATA_NAME = "accelerate_metadata.json"
+# re-exported here for compatibility; the registry is utils/constants.py
+from .utils.constants import (  # noqa: F401
+    CHECKPOINT_DIR_PATTERN,
+    CHECKPOINT_DIR_PREFIX,
+    CUSTOM_STATES_NAME,
+    METADATA_NAME,
+    MODEL_NAME,
+    RNG_STATE_NAME,
+    SAFE_WEIGHTS_INDEX_NAME,
+    SAFE_WEIGHTS_NAME,
+    SAFE_WEIGHTS_SHARD_PATTERN,
+    SAMPLER_STATES_NAME,
+    SCHEDULER_STATES_NAME,
+    TRAIN_STATE_DIR,
+)
 
 
 def _ocp():
@@ -70,14 +78,14 @@ def _auto_checkpoint_dir(accelerator, output_dir: Optional[str]):
     base.mkdir(parents=True, exist_ok=True)
     # retention GC
     existing = sorted(
-        (p for p in base.iterdir() if re.fullmatch(r"checkpoint_\d+", p.name)),
+        (p for p in base.iterdir() if re.fullmatch(CHECKPOINT_DIR_PATTERN, p.name)),
         key=lambda p: int(p.name.split("_")[1]),
     )
     if pc.total_limit is not None and len(existing) + 1 > pc.total_limit:
         for stale in existing[: len(existing) + 1 - pc.total_limit]:
             if accelerator.is_main_process:
                 shutil.rmtree(stale, ignore_errors=True)
-    out = base / f"checkpoint_{pc.iteration}"
+    out = base / f"{CHECKPOINT_DIR_PREFIX}_{pc.iteration}"
     pc.iteration += 1
     return out
 
@@ -89,7 +97,7 @@ def list_checkpoints(project_dir: str) -> list[str]:
     return [
         str(p)
         for p in sorted(
-            (p for p in base.iterdir() if re.fullmatch(r"checkpoint_\d+", p.name)),
+            (p for p in base.iterdir() if re.fullmatch(CHECKPOINT_DIR_PATTERN, p.name)),
             key=lambda p: int(p.name.split("_")[1]),
         )
     ]
@@ -354,18 +362,18 @@ def save_model(accelerator, train_state_or_params, save_directory: str,
         from .utils.serialization import save_safetensors
 
         if len(shards) == 1:
-            path = save_dir / "model.safetensors"
+            path = save_dir / SAFE_WEIGHTS_NAME
             save_safetensors(str(path), shards[0])
             written.append(str(path))
         else:
             index = {"metadata": {"total_size": sum(sizes)}, "weight_map": {}}
             for i, shard in enumerate(shards):
-                name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+                name = SAFE_WEIGHTS_SHARD_PATTERN.format(i + 1, len(shards))
                 save_safetensors(str(save_dir / name), shard)
                 for k in shard:
                     index["weight_map"][k] = name
                 written.append(str(save_dir / name))
-            (save_dir / "model.safetensors.index.json").write_text(json.dumps(index, indent=2))
+            (save_dir / SAFE_WEIGHTS_INDEX_NAME).write_text(json.dumps(index, indent=2))
     else:
         path = save_dir / "model.npz"
         np.savez(path, **flat)
@@ -379,17 +387,17 @@ def load_model_params(save_directory: str):
     """Inverse of :func:`save_model` — host numpy pytree."""
     save_dir = Path(save_directory)
     flat: dict[str, np.ndarray] = {}
-    index_file = save_dir / "model.safetensors.index.json"
+    index_file = save_dir / SAFE_WEIGHTS_INDEX_NAME
     if index_file.exists():
         from .utils.serialization import load_safetensors
 
         index = json.loads(index_file.read_text())
         for name in sorted(set(index["weight_map"].values())):
             flat.update(load_safetensors(str(save_dir / name)))
-    elif (save_dir / "model.safetensors").exists():
+    elif (save_dir / SAFE_WEIGHTS_NAME).exists():
         from .utils.serialization import load_safetensors
 
-        flat = load_safetensors(str(save_dir / "model.safetensors"))
+        flat = load_safetensors(str(save_dir / SAFE_WEIGHTS_NAME))
     elif (save_dir / "model.npz").exists():
         flat = dict(np.load(save_dir / "model.npz"))
     else:
@@ -414,7 +422,7 @@ def merge_weights(checkpoint_dir: str, output_dir: str, safe_serialization: bool
     if safe_serialization:
         from .utils.serialization import save_safetensors
 
-        path = out / "model.safetensors"
+        path = out / SAFE_WEIGHTS_NAME
         save_safetensors(str(path), arrays)
     else:
         path = out / "model.npz"

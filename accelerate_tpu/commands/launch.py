@@ -34,6 +34,7 @@ from ..utils.launch import (
 )
 
 from ..parallelism_config import AXIS_SIZE_FIELDS as _PARALLEL_FLAGS
+from ..utils.constants import MIXED_PRECISION_CHOICES, SHARDING_STRATEGY_CHOICES
 
 
 def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
@@ -58,7 +59,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
                              "crash (workers resume from their last checkpoint).")
     # execution
     parser.add_argument("--cpu", action="store_true", help="Force CPU platform (fake-mesh testing).")
-    parser.add_argument("--mixed_precision", default=None, choices=["no", "bf16", "fp16", "fp8"])
+    parser.add_argument("--mixed_precision", default=None, choices=MIXED_PRECISION_CHOICES)
     parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
     parser.add_argument("--debug", action="store_true", help="ACCELERATE_DEBUG_MODE collective shape checks.")
     parser.add_argument("--num_cpu_devices", type=int, default=None,
@@ -69,7 +70,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     # FSDP/ZeRO
     parser.add_argument("--use_fsdp", action="store_true", default=None)
     parser.add_argument("--fsdp_sharding_strategy", default=None,
-                        choices=["FULL_SHARD", "SHARD_GRAD_OP", "NO_SHARD", "HYBRID_SHARD"])
+                        choices=SHARDING_STRATEGY_CHOICES)
     parser.add_argument("--fsdp_offload_params", action="store_true", default=None)
     parser.add_argument("--fsdp_activation_checkpointing", action="store_true", default=None)
     # script

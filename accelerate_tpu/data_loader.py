@@ -485,7 +485,8 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.gradient_state = GradientState()
         self.iteration = 0
         self._loader_batch_size = _loader_batch_size
-        self._batches_yielded = 0  # stateful-dataloader resume counter
+        self._batches_yielded = 0  # intra-epoch stateful-resume position
+        self._skip_once = False    # skip_batches came from load_state_dict
 
     # -- device placement ---------------------------------------------------
 
@@ -530,6 +531,11 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.begin()
         if hasattr(self.inner, "set_epoch"):
             self.inner.set_epoch(self.iteration)
+        # intra-epoch position, skipped batches included: a state_dict taken
+        # mid-epoch must record how far into *this* pass the consumer is, not
+        # a lifetime count (a cumulative count restored as skip_batches would
+        # exceed the epoch after the first one and the loader would go silent)
+        self._batches_yielded = self.skip_batches
         prefetcher = None
         try:
             # source yields device-placed batches.  With prefetch_size >= 2
@@ -572,6 +578,12 @@ class DataLoaderShard(DataLoaderStateMixin):
             if prefetcher is not None:
                 prefetcher.close()
             self.iteration += 1
+            if self.end_of_dataloader:
+                # a completed pass consumed any restore-time skip; the next
+                # epoch starts at batch 0
+                self._batches_yielded = 0
+                if self._skip_once:
+                    self.skip_batches, self._skip_once = 0, False
             self.end()
 
     def __len__(self):
@@ -601,11 +613,29 @@ class DataLoaderShard(DataLoaderStateMixin):
     # -- stateful resume (reference DataLoaderAdapter :408-498) ------------
 
     def state_dict(self):
-        return {"batches_yielded": self._batches_yielded, "iteration": self.iteration}
+        by, it = self._batches_yielded, self.iteration
+        try:
+            full = len(self.inner)
+        except TypeError:  # length-less iterable
+            full = None
+        if full is not None and by >= full > 0:
+            # saved on the pass's last batch (mid-iteration, before the
+            # epoch-end reset ran): the position IS the next epoch's start —
+            # recording it as a full-epoch skip would silence the restored
+            # loader's first pass
+            by, it = 0, it + 1
+        return {"batches_yielded": by, "iteration": it}
 
     def load_state_dict(self, state_dict):
+        # resume-time skip applies to the next pass only (torchdata
+        # StatefulDataLoader semantics); a skip_first_batches-built wrapper
+        # keeps its persistent skip
         self.skip_batches = state_dict.get("batches_yielded", 0)
+        self._skip_once = self.skip_batches > 0
         self.iteration = state_dict.get("iteration", 0)
+        # a state_dict taken between restore and the first iteration must
+        # already report the restored position
+        self._batches_yielded = self.skip_batches
 
 
 class DataLoaderDispatcher(DataLoaderStateMixin):
@@ -635,7 +665,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self.gradient_state = GradientState()
         self.iteration = 0
         self._loader_batch_size = _loader_batch_size
-        self._batches_yielded = 0
+        self._batches_yielded = 0  # intra-epoch stateful-resume position
+        self._skip_once = False    # skip_batches came from load_state_dict
 
     def _fetch_batches(self, iterator):
         """Rank 0 reads one global batch (split mode) or num_processes batches
@@ -663,11 +694,14 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         if hasattr(self.inner, "set_epoch"):
             self.inner.set_epoch(self.iteration)
         main_iterator = iter(self.inner) if self.state.is_main_process else None
+        self._batches_yielded = self.skip_batches
         batch_idx = 0
+        completed = False
         try:
             while True:
                 batch, stop = self._fetch_batches(main_iterator)
                 if stop or batch is None:
+                    completed = True
                     break
                 whole = find_batch_size(batch)
                 slice_size = whole // self.state.num_processes
@@ -683,6 +717,10 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                 batch_idx += 1
         finally:
             self.iteration += 1
+            if completed:
+                self._batches_yielded = 0
+                if self._skip_once:
+                    self.skip_batches, self._skip_once = 0, False
             self.end()
 
     def __len__(self):
@@ -709,11 +747,22 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
             self.inner.set_epoch(epoch)
 
     def state_dict(self):
-        return {"batches_yielded": self._batches_yielded, "iteration": self.iteration}
+        by, it = self._batches_yielded, self.iteration
+        try:
+            full = len(self)  # fetch rounds per pass
+        except TypeError:
+            full = None
+        if full is not None and by >= full > 0:
+            # epoch-boundary save, see DataLoaderShard.state_dict
+            by, it = 0, it + 1
+        return {"batches_yielded": by, "iteration": it}
 
     def load_state_dict(self, state_dict):
+        # next-pass-only skip, like DataLoaderShard.load_state_dict
         self.skip_batches = state_dict.get("batches_yielded", 0)
+        self._skip_once = self.skip_batches > 0
         self.iteration = state_dict.get("iteration", 0)
+        self._batches_yielded = self.skip_batches
 
 
 # ---------------------------------------------------------------------------

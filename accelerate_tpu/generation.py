@@ -181,8 +181,14 @@ def _unrolled_view(model, params):
         if len(refs) == len(leaves) and all(r() is l for r, l in zip(refs, leaves)):
             return new_model, converted
     converted = unstack_layer_params(params)
+
+    def evict(_dead_ref, _memo=_UNROLL_MEMO):
+        # the stacked state died: drop the converted copy immediately rather
+        # than holding GBs until the next generate() call (or forever)
+        _memo.pop("entry", None)
+
     try:
-        _UNROLL_MEMO["entry"] = ([weakref.ref(l) for l in leaves], converted)
+        _UNROLL_MEMO["entry"] = ([weakref.ref(l, evict) for l in leaves], converted)
     except TypeError:  # a leaf type without weakref support: skip memoization
         _UNROLL_MEMO.pop("entry", None)
     return new_model, converted

@@ -116,6 +116,9 @@ def get_logger(name: str, log_level: str = None) -> MultiProcessAdapter:
         log_level = os.environ.get(_LEVEL_ENV)
     logger = logging.getLogger(name)
     if log_level is not None:
-        logger.setLevel(log_level.upper())
-        logger.root.setLevel(log_level.upper())
+        # accept both spellings the env var supports: a name ("info") or a
+        # numeric stdlib level ("10")
+        level = int(log_level) if str(log_level).lstrip("-").isdigit() else str(log_level).upper()
+        logger.setLevel(level)
+        logger.root.setLevel(level)
     return MultiProcessAdapter(logger)

@@ -162,6 +162,12 @@ class GradSyncKwargs(KwargsHandler):
 
     comm_dtype: Optional[str] = None  # None | "bf16" | "fp16" — grads cast before psum
     average_grads: bool = True        # mean (DDP semantics) vs sum across dp
+    # None: grads carry master (fp32) width through clip/update (torch-DDP
+    # semantics).  "bf16": differentiate wrt the compute-width param copy so
+    # the whole grad tree stays bf16 — halves grad HBM; the per-leaf optimizer
+    # math still promotes against its fp32 state (MaxText-style).  Requires
+    # mixed_precision="bf16" (fp16 needs fp32 unscaling, see prepare_train_step).
+    grad_dtype: Optional[str] = None
 
 
 @dataclass

@@ -88,7 +88,10 @@ class TPUProfiler:
         base = self._handler.output_trace_dir
         if base is None:
             return None
-        return base if self._schedule.repeat == 1 else os.path.join(base, f"cycle_{cycle}")
+        # cycle 0 writes to the configured dir itself — bare-block profiles
+        # and single-cycle schedules keep the pre-schedule layout (tooling
+        # points TensorBoard at output_trace_dir); later cycles nest
+        return base if cycle == 0 else os.path.join(base, f"cycle_{cycle}")
 
     # -- window transitions -------------------------------------------------
 

@@ -1,35 +1,106 @@
 """Gradient accumulation (reference examples/by_feature/gradient_accumulation.py).
 
+``complete_nlp_example.py`` minus every feature except accumulation:
 ``gradient_accumulation_steps=N`` with the default ``in_step`` mode splits
 each global batch into N microbatches inside the jitted step (a ``lax.scan``)
 — the pure-functional analog of ``with accelerator.accumulate(model)``.
+The drift test (tests/test_example_drift.py) keeps this file diff-minimal
+against the complete script.
 """
 
 import argparse
+import time
 
+import jax
+import jax.numpy as jnp
+import numpy as np
 import optax
 
 from accelerate_tpu import Accelerator
-from accelerate_tpu.test_utils.training import (
-    make_regression_loader,
-    regression_init_params,
-    regression_loss_fn,
-)
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification, make_bert_loss_fn
+from accelerate_tpu.utils.random import set_seed
+
+SIGNAL_TOKEN = 7
 
 
-def main(args):
-    acc = Accelerator(gradient_accumulation_steps=args.accum_steps)
-    dl = acc.prepare(make_regression_loader(batch_size=16 * args.accum_steps))
-    state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
-    step = acc.prepare_train_step(regression_loss_fn)
+def make_dataset(n: int, seq_len: int, vocab: int, seed: int):
+    """Classification toy data: label 1 iff SIGNAL_TOKEN appears (planted at
+    a few random positions so attention can find it from anywhere)."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(8, vocab, size=(n, seq_len)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    for row in np.nonzero(labels == 1)[0]:
+        pos = rng.choice(seq_len, size=3, replace=False)
+        ids[row, pos] = SIGNAL_TOKEN
+    return ids, labels
 
-    for epoch in range(2):
-        for batch in dl:
-            state, metrics = step(state, batch)
-        acc.print(f"epoch {epoch}: loss {float(metrics['loss']):.5f} (sync={acc.sync_gradients})")
+
+def make_loader(ids, labels, batch_size, shuffle, seed=0):
+    import torch
+    import torch.utils.data as tud
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return len(labels)
+
+        def __getitem__(self, i):
+            return {"input_ids": torch.from_numpy(ids[i]), "labels": int(labels[i])}
+
+    g = torch.Generator()
+    g.manual_seed(seed)
+    return tud.DataLoader(_DS(), batch_size=batch_size, shuffle=shuffle, generator=g, drop_last=True)
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+    )
+
+    cfg = BertConfig.tiny(vocab_size=128)
+    model = BertForSequenceClassification(cfg)
+
+    ids, labels = make_dataset(1024, seq_len=32, vocab=cfg.vocab_size, seed=args.seed)
+    train_dl = accelerator.prepare(
+        make_loader(ids, labels, args.batch_size * args.gradient_accumulation_steps, shuffle=True)
+    )
+
+    sample = jnp.zeros((2, 32), jnp.int32)
+    params = model.init(jax.random.key(args.seed), sample)
+    state = accelerator.create_train_state(
+        params, optax.adamw(args.lr), apply_fn=model.apply
+    )
+    train_step = accelerator.prepare_train_step(make_bert_loss_fn(model), max_grad_norm=1.0)
+
+    for epoch in range(args.num_epochs):
+        t0, n_steps = time.perf_counter(), 0
+        for batch in train_dl:
+            state, metrics = train_step(state, batch)
+            n_steps += 1
+        float(metrics["loss"])  # sync (scalar fetch — reliable on all platforms)
+        epoch_s = time.perf_counter() - t0
+        accelerator.print(
+            f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
+            f"({1e3 * epoch_s / max(n_steps, 1):.1f} ms/step"
+            f"{' incl. compile' if epoch == 0 else ''})"
+        )
+    accelerator.print(
+        f"physical batch {args.batch_size} x {args.gradient_accumulation_steps} accumulation "
+        f"(sync={accelerator.sync_gradients})"
+    )
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    training_function(parser.parse_args())
 
 
 if __name__ == "__main__":
-    parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--accum_steps", type=int, default=4)
-    main(parser.parse_args())
+    main()

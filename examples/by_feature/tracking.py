@@ -1,48 +1,122 @@
 """Experiment tracking (reference examples/by_feature/tracking.py).
 
+``complete_nlp_example.py`` minus every feature except tracking:
 ``log_with="jsonl"`` uses the built-in dependency-free tracker; swap for
 "tensorboard"/"wandb"/"mlflow"/... (tracking.py backends) when available.
+The drift test (tests/test_example_drift.py) keeps this file diff-minimal
+against the complete script.
 """
 
 import argparse
 import json
 import tempfile
+import time
 from pathlib import Path
 
+import jax
+import jax.numpy as jnp
+import numpy as np
 import optax
 
 from accelerate_tpu import Accelerator
-from accelerate_tpu.test_utils.training import (
-    make_regression_loader,
-    regression_init_params,
-    regression_loss_fn,
-)
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification, make_bert_loss_fn
+from accelerate_tpu.utils.random import set_seed
+
+SIGNAL_TOKEN = 7
 
 
-def main(args):
-    with tempfile.TemporaryDirectory() as logdir:
-        acc = Accelerator(log_with="jsonl", project_dir=logdir)
-        acc.init_trackers("tracking_example", config={"lr": 0.05})
-        dl = acc.prepare(make_regression_loader(batch_size=16))
-        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.05)))
-        step = acc.prepare_train_step(regression_loss_fn)
+def make_dataset(n: int, seq_len: int, vocab: int, seed: int):
+    """Classification toy data: label 1 iff SIGNAL_TOKEN appears (planted at
+    a few random positions so attention can find it from anywhere)."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(8, vocab, size=(n, seq_len)).astype(np.int32)
+    labels = rng.integers(0, 2, size=(n,)).astype(np.int32)
+    for row in np.nonzero(labels == 1)[0]:
+        pos = rng.choice(seq_len, size=3, replace=False)
+        ids[row, pos] = SIGNAL_TOKEN
+    return ids, labels
 
-        global_step = 0
-        for epoch in range(2):
-            for batch in dl:
-                state, metrics = step(state, batch)
-                acc.log({"loss": float(metrics["loss"])}, step=global_step)
-                global_step += 1
-        acc.end_training()
 
+def make_loader(ids, labels, batch_size, shuffle, seed=0):
+    import torch
+    import torch.utils.data as tud
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return len(labels)
+
+        def __getitem__(self, i):
+            return {"input_ids": torch.from_numpy(ids[i]), "labels": int(labels[i])}
+
+    g = torch.Generator()
+    g.manual_seed(seed)
+    return tud.DataLoader(_DS(), batch_size=batch_size, shuffle=shuffle, generator=g, drop_last=True)
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="jsonl",
+        project_dir=args.project_dir,
+    )
+    accelerator.init_trackers("tracking_example", config=vars(args))
+
+    cfg = BertConfig.tiny(vocab_size=128)
+    model = BertForSequenceClassification(cfg)
+
+    ids, labels = make_dataset(1024, seq_len=32, vocab=cfg.vocab_size, seed=args.seed)
+    train_dl = accelerator.prepare(make_loader(ids, labels, args.batch_size, shuffle=True))
+
+    sample = jnp.zeros((2, 32), jnp.int32)
+    params = model.init(jax.random.key(args.seed), sample)
+    state = accelerator.create_train_state(
+        params, optax.adamw(args.lr), apply_fn=model.apply
+    )
+    train_step = accelerator.prepare_train_step(make_bert_loss_fn(model), max_grad_norm=1.0)
+
+    for epoch in range(args.num_epochs):
+        t0, n_steps = time.perf_counter(), 0
+        for batch in train_dl:
+            state, metrics = train_step(state, batch)
+            n_steps += 1
+            accelerator.log(
+                {"loss": float(metrics["loss"])},
+                step=accelerator.step_count,
+            )
+        float(metrics["loss"])  # sync (scalar fetch — reliable on all platforms)
+        epoch_s = time.perf_counter() - t0
+        accelerator.print(
+            f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
+            f"({1e3 * epoch_s / max(n_steps, 1):.1f} ms/step"
+            f"{' incl. compile' if epoch == 0 else ''})"
+        )
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--project_dir", default=None,
+                        help="tracker logs land here (default: a temp dir)")
+    args = parser.parse_args()
+    if args.project_dir is not None:
+        training_function(args)
+        return
+    with tempfile.TemporaryDirectory() as project_dir:
+        args.project_dir = project_dir
+        training_function(args)
         records = [
             json.loads(line)
-            for f in Path(logdir).rglob("*.jsonl")
+            for f in Path(project_dir).rglob("*.jsonl")
             for line in f.read_text().splitlines()
         ]
-        acc.print(f"logged {len(records)} records; final loss {records[-1]['loss']:.5f}")
+        print(f"logged {len(records)} records; final loss {records[-1]['loss']:.5f}")
 
 
 if __name__ == "__main__":
-    parser = argparse.ArgumentParser(description=__doc__)
-    main(parser.parse_args())
+    main()

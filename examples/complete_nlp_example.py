@@ -1,14 +1,22 @@
-"""Checkpoint/resume mid-training (reference examples/by_feature/checkpointing.py).
+"""The canonical full-featured training script (reference
+examples/complete_nlp_example.py) — every feature the by_feature/ scripts
+demonstrate in isolation, composed in one place: mixed precision, gradient
+accumulation, an LR schedule, experiment tracking, step/epoch checkpointing
+with mid-epoch resume, and cross-process metric gathering.
 
-``complete_nlp_example.py`` minus every feature except checkpointing:
-``save_state``/``load_state`` with automatic checkpoint naming + retention,
-and stateful-dataloader resume (SURVEY §2.8).  The drift test
-(tests/test_example_drift.py) keeps this file diff-minimal against the
-complete script.
+The feature-example drift test (tests/test_example_drift.py) holds the
+flagship ``nlp_example.py`` and the NLP-skeleton by_feature scripts
+diff-minimal against this file, the way reference
+``tests/test_examples.py::ExampleDifferenceTests`` does.
+
+Run::
+
+    python examples/complete_nlp_example.py --with_tracking \
+        --checkpointing_steps epoch
+    accelerate-tpu launch examples/complete_nlp_example.py
 """
 
 import argparse
-import tempfile
 import time
 
 import jax
@@ -55,25 +63,42 @@ def training_function(args):
     set_seed(args.seed)
     accelerator = Accelerator(
         mixed_precision=args.mixed_precision,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        log_with="jsonl" if args.with_tracking else None,
         project_config=ProjectConfiguration(
             project_dir=args.project_dir, automatic_checkpoint_naming=True, total_limit=2
         ),
     )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_nlp_example", config=vars(args))
 
     cfg = BertConfig.tiny(vocab_size=128)
     model = BertForSequenceClassification(cfg)
 
     ids, labels = make_dataset(1024, seq_len=32, vocab=cfg.vocab_size, seed=args.seed)
-    train_dl = accelerator.prepare(make_loader(ids, labels, args.batch_size, shuffle=True))
+    eval_ids, eval_labels = make_dataset(128, seq_len=32, vocab=cfg.vocab_size, seed=args.seed + 1)
+    train_dl = accelerator.prepare(
+        make_loader(ids, labels, args.batch_size * args.gradient_accumulation_steps, shuffle=True)
+    )
+    eval_dl = accelerator.prepare(make_loader(eval_ids, eval_labels, args.batch_size, shuffle=False))
 
     steps_per_epoch = len(train_dl)
+    total_steps = steps_per_epoch * args.num_epochs
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, args.lr, warmup_steps=max(1, total_steps // 10),
+        decay_steps=total_steps,  # optax: total length INCLUDING warmup
+    )
+    scheduler = accelerator.prepare(schedule)
 
     sample = jnp.zeros((2, 32), jnp.int32)
     params = model.init(jax.random.key(args.seed), sample)
     state = accelerator.create_train_state(
-        params, optax.adamw(args.lr), apply_fn=model.apply
+        params, optax.adamw(schedule), apply_fn=model.apply
     )
     train_step = accelerator.prepare_train_step(make_bert_loss_fn(model), max_grad_norm=1.0)
+    eval_step = accelerator.prepare_eval_step(
+        lambda p, batch: jnp.argmax(model.apply(p, batch["input_ids"]), -1)
+    )
 
     start_epoch = 0
     if args.resume_from_checkpoint:
@@ -84,11 +109,18 @@ def training_function(args):
         start_epoch, resume_step = divmod(accelerator.step_count, steps_per_epoch)
         accelerator.print(f"resumed at epoch {start_epoch}, step {resume_step}")
 
+    correct = total = 0
     for epoch in range(start_epoch, args.num_epochs):
         t0, n_steps = time.perf_counter(), 0
         for batch in train_dl:
             state, metrics = train_step(state, batch)
+            scheduler.step()
             n_steps += 1
+            if args.with_tracking:
+                accelerator.log(
+                    {"loss": float(metrics["loss"]), "lr": scheduler.get_last_lr()[0]},
+                    step=accelerator.step_count,
+                )
             if args.checkpointing_steps.isdigit() and (
                 accelerator.step_count % int(args.checkpointing_steps) == 0
             ):
@@ -97,40 +129,42 @@ def training_function(args):
         epoch_s = time.perf_counter() - t0
         if args.checkpointing_steps == "epoch":
             accelerator.save_state(train_state=state)
+        correct = total = 0
+        for batch in eval_dl:
+            preds = eval_step(state.params, batch)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += len(np.asarray(refs))
+        if args.with_tracking:
+            accelerator.log({"accuracy": correct / max(total, 1)}, step=accelerator.step_count)
         accelerator.print(
             f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
+            f"accuracy {correct / max(total, 1):.3f} "
             f"({1e3 * epoch_s / max(n_steps, 1):.1f} ms/step"
             f"{' incl. compile' if epoch == start_epoch else ''})"
         )
-    return float(metrics["loss"])
+    if args.with_tracking:
+        accelerator.end_training()
+    return correct / max(total, 1)
 
 
 def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
     parser.add_argument("--lr", type=float, default=1e-3)
-    parser.add_argument("--num_epochs", type=int, default=1)
+    parser.add_argument("--num_epochs", type=int, default=3)
     parser.add_argument("--batch_size", type=int, default=32)
     parser.add_argument("--seed", type=int, default=42)
-    parser.add_argument("--checkpointing_steps", default="20",
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--checkpointing_steps", default="epoch",
                         help="save every N optimizer steps, or 'epoch', or 'never'")
     parser.add_argument("--resume_from_checkpoint", action="store_true",
                         help="restore the latest checkpoint in project_dir before training")
-    parser.add_argument("--project_dir", default=None,
-                        help="checkpoints land here (default: a temp dir, demo runs both phases)")
-    args = parser.parse_args()
-    if args.project_dir is not None:
-        training_function(args)
-        return
-    # demo mode: train with mid-epoch checkpoints, then resume from the last
-    # one and finish — the loss picks up where it left off
-    with tempfile.TemporaryDirectory() as project_dir:
-        args.project_dir = project_dir
-        first = training_function(args)
-        args.resume_from_checkpoint = True
-        args.num_epochs += 1
-        final = training_function(args)
-        print(f"resumed fine: loss {first:.4f} -> {final:.4f}")
+    parser.add_argument("--with_tracking", action="store_true",
+                        help="log loss/lr/accuracy with the built-in JSONL tracker")
+    parser.add_argument("--project_dir", default="complete_nlp_run",
+                        help="checkpoints + tracker logs land here")
+    training_function(parser.parse_args())
 
 
 if __name__ == "__main__":

@@ -70,6 +70,42 @@ def test_dp_sharded_matches_baseline():
     np.testing.assert_allclose(b_sharded, float(params["b"]), rtol=1e-5)
 
 
+def test_grad_dtype_bf16_trains_close_to_fp32():
+    """grad_dtype='bf16' (compute-width grads wrt the bf16 param copy) must
+    track the fp32-grad run: same convergence target, grads born bf16."""
+    from accelerate_tpu.utils.dataclasses import GradSyncKwargs
+
+    acc = Accelerator(mixed_precision="bf16",
+                      kwargs_handlers=[GradSyncKwargs(grad_dtype="bf16")])
+    captured = {}
+
+    def spying_loss(params, batch):
+        captured["param_dtype"] = jax.tree_util.tree_leaves(params)[0].dtype
+        return regression_loss_fn(params, batch)
+
+    dl = acc.prepare(make_regression_loader(batch_size=16))
+    state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(0.1)))
+    step = acc.prepare_train_step(spying_loss, max_grad_norm=1.0)
+    for _ in range(10):
+        for batch in dl:
+            state, metrics = step(state, batch)
+    # the loss fn saw the compute-width copy (so its grads are bf16)
+    assert captured["param_dtype"] == jnp.bfloat16
+    # masters stay fp32 and converge to the same target as the fp32-grad run
+    assert jax.tree_util.tree_leaves(state.params)[0].dtype == jnp.float32
+    assert float(state.params["a"]) == pytest.approx(2.0, abs=0.3)
+    assert float(state.params["b"]) == pytest.approx(3.0, abs=0.3)
+
+
+def test_grad_dtype_rejects_fp16_scaling():
+    from accelerate_tpu.utils.dataclasses import GradSyncKwargs
+
+    acc = Accelerator(mixed_precision="fp16",
+                      kwargs_handlers=[GradSyncKwargs(grad_dtype="bf16")])
+    with pytest.raises(ValueError, match="grad_dtype"):
+        acc.prepare_train_step(regression_loss_fn)
+
+
 def test_gradient_accumulation_in_step_parity():
     # accum over k microbatches == one big batch (SGD linearity)
     acc = Accelerator(gradient_accumulation_steps=4)

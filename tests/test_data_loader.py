@@ -239,6 +239,46 @@ def test_stateful_resume():
     np.testing.assert_allclose(np.asarray(remaining[0][0]).ravel(), [8, 9, 10, 11])
 
 
+def test_stateful_resume_epoch_position_not_lifetime():
+    """state_dict must record the intra-epoch position: after N full epochs
+    it says 0-into-the-next-epoch, and a restored loader still yields full
+    epochs (a lifetime count restored as skip would silence the loader)."""
+    dl = prepare_data_loader(_torch_loader())
+    for _ in range(2):
+        assert len(list(dl)) == 4
+    sd = dl.state_dict()
+    assert sd["batches_yielded"] == 0
+    assert sd["iteration"] == 2
+    dl2 = prepare_data_loader(_torch_loader())
+    dl2.load_state_dict(sd)
+    assert len(list(dl2)) == 4
+
+
+def test_stateful_resume_skip_applies_once():
+    """a mid-epoch restore fast-forwards the next pass only; the epoch after
+    that starts from batch 0 again."""
+    dl = prepare_data_loader(_torch_loader())
+    it = iter(dl)
+    next(it), next(it), next(it)
+    sd = dl.state_dict()
+    assert sd["batches_yielded"] == 3
+    dl2 = prepare_data_loader(_torch_loader())
+    dl2.load_state_dict(sd)
+    assert len(list(dl2)) == 1   # finishes the restored epoch
+    assert len(list(dl2)) == 4   # next epoch is complete again
+    # a state_dict taken right after restore (before iterating) still
+    # reports the restored position
+    dl3 = prepare_data_loader(_torch_loader())
+    dl3.load_state_dict(sd)
+    assert dl3.state_dict()["batches_yielded"] == 3
+    # consuming the pass's last batch rolls the recorded position to the
+    # next epoch's start — restoring THAT must not skip anything
+    it3 = iter(dl3)
+    next(it3)
+    sd3 = dl3.state_dict()
+    assert (sd3["batches_yielded"], sd3["iteration"]) == (0, 1)
+
+
 def test_dispatcher_single_process():
     dl = DataLoaderDispatcher(_torch_loader(n=8, bs=4))
     batches = [np.asarray(b[0]).ravel().tolist() for b in dl]

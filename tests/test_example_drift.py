@@ -1,0 +1,110 @@
+"""Feature examples stay diff-minimal against the canonical complete script
+(reference tests/test_examples.py::ExampleDifferenceTests, Makefile:66-67).
+
+``complete_nlp_example.py`` is the one full-featured script; the flagship
+``nlp_example.py`` and the NLP-skeleton by_feature scripts must be that
+script minus features — after stripping docstrings/comments/blank lines,
+every line of a subset script has to appear verbatim in the complete script,
+up to a small per-script allowance of genuinely feature-divergent lines
+(constructor kwargs, loop headers).  A refactor that touches one copy of the
+shared skeleton but not the others fails here.
+"""
+
+import ast
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+COMPLETE = EXAMPLES / "complete_nlp_example.py"
+
+
+def normalized_lines(path: Path, only_training_function: bool = False) -> list[str]:
+    """Source lines with docstrings, comments, blanks, and indentation gone.
+
+    With ``only_training_function`` the comparison is restricted to the
+    shared skeleton (module prelude + dataset helpers + training_function);
+    each script's ``main``/argparse/demo-driver plumbing is legitimately its
+    own.
+    """
+    src = path.read_text()
+    tree = ast.parse(src)
+    doc_lines: set[int] = set()
+    skip_spans: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if (
+                node.body
+                and isinstance(node.body[0], ast.Expr)
+                and isinstance(node.body[0].value, ast.Constant)
+                and isinstance(node.body[0].value.value, str)
+            ):
+                doc = node.body[0]
+                doc_lines.update(range(doc.lineno, doc.end_lineno + 1))
+    if only_training_function:
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef) and node.name == "main":
+                skip_spans.update(range(node.lineno, node.end_lineno + 1))
+            if isinstance(node, ast.If):  # the __main__ guard
+                skip_spans.update(range(node.lineno, node.end_lineno + 1))
+    out = []
+    for i, line in enumerate(src.splitlines(), 1):
+        if i in doc_lines or i in skip_spans:
+            continue
+        if "#" in line:
+            line = line.split("#")[0]
+        line = line.strip()
+        if line:
+            out.append(line)
+    return out
+
+
+# scripts that are "complete minus features", with the lines where they
+# legitimately diverge (the feature boundary itself): anything else missing
+# from the complete script is drift.
+SUBSET_SCRIPTS = {
+    "nlp_example.py": 8,
+    "by_feature/checkpointing.py": 6,
+    "by_feature/tracking.py": 12,
+    "by_feature/gradient_accumulation.py": 8,
+}
+
+# the complete script must keep exercising every composed feature — a line
+# dropped here means the canonical script silently lost a capability
+REQUIRED_FEATURE_LINES = [
+    "mixed_precision=args.mixed_precision,",                      # mixed precision
+    "gradient_accumulation_steps=args.gradient_accumulation_steps,",  # accumulation
+    'log_with="jsonl" if args.with_tracking else None,',          # tracking
+    "accelerator.save_state(train_state=state)",                  # checkpointing
+    "state = accelerator.load_state(train_state=state)",          # resume
+    "scheduler = accelerator.prepare(schedule)",                  # LR schedule
+    "scheduler.step()",
+    "preds, refs = accelerator.gather_for_metrics((preds, batch[\"labels\"]))",  # metrics
+    "accelerator.end_training()",
+]
+
+
+@pytest.mark.parametrize("script,allowance", sorted(SUBSET_SCRIPTS.items()))
+def test_subset_scripts_do_not_drift(script, allowance):
+    subset = normalized_lines(EXAMPLES / script, only_training_function=True)
+    complete = set(normalized_lines(COMPLETE))
+    missing = [l for l in subset if l not in complete]
+    assert len(missing) <= allowance, (
+        f"{script} drifted from complete_nlp_example.py — {len(missing)} lines "
+        f"(allowance {allowance}) not found in the complete script:\n  "
+        + "\n  ".join(missing)
+    )
+    # the shared skeleton must dominate: a rewrite that keeps under the
+    # allowance by shrinking the script is also drift
+    assert len(subset) - len(missing) >= 40, (
+        f"{script} shares only {len(subset) - len(missing)} lines with the "
+        "complete script; the common NLP skeleton has been rewritten"
+    )
+
+
+def test_complete_script_keeps_every_feature():
+    lines = set(normalized_lines(COMPLETE))
+    missing = [l for l in REQUIRED_FEATURE_LINES if l not in lines]
+    assert not missing, (
+        "complete_nlp_example.py lost feature lines:\n  " + "\n  ".join(missing)
+    )

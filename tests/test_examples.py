@@ -46,7 +46,7 @@ def test_cv_example():
         ("checkpointing.py", "resumed fine"),
         ("gradient_accumulation.py", "loss"),
         ("tracking.py", "logged"),
-        ("profiler.py", "profile wrote"),
+        ("profiler.py", "profile traced steps"),
         ("memory.py", "attempted batch sizes [128, 64, 32]"),
         ("local_sgd.py", "final loss"),
         ("pipeline_inference.py", "pipeline over 2 stage(s)"),

@@ -158,7 +158,8 @@ def test_profile_schedule_repeat_cycles(monkeypatch, tmp_path):
     # cycle length 2: active steps are 1 and 3; repeat=2 stops after cycle 2
     assert profiler.summary["traced_steps"] == [1, 3]
     assert [e[0] for e in events] == ["start", "stop", "start", "stop"]
-    assert ready_dirs == [str(tmp_path / "cycle_0"), str(tmp_path / "cycle_1")]
+    # cycle 0 keeps the configured dir (pre-schedule layout); later cycles nest
+    assert ready_dirs == [str(tmp_path), str(tmp_path / "cycle_1")]
 
 
 def test_profile_bare_block_traces_whole_region(monkeypatch, tmp_path):
