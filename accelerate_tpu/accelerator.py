@@ -103,6 +103,9 @@ if _HAS_FLAX:
         loss_scale: Optional[DynamicLossScale] = None
         grad_accum: Any = None
         accum_step: Optional[jax.Array] = None
+        # gradient-compression carry (PowerSGD warm-start Qs + per-rank
+        # error buffers); None unless GradSyncKwargs.compression is set
+        comm_state: Any = None
         apply_fn: Callable = flax.struct.field(pytree_node=False, default=None)
         tx: Any = flax.struct.field(pytree_node=False, default=None)
         # .replace(**kwargs) is provided by flax.struct.dataclass
@@ -568,6 +571,11 @@ class Accelerator:
         self._schedulers.append(wrapped)
         return wrapped
 
+    def _compression_axes(self) -> list:
+        """Mesh axes the gradient compression reduces over (the data-parallel
+        plane; every other axis must be trivial for DDP-style compression)."""
+        return [a for a in ("dp_replicate", "dp_shard") if a in self.mesh.shape]
+
     def _default_batch_spec(self):
         cfg = self.parallelism_config
         batch_axes = cfg.batch_dim_names or None
@@ -737,6 +745,20 @@ class Accelerator:
             grad_accum = jax.jit(_tree_zeros_like, out_shardings=plan)(params)
         else:
             grad_accum = _tree_zeros_like(params) if accum_needed else None
+        comm_state = None
+        if self.grad_sync_kwargs.compression == "powersgd":
+            from .parallel.powersgd import init_powersgd_state
+
+            axes = self._compression_axes()
+            dp_size = int(np.prod([self.mesh.shape[a] for a in axes])) if axes else 1
+            qs, errs = init_powersgd_state(params, self.grad_sync_kwargs.rank, dp_size)
+            if sharded:
+                # Qs replicated; each rank owns its residual slice
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                err_sh = NamedSharding(self.mesh, PartitionSpec(tuple(axes) or None))
+                qs = jax.tree_util.tree_map(lambda q: jax.device_put(q, rep), qs)
+                errs = jax.tree_util.tree_map(lambda e: jax.device_put(e, err_sh), errs)
+            comm_state = (qs, errs)
         state = TrainState(
             step=jnp.int32(0),
             params=params,
@@ -745,6 +767,7 @@ class Accelerator:
             loss_scale=loss_scale,
             grad_accum=grad_accum,
             accum_step=jnp.int32(0) if accum_needed else None,
+            comm_state=comm_state,
             apply_fn=apply_fn,
             tx=tx,
         )
@@ -1096,7 +1119,68 @@ class Accelerator:
             )
             return new_state, metrics
 
-        if mode == "in_step" and accum_steps > 1:
+        compression = self.grad_sync_kwargs.compression
+        if compression not in (None, "powersgd"):
+            raise ValueError(f"unknown GradSyncKwargs.compression {compression!r}; options: 'powersgd'")
+        if compression == "powersgd":
+            pc = self.parallelism_config
+            bad = {k: v for k, v in
+                   {"tp": pc.tp_size, "pp": pc.pp_size, "cp": pc.cp_size,
+                    "sp": pc.sp_size, "ep": pc.ep_size}.items() if v > 1}
+            if bad or offload_opt or accum_steps > 1 or policy.needs_loss_scaling or has_aux:
+                raise ValueError(
+                    "compression='powersgd' is the DDP comm-hook analog: pure "
+                    "data parallelism, no cpu_offload, accumulation of 1, no "
+                    "fp16 scaling, no aux outputs. Offending config: "
+                    f"{bad or ''}{' offload' if offload_opt else ''}"
+                    f"{' accum>1' if accum_steps > 1 else ''}"
+                    f"{' fp16' if policy.needs_loss_scaling else ''}"
+                    f"{' has_aux' if has_aux else ''}"
+                )
+            from .parallel.powersgd import compress_decompress
+
+            psgd_rank = self.grad_sync_kwargs.rank
+            axes = tuple(self._compression_axes())
+            err_spec = PartitionSpec(axes)
+            try:
+                from jax import shard_map as _shard_map
+            except ImportError:  # older jax
+                from jax.experimental.shard_map import shard_map as _shard_map
+
+            def _psgd_local(params, mb, use_rng, qs, errs):
+                def loss_only(p):
+                    p = policy.cast_to_compute(p)
+                    mb_args = (p, mb, use_rng) if wants_rng else (p, mb)
+                    return loss_fn(*mb_args).astype(jnp.float32)
+
+                loss, grads = jax.value_and_grad(loss_only)(params)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+                errs_local = jax.tree_util.tree_map(lambda e: e[0], errs)
+                g_hat, new_qs, new_errs = compress_decompress(
+                    grads, qs, errs_local, axes, psgd_rank
+                )
+                new_errs = jax.tree_util.tree_map(lambda e: e[None], new_errs)
+                return jax.lax.pmean(loss, axes), g_hat, new_qs, new_errs
+
+            def step_fn(state: TrainState, batch):
+                rng, use_rng = jax.random.split(state.rng)
+                qs, errs = state.comm_state
+                spec_of = self._default_batch_spec()
+                batch_specs = jax.tree_util.tree_map(spec_of, batch)
+                fn = _shard_map(
+                    _psgd_local, mesh=self.mesh,
+                    in_specs=(PartitionSpec(), batch_specs, PartitionSpec(),
+                              PartitionSpec(), err_spec),
+                    out_specs=(PartitionSpec(), PartitionSpec(), PartitionSpec(), err_spec),
+                    check_vma=False,
+                )
+                loss, g_hat, new_qs, new_errs = fn(state.params, batch, use_rng, qs, errs)
+                new_state, metrics = apply_update(
+                    state.replace(rng=rng, comm_state=(new_qs, new_errs)), g_hat, loss
+                )
+                return new_state, metrics
+
+        elif mode == "in_step" and accum_steps > 1:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)

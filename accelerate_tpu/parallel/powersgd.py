@@ -1,0 +1,132 @@
+"""PowerSGD low-rank gradient compression with error feedback.
+
+TPU-native analog of the reference's DDP PowerSGD communication hook
+(reference ``DDPCommunicationHookType.POWER_SGD``, utils/dataclasses.py:134,
+wired at accelerator.py:1865): instead of all-reducing the dense gradient
+over the data-parallel axis, each rank compresses its *local* gradient into
+rank-``r`` factors, all-reduces only the factors, and decompresses — with a
+per-rank error buffer feeding the compression residual back into the next
+step (Vogels et al., NeurIPS 2019).
+
+Under GSPMD the dense gradient all-reduce is implicit (XLA inserts the psum
+from shardings), so there is no hook point to intercept — the compressed
+path instead runs the loss/grad inside a ``shard_map`` over the dp axes
+where per-rank gradients exist, and the only cross-device traffic for
+eligible leaves is the two factor ``psum``s (rides ICI exactly like the
+dense psum, at ``r*(n+m)/(n*m)`` of the bytes).
+
+Eligibility: floating leaves with ndim >= 2 whose factor traffic beats the
+dense leaf (``r*(n+m) < n*m``); everything else (biases, norm scales,
+scalars) all-reduces dense.  All math in fp32; Gram–Schmidt via QR.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _matrix_view(shape) -> tuple[int, int]:
+    """[n, m] view a leaf compresses through: dim 0 stays, the rest fold."""
+    return shape[0], int(np.prod(shape[1:]))
+
+
+def eligible(leaf, rank: int) -> bool:
+    if not hasattr(leaf, "shape") or len(leaf.shape) < 2:
+        return False
+    if not jnp.issubdtype(jnp.result_type(leaf), jnp.floating):
+        return False
+    n, m = _matrix_view(leaf.shape)
+    return rank * (n + m) < n * m
+
+
+def init_powersgd_state(params, rank: int, dp_size: int, seed: int = 0):
+    """``(qs, errs)`` pytrees congruent with ``params``: a warm-start Q
+    [m, r] for eligible leaves (replicated; identical on every rank by
+    construction) and a zero error buffer [dp_size, *leaf.shape] whose
+    leading axis the caller shards over the dp axes — each rank owns its
+    own residual.  Ineligible leaves carry ``None`` in both trees."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    qs, errs = [], []
+    for i, leaf in enumerate(leaves):
+        if eligible(leaf, rank):
+            _, m = _matrix_view(leaf.shape)
+            q = jax.random.normal(jax.random.key(seed + i), (m, rank), jnp.float32)
+            qs.append(q)
+            errs.append(jnp.zeros((dp_size, *leaf.shape), jnp.float32))
+        else:
+            qs.append(None)
+            errs.append(None)
+    return (
+        jax.tree_util.tree_unflatten(treedef, qs),
+        jax.tree_util.tree_unflatten(treedef, errs),
+    )
+
+
+def _orthonormalize(p):
+    # reduced QR: the factor psum sums rank-r spans; orthonormal P keeps the
+    # projection well-conditioned across steps (plain Gram–Schmidt in the
+    # paper; QR is the batched XLA-native spelling)
+    q, _ = jnp.linalg.qr(p)
+    return q
+
+
+def compress_decompress(grads, qs, errs, axis_names, rank: int):
+    """Inside ``shard_map``: per-rank grads -> globally averaged low-rank
+    approximations.  Returns ``(grads_hat, new_qs, new_errs)``; ineligible
+    leaves are dense-``pmean``ed with ``None`` state."""
+
+    def one(g, q, e):
+        if q is None:
+            return jax.lax.pmean(g, axis_names), None, None
+        shape = g.shape
+        n, m = _matrix_view(shape)
+        mtx = g.astype(jnp.float32).reshape(n, m) + e.reshape(n, m)
+        p = jax.lax.pmean(mtx @ q, axis_names)       # [n, r]
+        p = _orthonormalize(p)
+        q_local = mtx.T @ p                          # [m, r] this rank's factor
+        new_q = jax.lax.pmean(q_local, axis_names)
+        g_hat = p @ new_q.T                          # [n, m], already averaged
+        # the residual is vs this rank's own approximation — what the factor
+        # psum lost of *our* gradient comes back next step
+        new_e = mtx - p @ q_local.T
+        return g_hat.reshape(shape).astype(g.dtype), new_q, new_e.reshape(shape)
+
+    flat_g, treedef = jax.tree_util.tree_flatten(grads)
+    flat_q = treedef.flatten_up_to(qs)
+    flat_e = treedef.flatten_up_to(errs)
+    out = [one(g, q, e) for g, q, e in zip(flat_g, flat_q, flat_e)]
+    unf = lambda xs: jax.tree_util.tree_unflatten(treedef, xs)
+    return unf([o[0] for o in out]), unf([o[1] for o in out]), unf([o[2] for o in out])
+
+
+def wire_bytes_report(params, rank: int) -> dict:
+    """Per-step all-reduce traffic accounting: dense psum vs the PowerSGD
+    factor psums (the convergence-parity test pins this, and it is the
+    number to quote when sizing DCN-bound multi-slice dp)."""
+    dense = compressed = 0
+    n_eligible = n_dense = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        if not hasattr(leaf, "shape"):
+            continue
+        size = int(np.prod(leaf.shape)) * 4
+        dense += size
+        if eligible(leaf, rank):
+            n, m = _matrix_view(leaf.shape)
+            compressed += 2 * rank * (n + m) * 4  # P psum + Q psum
+            n_eligible += 1
+        else:
+            compressed += size
+            n_dense += 1
+    return {
+        "dense_bytes_per_step": dense,
+        "compressed_bytes_per_step": compressed,
+        "ratio": compressed / max(dense, 1),
+        "eligible_leaves": n_eligible,
+        "dense_leaves": n_dense,
+        "rank": rank,
+    }

@@ -168,6 +168,12 @@ class GradSyncKwargs(KwargsHandler):
     # math still promotes against its fp32 state (MaxText-style).  Requires
     # mixed_precision="bf16" (fp16 needs fp32 unscaling, see prepare_train_step).
     grad_dtype: Optional[str] = None
+    # "powersgd": error-feedback low-rank compression of the dp-axis grad
+    # reduction (reference DDPCommunicationHookType.POWER_SGD analog; engine:
+    # parallel/powersgd.py).  ``rank`` is the factor rank — wire bytes per
+    # eligible [n, m] leaf drop from n*m to 2*rank*(n+m).
+    compression: Optional[str] = None
+    rank: int = 4
 
 
 @dataclass
