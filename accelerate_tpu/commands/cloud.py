@@ -170,12 +170,21 @@ def cloud_launch_command(args) -> None:
         else:
             print(manifest)
         if args.submit:
+            submit_cmd = ["kubectl", "apply", "-f", args.output or "-"]
+            if args.dry_run:
+                # client-side validation only: kubectl parses the manifest
+                # and prints what WOULD be created, nothing reaches the
+                # cluster — the CI-safe path the submit test asserts
+                submit_cmd.append("--dry-run=client")
+            if args.dry_run and shutil.which("kubectl") is None:
+                print(f"DRY RUN (kubectl not on PATH): {shlex.join(submit_cmd)}")
+                return
             if shutil.which("kubectl") is None:
                 raise ImportError(
                     "--submit needs kubectl on PATH (or drop --submit and "
                     "apply the printed manifest yourself)"
                 )
-            subprocess.run(["kubectl", "apply", "-f", args.output or "-"],
+            subprocess.run(submit_cmd,
                            input=None if args.output else manifest,
                            text=True, check=True)
     else:  # queued-resources
@@ -185,6 +194,11 @@ def cloud_launch_command(args) -> None:
         )
         print(shlex.join(cmd))
         if args.submit:
+            if args.dry_run:
+                # gcloud has no universal --dry-run: the contract is "print
+                # the exact submission line, touch nothing"
+                print(f"DRY RUN: {shlex.join(cmd)}")
+                return
             if shutil.which("gcloud") is None:
                 raise ImportError("--submit needs gcloud on PATH")
             subprocess.run(cmd, check=True)
@@ -216,6 +230,10 @@ def cloud_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--output", "-o", default=None, help="Write the manifest here instead of stdout.")
     parser.add_argument("--submit", action="store_true",
                         help="Apply via kubectl / gcloud (must be on PATH).")
+    parser.add_argument("--dry-run", dest="dry_run", action="store_true",
+                        help="With --submit: validate client-side (kubectl "
+                             "--dry-run=client) or print the exact gcloud "
+                             "line without executing it.")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
 

@@ -26,11 +26,17 @@ from .utils.dataclasses import LoggerType
 
 logger = get_logger(__name__)
 
-_flatten = lambda d, sep=".": {
-    f"{k}{sep}{kk}" if kk else k: vv
-    for k, v in d.items()
-    for kk, vv in (v.items() if isinstance(v, dict) else {"": v}).items()
-}
+def _flatten(d: dict, sep: str = ".", _prefix: str = "") -> dict:
+    """``{"opt": {"lr": 0.1}} -> {"opt.lr": 0.1}`` to arbitrary depth — the
+    shape hparam/metric backends want."""
+    out = {}
+    for k, v in d.items():
+        key = f"{_prefix}{sep}{k}" if _prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, sep, key))
+        else:
+            out[key] = v
+    return out
 
 
 def on_main_process(function):

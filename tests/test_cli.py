@@ -511,6 +511,60 @@ def test_cloud_launch_renders_queued_resource(capsys, monkeypatch):
     assert "ACCELERATE_MIXED_PRECISION" in out and "python train.py" in out
 
 
+def test_cloud_launch_submit_dry_run_gke(tmp_path, capsys, monkeypatch):
+    """--submit --dry-run hands kubectl a client-side validation run (or
+    prints the exact line when kubectl is absent) — nothing reaches any
+    cluster, which is what lets CI assert the submission path."""
+    for k in list(__import__("os").environ):
+        if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_")):
+            monkeypatch.delenv(k, raising=False)
+    from accelerate_tpu.commands import cloud as cloud_mod
+
+    calls = []
+    monkeypatch.setattr(cloud_mod.shutil, "which", lambda name: f"/usr/bin/{name}")
+    monkeypatch.setattr(
+        cloud_mod.subprocess, "run",
+        lambda cmd, **kw: calls.append((cmd, kw.get("input"))) or
+        __import__("types").SimpleNamespace(returncode=0),
+    )
+    out_file = tmp_path / "jobset.yaml"
+    args = cloud_mod.cloud_command_parser().parse_args([
+        "--backend", "gke", "--num_machines", "2", "--submit", "--dry-run",
+        "-o", str(out_file), "train.py",
+    ])
+    cloud_mod.cloud_launch_command(args)
+    assert len(calls) == 1
+    cmd, _stdin = calls[0]
+    assert cmd == ["kubectl", "apply", "-f", str(out_file), "--dry-run=client"]
+    assert "kind: JobSet" in out_file.read_text()
+    # without kubectl the dry run degrades to printing the exact line
+    calls.clear()
+    monkeypatch.setattr(cloud_mod.shutil, "which", lambda name: None)
+    cloud_mod.cloud_launch_command(args)
+    out = capsys.readouterr().out
+    assert "DRY RUN" in out and "--dry-run=client" in out
+    assert not calls
+
+
+def test_cloud_launch_submit_dry_run_queued(capsys, monkeypatch):
+    for k in list(__import__("os").environ):
+        if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_")):
+            monkeypatch.delenv(k, raising=False)
+    from accelerate_tpu.commands import cloud as cloud_mod
+
+    monkeypatch.setattr(
+        cloud_mod.subprocess, "run",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("must not execute")),
+    )
+    args = cloud_mod.cloud_command_parser().parse_args([
+        "--backend", "queued-resources", "--tpu_type", "v5litepod-16",
+        "--submit", "--dry-run", "train.py",
+    ])
+    cloud_mod.cloud_launch_command(args)
+    out = capsys.readouterr().out
+    assert "DRY RUN: gcloud compute tpus queued-resources create" in out
+
+
 def test_cloud_launch_rejects_non_python_script():
     from accelerate_tpu.commands.cloud import cloud_command_parser, cloud_launch_command
 
