@@ -1,0 +1,21 @@
+# Test tiers (role of reference Makefile: quality + test targets).
+#
+# `make test` is the fast iteration gate: measured ~2.5 min wall on the
+# single-core dev box with a warm /tmp compile cache (first run compiles
+# more; tests/conftest.py enables the persistent JAX compilation cache).
+# `make test-all` adds the slow tier: subprocess launcher round-trips,
+# interpret-mode Pallas kernels, model-family parity matrices (~15+ min).
+
+.PHONY: test test-all test-examples quality
+
+test:
+	python -m pytest tests/ -q -m "not slow"
+
+test-all:
+	python -m pytest tests/ -q
+
+test-examples:
+	python -m pytest tests/test_examples.py -q -m slow
+
+quality:
+	python -m pytest tests/test_example_drift.py tests/test_docs.py -q

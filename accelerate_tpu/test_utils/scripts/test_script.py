@@ -47,6 +47,14 @@ def check_collectives():
 
     objs = ops.gather_object({"rank": state.process_index})
     assert sorted(o["rank"] for o in objs) == list(range(state.num_processes))
+
+    # broadcast from BOTH ends: rank 0 and the last rank (the non-zero
+    # source rides broadcast_one_to_all(is_source=...) — one tensor's
+    # traffic, no allgather)
+    for src in (0, state.num_processes - 1):
+        val = np.full((4,), float(state.process_index), np.float32)
+        out = np.asarray(ops.broadcast(val, from_process=src))
+        np.testing.assert_allclose(out, np.full((4,), float(src), np.float32))
     state.print("collectives OK")
 
 

@@ -1,0 +1,140 @@
+"""Generate docs/api/*.md from live signatures + docstrings.
+
+Role of reference ``docs/source/package_reference/`` (~15 autodoc pages):
+a per-API reference. Autodoc'd rather than handwritten so it cannot drift —
+``tests/test_docs.py`` regenerates and diffs.
+
+Run: ``python docs/gen_api.py``
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import sys
+from pathlib import Path
+
+OUT = Path(__file__).parent / "api"
+
+# page -> (module, [names])  (None = every public callable/class in __all__
+# or module order)
+PAGES: dict[str, tuple[str, list[str] | None]] = {
+    "accelerator": ("accelerate_tpu.accelerator", ["Accelerator", "TrainState", "global_norm"]),
+    "state": ("accelerate_tpu.state", ["PartialState", "AcceleratorState", "GradientState"]),
+    "parallelism_config": ("accelerate_tpu.parallelism_config", ["ParallelismConfig"]),
+    "data_loader": ("accelerate_tpu.data_loader", [
+        "prepare_data_loader", "DataLoaderShard", "DataLoaderDispatcher",
+        "BatchSamplerShard", "IterableDatasetShard", "SeedableRandomSampler",
+        "skip_first_batches", "SkipDataLoader",
+    ]),
+    "big_modeling": ("accelerate_tpu.big_modeling", [
+        "init_empty_weights", "abstract_init", "init_params_leafwise",
+        "infer_auto_placement", "load_checkpoint_in_model",
+        "load_checkpoint_and_dispatch", "dispatch_model", "OffloadStore",
+    ]),
+    "pipeline": ("accelerate_tpu.parallel.pipeline_parallel", [
+        "prepare_pipeline", "PipelinedModel",
+    ]),
+    "checkpointing": ("accelerate_tpu.checkpointing", [
+        "save_accelerator_state", "load_accelerator_state", "save_model",
+        "load_model_params", "merge_weights",
+    ]),
+    "generation": ("accelerate_tpu.generation", [
+        "generate", "beam_search", "GenerationConfig",
+    ]),
+    "tracking": ("accelerate_tpu.tracking", [
+        "GeneralTracker", "JSONLTracker", "TensorBoardTracker", "WandBTracker",
+        "MLflowTracker", "filter_trackers",
+    ]),
+    "operations": ("accelerate_tpu.ops.operations", [
+        "gather", "gather_object", "broadcast", "broadcast_object_list",
+        "reduce", "pad_across_processes", "recursively_apply", "map_pytree",
+        "send_to_device", "concatenate",
+    ]),
+    "kernels": ("accelerate_tpu.ops.flash_attention", None),
+    "quantization": ("accelerate_tpu.utils.quantization", [
+        "QuantizationConfig", "QuantizedTensor", "quantize", "dequantize",
+        "quantize_params", "quantized_apply",
+    ]),
+    "powersgd": ("accelerate_tpu.parallel.powersgd", None),
+    "profiler": ("accelerate_tpu.utils.profiler", ["TPUProfiler"]),
+    "dataclasses": ("accelerate_tpu.utils.dataclasses", [
+        "GradSyncKwargs", "ProfileKwargs", "GradientAccumulationPlugin",
+        "FullyShardedDataParallelPlugin", "ProjectConfiguration",
+        "DataLoaderConfiguration", "InitProcessGroupKwargs",
+    ]),
+    "memory": ("accelerate_tpu.utils.memory", None),
+}
+
+
+def _doc_first_block(obj) -> str:
+    doc = inspect.getdoc(obj) or "*(undocumented)*"
+    return doc.strip()
+
+
+def _signature(obj) -> str:
+    import re
+
+    try:
+        sig = str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+    # default-value reprs carry memory addresses; scrub for reproducibility
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
+
+
+def _public_members(mod):
+    for name, obj in vars(mod).items():
+        if name.startswith("_"):
+            continue
+        if inspect.isclass(obj) or inspect.isfunction(obj):
+            if getattr(obj, "__module__", None) == mod.__name__:
+                yield name
+
+
+def render_page(page: str, module_name: str, names) -> str:
+    mod = importlib.import_module(module_name)
+    if names is None:
+        names = list(_public_members(mod))
+    lines = [f"# `{module_name}`", ""]
+    mod_doc = (mod.__doc__ or "").strip().splitlines()
+    if mod_doc:
+        lines += [mod_doc[0], ""]
+    for name in names:
+        obj = getattr(mod, name)
+        if inspect.isclass(obj):
+            lines += [f"## class `{name}{_signature(obj)}`", "", _doc_first_block(obj), ""]
+            for mname, m in sorted(vars(obj).items()):
+                if mname.startswith("_") or not (inspect.isfunction(m) or isinstance(m, property)):
+                    continue
+                target = m.fget if isinstance(m, property) else m
+                if not (target.__doc__ or "").strip():
+                    continue
+                kind = "property " if isinstance(m, property) else ""
+                sig = "" if isinstance(m, property) else _signature(target)
+                lines += [f"### {kind}`{name}.{mname}{sig}`", "", _doc_first_block(target), ""]
+        else:
+            lines += [f"## `{name}{_signature(obj)}`", "", _doc_first_block(obj), ""]
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def generate() -> dict[str, str]:
+    return {
+        page: render_page(page, module_name, names)
+        for page, (module_name, names) in PAGES.items()
+    }
+
+
+def main():
+    OUT.mkdir(exist_ok=True)
+    pages = generate()
+    index = ["# API reference", "", "Generated by `docs/gen_api.py` — do not edit by hand.", ""]
+    for page in sorted(pages):
+        (OUT / f"{page}.md").write_text(pages[page])
+        index.append(f"- [{page}]({page}.md)")
+    (OUT / "index.md").write_text("\n".join(index) + "\n")
+    print(f"wrote {len(pages) + 1} pages to {OUT}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

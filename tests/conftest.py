@@ -25,6 +25,15 @@ jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 if os.environ["JAX_PLATFORMS"] == "cpu":
     jax.config.update("jax_num_cpu_devices", 8)
 
+# The suite is compile-dominated (single-core host); the persistent cache
+# makes every run after the first skip recompiles of unchanged programs.
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/accelerate_tpu_test_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:  # pragma: no cover - older jax without the knobs
+    pass
+
 import pytest  # noqa: E402
 
 

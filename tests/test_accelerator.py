@@ -158,6 +158,7 @@ def test_fsdp_shards_params_and_opt_state(mesh8):
     assert state.params["tiny"].sharding.spec in (P(None, None), P())
 
 
+@pytest.mark.slow
 def test_cp_params_replicated_moments_joint_sharded():
     """Under cp, params consumed inside the ring shard_map stay
     cp-replicated (no per-step replicate-then-reshard churn) while the adam
@@ -427,6 +428,7 @@ def test_train_step_has_aux_simple():
     assert "aux" in metrics and np.isfinite(float(metrics["aux"]["pred_mean"]))
 
 
+@pytest.mark.slow
 def test_train_step_has_aux_with_accumulation():
     """Aux rides the microbatch scan carry: last microbatch's aux returned."""
     acc = Accelerator(gradient_accumulation_steps=4)
@@ -443,6 +445,7 @@ def test_train_step_has_aux_with_accumulation():
     assert float(metrics["aux"]["x_first"]) == 12.0
 
 
+@pytest.mark.slow
 def test_grad_accum_buffers_shard_like_params():
     """across_steps accumulation buffers must inherit FSDP shardings — an
     uncommitted/replicated grad_accum would be a full gradient copy per
@@ -463,6 +466,7 @@ def test_grad_accum_buffers_shard_like_params():
     assert state.step.sharding.spec == jax.sharding.PartitionSpec()
 
 
+@pytest.mark.slow
 def test_maybe_context_parallel_shards_buffers():
     """CP per-step buffer sharding (reference maybe_context_parallel :4076):
     yields zigzag-reordered, cp-sharded buffers; no-op without cp."""

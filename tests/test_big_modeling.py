@@ -160,6 +160,7 @@ def test_load_checkpoint_and_dispatch(tmp_path):
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_load_checkpoint_dotted_placement_and_int_target(tmp_path):
     """Placement keys use the dotted compute_module_sizes convention and may
     target a device index; both must be honored during streaming."""
@@ -190,6 +191,7 @@ def test_offload_store_bulk_flush(tmp_path):
     assert "a" in OffloadStore(tmp_path)
 
 
+@pytest.mark.slow
 def test_offloaded_apply(tmp_path):
     params = {"w": np.arange(8.0).reshape(2, 4)}  # host numpy = "offloaded"
     apply_fn = lambda p, x: x @ p["w"]
@@ -198,6 +200,7 @@ def test_offloaded_apply(tmp_path):
     np.testing.assert_allclose(np.asarray(out), np.ones((3, 2)) @ np.arange(8.0).reshape(2, 4))
 
 
+@pytest.mark.slow
 def test_dispatch_model_cpu_and_disk(tmp_path):
     params = {"a": jnp.ones((4,)), "b": jnp.ones((4,))}
     placed, store = dispatch_model(
@@ -207,6 +210,7 @@ def test_dispatch_model_cpu_and_disk(tmp_path):
     assert isinstance(placed["b"], np.memmap)
 
 
+@pytest.mark.slow
 def test_init_params_leafwise_shapes_and_placement():
     """Leaf-streamed init returns a real param tree matching the abstract
     structure, placed on the plan (r2 regression: a decorator mixup once
@@ -234,6 +238,7 @@ def test_init_params_leafwise_shapes_and_placement():
     assert logits.shape[:2] == (1, 8)
 
 
+@pytest.mark.slow
 def test_cpu_and_disk_offload_wrappers(tmp_path):
     """Reference-shaped cpu_offload/disk_offload: whole tree leaves the
     accelerator, the wrapped apply ships leaves just-in-time and computes

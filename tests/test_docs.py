@@ -1,0 +1,30 @@
+"""The generated API reference stays in sync with the live docstrings
+(role of reference docs/source/package_reference autodoc: the docs can't
+describe code that no longer exists)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_api_reference_is_current():
+    sys.path.insert(0, str(REPO / "docs"))
+    try:
+        import gen_api
+    finally:
+        sys.path.pop(0)
+    pages = gen_api.generate()
+    api_dir = REPO / "docs" / "api"
+    stale = []
+    for page, content in pages.items():
+        on_disk = api_dir / f"{page}.md"
+        if not on_disk.exists() or on_disk.read_text() != content:
+            stale.append(page)
+    assert not stale, (
+        f"docs/api pages out of date: {stale} — run `python docs/gen_api.py`"
+    )
+    on_disk_pages = {p.stem for p in api_dir.glob("*.md")} - {"index"}
+    assert on_disk_pages == set(pages), (
+        f"orphaned/missing api pages: {on_disk_pages ^ set(pages)}"
+    )

@@ -76,6 +76,7 @@ def test_dispatch_combine_roundtrip():
     np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_expert_parallel_apply_matches_local():
     """Explicit shard_map all_to_all path == unsharded local compute."""
     cfg = ParallelismConfig(dp_shard_size=2, ep_size=4)
@@ -94,6 +95,7 @@ def test_expert_parallel_apply_matches_local():
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_expert_parallel_apply_no_ep_axis():
     cfg = ParallelismConfig(dp_shard_size=8)
     mesh = cfg.build_device_mesh()

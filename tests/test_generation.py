@@ -133,6 +133,7 @@ def test_sampling_greedy_ignores_rng():
     assert int(sample_logits(logits, jax.random.PRNGKey(0), cfg)[0]) == 2
 
 
+@pytest.mark.slow
 def test_mixtral_generates():
     """MoE decode path: cache threads through the Mixtral block."""
     cfg = MixtralConfig.tiny()
@@ -154,6 +155,7 @@ def test_generate_do_sample_runs(tiny_model):
     assert out.shape == (1, 4)
 
 
+@pytest.mark.slow
 def test_t5_generate_seq2seq_greedy_matches_manual():
     """Encoder-decoder decode: scan over the fixed decoder buffer equals a
     manual grow-the-sequence greedy loop."""
@@ -179,6 +181,7 @@ def test_t5_generate_seq2seq_greedy_matches_manual():
     assert [int(x) for x in out[0]] == expect
 
 
+@pytest.mark.slow
 def test_t5_encode_only_and_cached_decode():
     """encoder_output round-trip: decode with cached states == joint call."""
     from accelerate_tpu.models.t5 import T5Config, T5ForConditionalGeneration
@@ -205,6 +208,7 @@ def test_beam_search_k1_equals_greedy(tiny_model):
     np.testing.assert_array_equal(np.asarray(beam1), np.asarray(greedy))
 
 
+@pytest.mark.slow
 def test_beam_search_score_at_least_greedy(tiny_model):
     """The best of K beams scores >= the greedy hypothesis (sum of token
     log-probs under the model)."""
@@ -261,6 +265,7 @@ def test_beam_search_length_penalty_counts_eos_step(tiny_model):
     np.testing.assert_array_equal(np.asarray(out), [[1, 3]])
 
 
+@pytest.mark.slow
 def test_beam_search_batch_and_lengths(tiny_model):
     """Beam search handles right-padded variable-length prompts per row."""
     from accelerate_tpu.generation import beam_search
@@ -275,6 +280,7 @@ def test_beam_search_batch_and_lengths(tiny_model):
     np.testing.assert_array_equal(np.asarray(out[1]), np.asarray(solo[0]))
 
 
+@pytest.mark.slow
 def test_generate_with_sharded_params():
     """Generation over TP+FSDP-sharded params produces identical tokens to
     the unsharded run (GSPMD propagates shardings through prefill + the
@@ -349,6 +355,7 @@ def test_generate_quantized_via_apply_wrapper(tiny_model):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_generate_streamed_matches_regular(tiny_model):
     """Layer-streamed decode (the over-HBM inference mode) matches the
     one-jit generate.  Token streams are compared where logits are

@@ -9,12 +9,14 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import __graft_entry__ as graft  # noqa: E402
 
 
+@pytest.mark.slow
 def test_composed_dp_tp_pp_leg():
     losses_and_cont, restore_ok = graft._composed_dp_tp_pp_leg(
         8, np.random.default_rng(0)
@@ -25,6 +27,7 @@ def test_composed_dp_tp_pp_leg():
     assert losses[2] < losses[1] < losses[0]
 
 
+@pytest.mark.slow
 def test_sharded_over_hbm_decode_leg():
     info = graft._sharded_over_hbm_decode_leg(8, np.random.default_rng(0))
     assert "tokens ok" in info

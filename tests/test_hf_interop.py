@@ -97,6 +97,7 @@ def hf_mixtral_checkpoint(tmp_path_factory):
     return hf_model, path
 
 
+@pytest.mark.slow
 def test_hf_mixtral_logits_parity(hf_mixtral_checkpoint):
     """Expert stacking pass: per-expert w1/w2/w3 land transposed in the
     stacked [E, d, f] arrays; logits match transformers' Mixtral (capacity
@@ -122,6 +123,7 @@ def test_hf_mixtral_logits_parity(hf_mixtral_checkpoint):
     np.testing.assert_allclose(ours, theirs, rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow
 def test_hf_mixtral_sharded_load(hf_mixtral_checkpoint):
     """With a mesh, the stacked expert tensors land in their PLANNED shards
     like every other weight (the stream adapter feeds the normal loader —

@@ -69,6 +69,7 @@ def test_llama_trains_under_accelerator():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_llama_tp_sharding_applied():
     cfg = LlamaConfig.tiny()
     model = LlamaForCausalLM(cfg)
@@ -113,6 +114,7 @@ def test_bert_forward_and_train():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_resnet_forward():
     cfg = ResNetConfig.tiny()
     model = ResNet(cfg)
@@ -137,6 +139,7 @@ def test_flops_per_token_positive():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_t5_forward_shapes():
     from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
 
@@ -149,6 +152,7 @@ def test_t5_forward_shapes():
     assert logits.shape == (2, 8, cfg.vocab_size)
 
 
+@pytest.mark.slow
 def test_t5_decoder_is_causal():
     """Changing a future decoder token must not change earlier logits."""
     import numpy as np
@@ -166,6 +170,7 @@ def test_t5_decoder_is_causal():
     np.testing.assert_allclose(np.asarray(base[0, :-1]), np.asarray(pert[0, :-1]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_t5_encoder_mask_blocks_attention():
     import numpy as np
 
@@ -184,6 +189,7 @@ def test_t5_encoder_mask_blocks_attention():
     np.testing.assert_allclose(np.asarray(masked), np.asarray(masked2), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_t5_training_converges_sharded():
     """Seq2seq copy task improves under dp_shard x tp sharding."""
     import optax
@@ -212,6 +218,7 @@ def test_t5_training_converges_sharded():
     assert float(metrics["loss"]) < first, (first, float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_t5_ffn_kernels_are_tensor_parallel_sharded():
     """Regression: wi_gate/wi_up must match the TP rule table so the d_model x
     d_ff FFN matrices actually shard over tp (not silently replicate)."""
@@ -231,6 +238,7 @@ def test_t5_ffn_kernels_are_tensor_parallel_sharded():
     assert mlp["wo_mlp"]["kernel"].spec[0] == "tp", mlp["wo_mlp"]["kernel"].spec
 
 
+@pytest.mark.slow
 def test_llama_remat_policy_dots_compiles():
     """remat_policy='dots' (save matmul outputs) must trace/execute like 'full'."""
     import optax
@@ -275,6 +283,7 @@ def test_fused_linear_xent_matches_logits_path():
             )
 
 
+@pytest.mark.slow
 def test_fused_linear_xent_non_divisible_vocab():
     """Vocab not divisible by num_chunks (clamped-slice regression): loss and
     grads must still match the reference exactly."""
@@ -303,6 +312,7 @@ def test_fused_linear_xent_non_divisible_vocab():
         np.testing.assert_allclose(np.asarray(g_f[1]), np.asarray(g_r[1]), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_t5_remat_matches_plain():
     """remat=True changes memory, not math: same logits and grads."""
     import numpy as np
@@ -326,6 +336,7 @@ def test_t5_remat_matches_plain():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_offload_remat_policy_degrades_and_trains(monkeypatch):
     """remat_policy="offload" (activation boundaries in pinned host memory
     on TPU) keeps param paths and numerics; on the CPU mesh it degrades to
@@ -358,6 +369,7 @@ def test_offload_remat_policy_degrades_and_trains(monkeypatch):
     np.testing.assert_allclose(float(loss_stack), float(ref), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_scan_layers_matches_unrolled():
     """scan_layers=True computes the same function as the unrolled stack:
     init the unrolled model, stack its per-layer params into the scan
@@ -399,6 +411,7 @@ def test_scan_layers_matches_unrolled():
     assert jax.tree_util.tree_structure(rt) == jax.tree_util.tree_structure(params)
 
 
+@pytest.mark.slow
 def test_scan_layers_init_and_tp_sharding():
     """Direct init in the scan layout + the sharding planner's shifted TP
     rules: the stacked q_proj kernel [L, H, H'] shards 'tp' on its LAST dim."""
@@ -432,6 +445,7 @@ def test_scan_layers_cached_decode_raises():
         model.apply(params, ids, cache=cache)
 
 
+@pytest.mark.slow
 def test_scan_block_size_matches_unrolled():
     """scan_block_size=2 (pair iterations, halved offload boundaries)
     computes the same function as the unrolled stack; converters map
@@ -472,6 +486,7 @@ def test_scan_block_size_matches_unrolled():
         LlamaConfig.tiny(num_hidden_layers=4, scan_block_size=2)
 
 
+@pytest.mark.slow
 def test_mixtral_scan_layers_parity():
     """scan_layers composes with the MoE block family (MixtralConfig
     subclasses LlamaConfig; blocks are homogeneous so the stacked scan

@@ -82,6 +82,7 @@ def test_offload_matches_resident_simple():
     )
 
 
+@pytest.mark.slow
 def test_offload_matches_resident_across_steps_accum():
     """compute_on inside the lax.cond update boundary (across_steps mode)."""
     plugin = GradientAccumulationPlugin(num_steps=3, mode="across_steps")
@@ -131,6 +132,7 @@ def test_chunked_host_update_matches_resident():
     )
 
 
+@pytest.mark.slow
 def test_chunked_host_update_with_accum_and_injected_hyperparams():
     """Chunking composes with in_step accumulation and the 7B bench's
     inject_hyperparams(lion) optimizer (traced scalars in the state tree)."""
@@ -147,6 +149,7 @@ def test_chunked_host_update_with_accum_and_injected_hyperparams():
     )
 
 
+@pytest.mark.slow
 def test_chunked_host_update_unclipped():
     """max_grad_norm=None (the 7B configuration) under chunking."""
     losses_mono, params_mono = _run(offload=True, max_grad_norm=None)
@@ -158,6 +161,7 @@ def test_chunked_host_update_unclipped():
     )
 
 
+@pytest.mark.slow
 def test_offload_with_fp16_loss_scaling():
     """The overflow-hold wheres run inside the host region; training stays
     finite and converges under dynamic loss scaling."""
@@ -175,6 +179,7 @@ def test_offload_plugin_flag_resolution():
     assert p3.cpu_offload is False
 
 
+@pytest.mark.slow
 def test_offload_with_reference_accelerate_loop(  # the reference loop shape
 ):
     """Offload works through the plain prepare()/dataloader flow too."""
@@ -196,6 +201,7 @@ def test_offload_with_reference_accelerate_loop(  # the reference loop shape
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_offload_state_checkpoint_roundtrip(tmp_path):
     """save_state/load_state round-trips an offload-configured TrainState and
     training continues (on TPU the restore also re-pins host-resident
@@ -221,6 +227,7 @@ def test_offload_state_checkpoint_roundtrip(tmp_path):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_offload_adafactor_matches_resident():
     """adafactor under the offload step == resident, on the CPU mesh (the
     compute_on region runs either way; real pinned-host placement is the

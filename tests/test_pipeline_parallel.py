@@ -55,6 +55,7 @@ def test_pipeline_matches_plain_forward(num_microbatches):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-2, rtol=2e-2)
 
 
+@pytest.mark.slow
 def test_pipeline_two_stages_with_tp():
     cfg, model, params, ids = _tiny_model(num_layers=4)
     mesh = _mesh(pp=2, tp_size=2, dp_shard_size=2)
@@ -97,6 +98,7 @@ def test_pipeline_blocks_differentiable():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=3e-2, rtol=3e-2)
 
 
+@pytest.mark.slow
 def test_pipeline_training_step_improves_loss():
     """End-to-end pipelined TRAINING: loss decreases over a few adamw steps."""
     cfg, model, params, ids = _tiny_model(num_layers=2)
