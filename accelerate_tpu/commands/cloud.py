@@ -157,11 +157,21 @@ def cloud_launch_command(args) -> None:
         # same constraint as the reference's SageMaker path (launch.py:670)
         raise ValueError("cloud-launch needs a python training script file")
 
-    if args.backend == "gke":
+    # flag > stored questionnaire answer (cloud_* in the config file, the
+    # SageMakerConfig analog) > hard default
+    backend = args.backend or getattr(config, "cloud_backend", None) or "gke"
+    tpu_type = args.tpu_type or getattr(config, "cloud_tpu_type", None) or (
+        "tpu-v5-lite-podslice" if backend == "gke" else "v5litepod-8"
+    )
+    if backend == "gke":
         manifest = render_jobset_yaml(
-            args, config, tpu_type=args.tpu_type, image=args.image,
-            name=args.name, chips_per_host=args.chips_per_host,
-            tpu_topology=args.tpu_topology,
+            args, config, tpu_type=tpu_type,
+            image=args.image or getattr(config, "cloud_image", None) or "python:3.11",
+            name=args.name,
+            chips_per_host=args.chips_per_host
+            or getattr(config, "cloud_chips_per_host", None) or _DEFAULT_CHIPS_PER_HOST,
+            tpu_topology=args.tpu_topology
+            or getattr(config, "cloud_tpu_topology", None) or "2x4",
         )
         if args.output:
             with open(args.output, "w") as f:
@@ -189,8 +199,9 @@ def cloud_launch_command(args) -> None:
                            text=True, check=True)
     else:  # queued-resources
         cmd = render_queued_resource_command(
-            args, config, tpu_type=args.tpu_type, name=args.name,
-            zone=args.zone, project=args.project,
+            args, config, tpu_type=tpu_type, name=args.name,
+            zone=args.zone or getattr(config, "cloud_zone", None),
+            project=args.project or getattr(config, "cloud_project", None),
         )
         print(shlex.join(cmd))
         if args.submit:
@@ -211,14 +222,17 @@ def cloud_command_parser(subparsers=None) -> argparse.ArgumentParser:
     else:
         parser = argparse.ArgumentParser("accelerate-tpu cloud-launch", description=description)
     parser.add_argument("--config_file", default=None)
-    parser.add_argument("--backend", choices=["gke", "queued-resources"], default="gke")
-    parser.add_argument("--tpu_type", dest="tpu_type", default="tpu-v5-lite-podslice",
-                        help="GKE accelerator type / queued-resource accelerator-type.")
-    parser.add_argument("--image", default="python:3.11",
-                        help="Container image with your training environment (gke).")
+    parser.add_argument("--backend", choices=["gke", "queued-resources"], default=None,
+                        help="default: the config file's cloud_backend, else gke")
+    parser.add_argument("--tpu_type", dest="tpu_type", default=None,
+                        help="GKE accelerator type / queued-resource accelerator-type "
+                             "(default: config cloud_tpu_type).")
+    parser.add_argument("--image", default=None,
+                        help="Container image with your training environment (gke; "
+                             "default: config cloud_image).")
     parser.add_argument("--name", default="accelerate-tpu-job")
-    parser.add_argument("--chips_per_host", type=int, default=_DEFAULT_CHIPS_PER_HOST)
-    parser.add_argument("--tpu_topology", default="2x4",
+    parser.add_argument("--chips_per_host", type=int, default=None)
+    parser.add_argument("--tpu_topology", default=None,
                         help="GKE slice topology label (e.g. 2x4, 4x4, 4x8) — must match "
                              "the node pool; see `gcloud container node-pools describe`.")
     parser.add_argument("--zone", default=None)

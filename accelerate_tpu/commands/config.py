@@ -67,6 +67,16 @@ class LaunchConfig:
     fsdp_sharding_strategy: str = "FULL_SHARD"
     fsdp_offload_params: bool = False
     fsdp_activation_checkpointing: bool = False
+    # -- managed-cloud defaults for `cloud-launch` (the reference's
+    # SageMakerConfig questionnaire analog: commands/config/sagemaker.py —
+    # stored once, every cloud submission reuses them) -------------------
+    cloud_backend: Optional[str] = None  # "gke" | "queued-resources"
+    cloud_tpu_type: Optional[str] = None
+    cloud_image: Optional[str] = None
+    cloud_tpu_topology: Optional[str] = None
+    cloud_zone: Optional[str] = None
+    cloud_project: Optional[str] = None
+    cloud_chips_per_host: Optional[int] = None
     # -- free-form env passthrough ----------------------------------------
     env: dict = field(default_factory=dict)
 
@@ -212,6 +222,24 @@ def interactive_config() -> LaunchConfig:
            "auto" if cfg.dp_shard_size == -1 else cfg.dp_shard_size,
            cfg.pp_size, cfg.cp_size, cfg.sp_size, cfg.tp_size, cfg.ep_size)
     )
+
+    # managed-cloud defaults (the reference SageMaker questionnaire analog):
+    # stored once, `cloud-launch` reuses them so submission is one command
+    if _ask("Configure managed-cloud defaults for `cloud-launch`?", False, bool):
+        cfg.cloud_backend = _ask_choice(
+            "Cloud backend", ("gke", "queued-resources"), "gke"
+        )
+        cfg.cloud_tpu_type = _ask(
+            "TPU type (GKE accelerator / queued-resource accelerator-type)?",
+            "tpu-v5-lite-podslice" if cfg.cloud_backend == "gke" else "v5litepod-8",
+        )
+        if cfg.cloud_backend == "gke":
+            cfg.cloud_image = _ask("Container image?", "python:3.11")
+            cfg.cloud_tpu_topology = _ask("Slice topology label (e.g. 2x4)?", "2x4")
+            cfg.cloud_chips_per_host = _ask_pos_int("Chips per host?", 4)
+        else:
+            cfg.cloud_zone = _ask("GCP zone?", "us-west4-a")
+            cfg.cloud_project = _ask("GCP project (empty = gcloud default)?", "") or None
     return cfg
 
 

@@ -244,6 +244,12 @@ def test_interactive_config_full_flow(monkeypatch, capsys):
         "FULL_SHARD", # strategy
         "y",          # offload
         "y",          # activation ckpt
+        "y",          # configure cloud defaults
+        "gke",        # backend
+        "",           # tpu type (default)
+        "eu.gcr.io/x/train:1",  # image
+        "4x4",        # topology
+        "4",          # chips per host
     ])
     monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
     cfg = interactive_config()
@@ -256,6 +262,9 @@ def test_interactive_config_full_flow(monkeypatch, capsys):
     assert cfg.fsdp_offload_params and cfg.fsdp_activation_checkpointing
     assert cfg.debug and cfg.num_machines == 2
     assert cfg.main_process_ip == "10.0.0.1" and cfg.main_process_port == 29500
+    assert cfg.cloud_backend == "gke" and cfg.cloud_tpu_type == "tpu-v5-lite-podslice"
+    assert cfg.cloud_image == "eu.gcr.io/x/train:1"
+    assert cfg.cloud_tpu_topology == "4x4" and cfg.cloud_chips_per_host == 4
 
     class _Args:
         num_cpu_devices = None
@@ -563,6 +572,36 @@ def test_cloud_launch_submit_dry_run_queued(capsys, monkeypatch):
     cloud_mod.cloud_launch_command(args)
     out = capsys.readouterr().out
     assert "DRY RUN: gcloud compute tpus queued-resources create" in out
+
+
+def test_cloud_launch_reads_questionnaire_defaults(tmp_path, capsys, monkeypatch):
+    """cloud_* answers stored by the config questionnaire (the reference
+    SageMakerConfig flow) become the submission defaults — flags still win."""
+    for k in list(__import__("os").environ):
+        if k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_")):
+            monkeypatch.delenv(k, raising=False)
+    from accelerate_tpu.commands import cloud as cloud_mod
+    from accelerate_tpu.commands.config import LaunchConfig
+
+    cfg = LaunchConfig(
+        cloud_backend="queued-resources", cloud_tpu_type="v5litepod-16",
+        cloud_zone="europe-west4-b", cloud_project="my-proj",
+    )
+    path = cfg.save(tmp_path / "config.yaml")
+    args = cloud_mod.cloud_command_parser().parse_args(
+        ["--config_file", str(path), "train.py"]
+    )
+    cloud_mod.cloud_launch_command(args)
+    out = capsys.readouterr().out
+    assert "queued-resources create" in out
+    assert "--accelerator-type=v5litepod-16" in out
+    assert "--zone=europe-west4-b" in out and "--project=my-proj" in out
+    # an explicit flag overrides the stored answer
+    args = cloud_mod.cloud_command_parser().parse_args(
+        ["--config_file", str(path), "--tpu_type", "v5litepod-32", "train.py"]
+    )
+    cloud_mod.cloud_launch_command(args)
+    assert "--accelerator-type=v5litepod-32" in capsys.readouterr().out
 
 
 def test_cloud_launch_rejects_non_python_script():
