@@ -40,6 +40,26 @@ def test_cv_example():
     assert "loss" in out
 
 
+def test_complete_nlp_example(tmp_path):
+    """The canonical full-featured script: every composed feature active in
+    one run (tracking, epoch checkpointing, accumulation, schedule, mixed
+    precision, gathered metrics), then a resume run from its checkpoints."""
+    out = _run(
+        EXAMPLES / "complete_nlp_example.py", "--num_epochs", "2",
+        "--with_tracking", "--checkpointing_steps", "epoch",
+        "--gradient_accumulation_steps", "2",
+        "--project_dir", str(tmp_path / "run"),
+    )
+    assert "accuracy" in out
+    resumed = _run(
+        EXAMPLES / "complete_nlp_example.py", "--num_epochs", "3",
+        "--resume_from_checkpoint", "--checkpointing_steps", "never",
+        "--gradient_accumulation_steps", "2",  # epoch accounting needs the
+        "--project_dir", str(tmp_path / "run"),  # same loader batch size
+    )
+    assert "resumed at epoch 2" in resumed and "accuracy" in resumed
+
+
 @pytest.mark.parametrize(
     "script,needle",
     [
