@@ -1,0 +1,152 @@
+"""The canonical full-featured CV training script (reference
+examples/complete_cv_example.py) — the ResNet skeleton of ``cv_example.py``
+composed with every feature: mixed precision, an LR schedule, experiment
+tracking, step/epoch checkpointing with resume, and gathered eval accuracy.
+``tests/test_example_drift.py`` holds ``cv_example.py`` diff-minimal
+against this file.
+
+Run::
+
+    python examples/complete_cv_example.py --with_tracking \
+        --checkpointing_steps epoch
+    accelerate-tpu launch examples/complete_cv_example.py
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, ProjectConfiguration
+from accelerate_tpu.models import ResNet, ResNetConfig, make_resnet_loss_fn
+from accelerate_tpu.utils.random import set_seed
+
+
+def make_loader(n, num_classes, batch_size, seed, image_size=32, shuffle=True):
+    import torch
+    import torch.utils.data as tud
+
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=(n,)).astype(np.int32)
+    shift = (labels[:, None, None, None].astype(np.float32) / num_classes) * 2 - 1
+    images = (rng.normal(0, 0.3, size=(n, image_size, image_size, 3)).astype(np.float32) + shift)
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return {"image": torch.from_numpy(images[i]), "label": int(labels[i])}
+
+    g = torch.Generator()
+    g.manual_seed(seed)
+    return tud.DataLoader(_DS(), batch_size=batch_size, shuffle=shuffle, generator=g, drop_last=True)
+
+
+def training_function(args):
+    set_seed(args.seed)
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="jsonl" if args.with_tracking else None,
+        project_config=ProjectConfiguration(
+            project_dir=args.project_dir, automatic_checkpoint_naming=True, total_limit=2
+        ),
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config=vars(args))
+
+    cfg = ResNetConfig.tiny()
+    model = ResNet(cfg)
+    loader = accelerator.prepare(make_loader(512, cfg.num_classes, args.batch_size, args.seed))
+    eval_loader = accelerator.prepare(
+        make_loader(128, cfg.num_classes, args.batch_size, args.seed + 1, shuffle=False)
+    )
+
+    steps_per_epoch = len(loader)
+    total_steps = steps_per_epoch * args.num_epochs
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, args.lr, warmup_steps=max(1, total_steps // 10),
+        decay_steps=total_steps,  # optax: total length INCLUDING warmup
+    )
+    scheduler = accelerator.prepare(schedule)
+
+    variables = model.init(jax.random.key(args.seed), jnp.zeros((1, 32, 32, 3)))
+    state = accelerator.create_train_state(dict(variables), optax.adam(schedule))
+    # loss returns (loss, new_batch_stats): has_aux threads the stats out
+    step = accelerator.prepare_train_step(make_resnet_loss_fn(model), has_aux=True)
+    eval_step = accelerator.prepare_eval_step(
+        lambda p, batch: jnp.argmax(
+            model.apply(p, batch["image"], train=False), -1
+        )
+    )
+
+    start_epoch = 0
+    if args.resume_from_checkpoint:
+        # restores the train state, step_count, RNG streams AND the prepared
+        # dataloader's intra-epoch position
+        state = accelerator.load_state(train_state=state)
+        start_epoch, resume_step = divmod(accelerator.step_count, steps_per_epoch)
+        accelerator.print(f"resumed at epoch {start_epoch}, step {resume_step}")
+
+    for epoch in range(start_epoch, args.num_epochs):
+        t0, n_steps = time.perf_counter(), 0
+        for batch in loader:
+            state, metrics = step(state, batch)
+            # fold the updated batch-norm statistics back into the state
+            state = state.replace(params={**state.params, "batch_stats": metrics["aux"]})
+            scheduler.step()
+            n_steps += 1
+            if args.with_tracking:
+                accelerator.log(
+                    {"loss": float(metrics["loss"]), "lr": scheduler.get_last_lr()[0]},
+                    step=accelerator.step_count,
+                )
+            if args.checkpointing_steps.isdigit() and (
+                accelerator.step_count % int(args.checkpointing_steps) == 0
+            ):
+                accelerator.save_state(train_state=state)
+        epoch_s = time.perf_counter() - t0
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(train_state=state)
+        correct = total = 0
+        for batch in eval_loader:
+            preds = eval_step(state.params, batch)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["label"]))
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += len(np.asarray(refs))
+        if args.with_tracking:
+            accelerator.log({"accuracy": correct / max(total, 1)}, step=accelerator.step_count)
+        accelerator.print(
+            f"epoch {epoch}: loss {float(metrics['loss']):.4f} "
+            f"accuracy {correct / max(total, 1):.3f} "
+            f"({1e3 * epoch_s / max(n_steps, 1):.1f} ms/step"
+            f"{' incl. compile' if epoch == start_epoch else ''})"
+        )
+    if args.with_tracking:
+        accelerator.end_training()
+    return correct / max(total, 1)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--num_epochs", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--checkpointing_steps", default="epoch",
+                        help="save every N optimizer steps, or 'epoch', or 'never'")
+    parser.add_argument("--resume_from_checkpoint", action="store_true",
+                        help="restore the latest checkpoint in project_dir before training")
+    parser.add_argument("--with_tracking", action="store_true",
+                        help="log loss/lr/accuracy with the built-in JSONL tracker")
+    parser.add_argument("--project_dir", default="complete_cv_run",
+                        help="checkpoints + tracker logs land here")
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

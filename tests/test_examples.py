@@ -40,6 +40,21 @@ def test_cv_example():
     assert "loss" in out
 
 
+def test_complete_cv_example(tmp_path):
+    out = _run(
+        EXAMPLES / "complete_cv_example.py", "--num_epochs", "2",
+        "--with_tracking", "--checkpointing_steps", "epoch",
+        "--project_dir", str(tmp_path / "run"),
+    )
+    assert "accuracy" in out
+    resumed = _run(
+        EXAMPLES / "complete_cv_example.py", "--num_epochs", "3",
+        "--resume_from_checkpoint", "--checkpointing_steps", "never",
+        "--project_dir", str(tmp_path / "run"),
+    )
+    assert "resumed at epoch 2" in resumed
+
+
 def test_complete_nlp_example(tmp_path):
     """The canonical full-featured script: every composed feature active in
     one run (tracking, epoch checkpointing, accumulation, schedule, mixed
