@@ -354,19 +354,45 @@ def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
 
 @verify_operation
 def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
-    """Cross-process reduce of a pytree (reference operations.py:728)."""
+    """Cross-process reduce of a pytree (reference operations.py:728).
+
+    Wired as a true all-reduce: each process contributes its slice of a
+    process-axis global array and a jitted sum produces the replicated
+    result — one reduction's traffic, not N allgathered copies landing on
+    every host (same pod-scale fix as :func:`broadcast`)."""
     state = _state()
 
     def _reduce(t):
         t = np.asarray(t)
         if state.num_processes > 1:
-            stacked = _process_allgather(t, tiled=False)
-            t = stacked.sum(axis=0)
+            t = _sum_across_processes(t)
             if reduction == "mean":
                 t = t / state.num_processes
         return t * scale
 
     return recursively_apply(_reduce, tensor, error_on_other_type=True)
+
+
+def _sum_across_processes(t: np.ndarray) -> np.ndarray:
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    n_proc = jax.process_count()
+    devices = np.array(sorted(jax.devices(), key=lambda d: d.id))
+    mesh = Mesh(devices.reshape(n_proc, -1), ("proc", "dev"))
+    global_arr = multihost_utils.host_local_array_to_global_array(
+        t[None], mesh, PartitionSpec("proc")
+    )
+    summed = jax.jit(
+        lambda x: jnp.sum(x, axis=0),
+        out_shardings=NamedSharding(mesh, PartitionSpec()),
+    )(global_arr)
+    return np.asarray(
+        multihost_utils.global_array_to_host_local_array(
+            summed, mesh, PartitionSpec()
+        )
+    )
 
 
 def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
