@@ -44,6 +44,12 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     attn_implementation: str = "native"  # native | flash | ring
+    # explicit flash kernel tiling (None = ops/flash_attention.py heuristic;
+    # the heuristic's d>=128 clamp to block_q 512 exists for REMATTED
+    # contexts hitting the Mosaic scoped-VMEM limit — remat-off configs at
+    # head_dim 128 may prefer the (1024, 1024) tile, measure per shape)
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
     remat: bool = False
     # remat granularity when remat=True: "full" recomputes everything
     # (minimum memory), "dots" saves matmul outputs (recompute only the cheap
@@ -281,7 +287,11 @@ class LlamaAttention(nn.Module):
             return dense(cfg.hidden_size, name="o_proj")(out), new_cache
 
         attn = get_attention_impl(cfg.attn_implementation)
-        out = attn(q, k, v, causal=True, segment_ids=segment_ids)
+        attn_kwargs = {}
+        if cfg.attn_implementation == "flash" and cfg.flash_block_q is not None:
+            attn_kwargs = {"block_q": cfg.flash_block_q,
+                           "block_k": cfg.flash_block_k or cfg.flash_block_q}
+        out = attn(q, k, v, causal=True, segment_ids=segment_ids, **attn_kwargs)
         out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
         return dense(cfg.hidden_size, name="o_proj")(out)
 

@@ -374,6 +374,8 @@ def main():
                     help="1b mode only: rematerialization policy (default none)")
     ap.add_argument("--ce-chunks", type=int, default=None,
                     help="fused-CE vocab chunks override")
+    ap.add_argument("--flash-block", type=int, default=None,
+                    help="override flash (block_q, block_k) with a square tile")
     ap.add_argument("--grad-dtype", choices=["bf16", "fp32"], default=None,
                     help="gradient width (default: bf16 — compute-width grads "
                          "measured +0.6 MFU at 600m and required at 1b; fp32 "
@@ -486,6 +488,11 @@ def main():
         cfg = LlamaConfig.tiny()
         batch, seq, iters = args.batch or 4, args.seq_len or 128, args.iters or 3
 
+    if args.flash_block:
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, flash_block_q=args.flash_block, flash_block_k=args.flash_block)
+        extra_report["flash_block"] = args.flash_block
     model = LlamaForCausalLM(cfg)
     n_dev = jax.device_count()
     fsdp_plugin = None
