@@ -493,6 +493,15 @@ def main():
 
         cfg = _dc.replace(cfg, flash_block_q=args.flash_block, flash_block_k=args.flash_block)
         extra_report["flash_block"] = args.flash_block
+    elif args.offload and cfg.attn_implementation == "flash":
+        # under host offload the D2H transfers XLA fuses around the flash
+        # backward push the (1024, 1024) tile ~192KB over the Mosaic
+        # scoped-VMEM stack limit (same failure class as the documented
+        # d>=128-under-remat case); the 512 tile costs ~1.5% and compiles
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, flash_block_q=512, flash_block_k=1024)
+        extra_report["flash_block"] = "512x1024 (offload clamp)"
     model = LlamaForCausalLM(cfg)
     n_dev = jax.device_count()
     fsdp_plugin = None
