@@ -49,7 +49,7 @@ def _batches(n=6, bs=16, seed=1):
 
 
 def _run(offload: bool, accum_plugin=None, mixed_precision="no", n_steps=6,
-         chunk_gib=None, tx=None, max_grad_norm=1.0):
+         chunk_gib=None, tx=None, max_grad_norm=1.0, kwargs_handlers=None):
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
     plugin = FullyShardedDataParallelPlugin(
@@ -60,6 +60,7 @@ def _run(offload: bool, accum_plugin=None, mixed_precision="no", n_steps=6,
         fsdp_plugin=plugin,
         gradient_accumulation_plugin=accum_plugin,
         mixed_precision=mixed_precision,
+        kwargs_handlers=kwargs_handlers,
     )
     tx = acc.prepare(tx if tx is not None else optax.adamw(1e-2))
     state = acc.create_train_state(_mlp_params(), tx)
@@ -91,6 +92,29 @@ def test_offload_matches_resident_across_steps_accum():
     np.testing.assert_allclose(losses_off, losses_res, rtol=1e-6)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), params_off, params_res
+    )
+
+
+def test_offload_with_bf16_grads_tracks_resident():
+    """The 7B bench recipe: cpu_offload + GradSyncKwargs(grad_dtype='bf16')
+    (grads born compute-width, host upcasts inside the update region) must
+    track the resident fp32-grad run."""
+    from accelerate_tpu.utils.dataclasses import GradSyncKwargs
+
+    losses_res, params_res = _run(offload=False, mixed_precision="bf16",
+                                  max_grad_norm=None)
+    losses_off, params_off = _run(
+        offload=True, mixed_precision="bf16", max_grad_norm=None,
+        kwargs_handlers=[GradSyncKwargs(grad_dtype="bf16")],
+    )
+    # bf16 grads differ from fp32 grads in the last bits; the trajectories
+    # must stay close, not bitwise-equal
+    np.testing.assert_allclose(losses_off, losses_res, rtol=5e-2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=0.1, atol=5e-3
+        ),
+        params_off, params_res,
     )
 
 
