@@ -697,24 +697,40 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self._batches_yielded = self.skip_batches
         batch_idx = 0
         completed = False
+
+        def _prepare_local(batch):
+            whole = find_batch_size(batch)
+            slice_size = whole // self.state.num_processes
+            start = self.state.process_index * slice_size
+            local = self.slice_fn(batch, slice(start, start + slice_size))
+            if self.mesh is not None and self.batch_spec is not None:
+                return host_local_to_global(local, self.mesh, self.batch_spec)
+            if self.device is not None:
+                return send_to_device(local, self.device)
+            return local
+
         try:
+            # one-batch lookahead, like DataLoaderShard: the NEXT batch's
+            # broadcast + H2D placement starts (device puts are async) while
+            # the consumer computes on the current one
+            current, have_current = None, False
             while True:
                 batch, stop = self._fetch_batches(main_iterator)
                 if stop or batch is None:
                     completed = True
                     break
-                whole = find_batch_size(batch)
-                slice_size = whole // self.state.num_processes
-                start = self.state.process_index * slice_size
-                local = self.slice_fn(batch, slice(start, start + slice_size))
-                if self.mesh is not None and self.batch_spec is not None:
-                    local = host_local_to_global(local, self.mesh, self.batch_spec)
-                elif self.device is not None:
-                    local = send_to_device(local, self.device)
-                if batch_idx >= self.skip_batches:
-                    self._batches_yielded += 1
-                    yield local
+                nxt = _prepare_local(batch)
+                if have_current:
+                    if batch_idx > self.skip_batches:
+                        self._batches_yielded += 1
+                        yield current
+                current, have_current = nxt, True
                 batch_idx += 1
+            if have_current:
+                self.end_of_dataloader = True
+                if batch_idx > self.skip_batches:
+                    self._batches_yielded += 1
+                    yield current
         finally:
             self.iteration += 1
             if completed:
