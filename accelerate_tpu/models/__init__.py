@@ -3,8 +3,10 @@ from .hf_interop import (
     hf_llama_key_map,
     hf_llama_tensor_map,
     hf_mixtral_key_map,
+    hf_t5_key_map,
     load_hf_llama,
     load_hf_mixtral,
+    load_hf_t5,
 )
 from .llama import (
     LlamaConfig,

@@ -195,3 +195,106 @@ def load_hf_mixtral(model, checkpoint, *, mesh=None, dtype=None, rng=None,
         dtype=dtype, strict=strict,
         key_map=hf_mixtral_key_map, tensor_map=hf_llama_tensor_map, **kwargs,
     )
+
+
+# -- T5 (encoder-decoder) ----------------------------------------------------
+# HF layout: shared embedding + per-block numbered sub-layers (layer.0 self
+# attention, layer.1 cross attention [decoder], last layer DenseReluDense);
+# the relative-attention bias table lives only in block 0 of each stack.
+_T5_RULES: list[tuple[str, str]] = [
+    (r"^shared\.weight$", r"params.shared_embedding.embedding"),
+    (r"^encoder\.block\.(\d+)\.layer\.0\.SelfAttention\.(q|k|v|o)\.weight$",
+     r"params.enc_layers_\1.self_attn.\2_proj.kernel"),
+    (r"^encoder\.block\.0\.layer\.0\.SelfAttention\.relative_attention_bias\.weight$",
+     r"params.enc_rel_bias.rel_embedding"),
+    (r"^encoder\.block\.(\d+)\.layer\.0\.layer_norm\.weight$",
+     r"params.enc_layers_\1.ln_attn.scale"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.DenseReluDense\.wi_0\.weight$",
+     r"params.enc_layers_\1.mlp.wi_gate.kernel"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.DenseReluDense\.wi_1\.weight$",
+     r"params.enc_layers_\1.mlp.wi_up.kernel"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.DenseReluDense\.wo\.weight$",
+     r"params.enc_layers_\1.mlp.wo_mlp.kernel"),
+    (r"^encoder\.block\.(\d+)\.layer\.1\.layer_norm\.weight$",
+     r"params.enc_layers_\1.ln_mlp.scale"),
+    (r"^encoder\.final_layer_norm\.weight$", r"params.enc_norm.scale"),
+    (r"^decoder\.block\.(\d+)\.layer\.0\.SelfAttention\.(q|k|v|o)\.weight$",
+     r"params.dec_layers_\1.self_attn.\2_proj.kernel"),
+    (r"^decoder\.block\.0\.layer\.0\.SelfAttention\.relative_attention_bias\.weight$",
+     r"params.dec_rel_bias.rel_embedding"),
+    (r"^decoder\.block\.(\d+)\.layer\.0\.layer_norm\.weight$",
+     r"params.dec_layers_\1.ln_self.scale"),
+    (r"^decoder\.block\.(\d+)\.layer\.1\.EncDecAttention\.(q|k|v|o)\.weight$",
+     r"params.dec_layers_\1.cross_attn.\2_proj.kernel"),
+    (r"^decoder\.block\.(\d+)\.layer\.1\.layer_norm\.weight$",
+     r"params.dec_layers_\1.ln_cross.scale"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.DenseReluDense\.wi_0\.weight$",
+     r"params.dec_layers_\1.mlp.wi_gate.kernel"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.DenseReluDense\.wi_1\.weight$",
+     r"params.dec_layers_\1.mlp.wi_up.kernel"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.DenseReluDense\.wo\.weight$",
+     r"params.dec_layers_\1.mlp.wo_mlp.kernel"),
+    (r"^decoder\.block\.(\d+)\.layer\.2\.layer_norm\.weight$",
+     r"params.dec_layers_\1.ln_mlp.scale"),
+    (r"^decoder\.final_layer_norm\.weight$", r"params.dec_norm.scale"),
+    (r"^lm_head\.weight$", r"params.lm_head.kernel"),
+]
+
+# aliases of `shared.weight` and buffers with no param here
+_T5_SKIP = re.compile(r"^(encoder|decoder)\.embed_tokens\.weight$")
+
+
+def hf_t5_key_map(name: str) -> Optional[str]:
+    """HF T5 ``state_dict`` name -> this framework's T5 param path (see
+    ``models/t5.py``; v1.1 gated-gelu MLP layout: wi_0 gate / wi_1 up)."""
+    if _T5_SKIP.match(name):
+        return None
+    if re.match(r"^(encoder|decoder)\.block\.\d+\.layer\.\d\.DenseReluDense\.wi\.weight$", name):
+        raise ValueError(
+            "This T5 checkpoint uses the original ungated relu MLP "
+            "(DenseReluDense.wi); the in-tree T5 implements the v1.1 "
+            "gated-gelu layout (wi_0/wi_1). Load a t5-v1_1-* / flan-t5-* "
+            "style export instead."
+        )
+    for pattern, template in _T5_RULES:
+        if re.match(pattern, name):
+            return re.sub(pattern, template, name)
+    return name  # unknown names surface as `unexpected`
+
+
+def load_hf_t5(model, checkpoint, *, mesh=None, dtype=None, rng=None,
+               sample_args=(), strict: bool = True, **kwargs):
+    """Stream an HF-format T5 checkpoint into the in-tree encoder-decoder
+    (names remapped, kernels transposed; the relative-attention bias tables
+    pass through — both sides store [num_buckets, num_heads]).  Tied
+    (v1.0-style) checkpoints need ``T5Config(tie_word_embeddings=True)``
+    (no ``lm_head`` param exists); untied v1.1 exports need ``False``.
+    Returns (params, offload_store)."""
+    import jax.numpy as jnp
+
+    from ..big_modeling import load_checkpoint_and_dispatch
+
+    if not sample_args:
+        sample_args = (jnp.ones((1, 8), jnp.int32), jnp.ones((1, 4), jnp.int32))
+    key_map = hf_t5_key_map
+    if getattr(model.config, "tie_word_embeddings", True):
+        # tied model: a stored lm_head.weight (some exporters keep the alias)
+        # has no param to land in
+        def key_map(name):
+            return None if name == "lm_head.weight" else hf_t5_key_map(name)
+
+    try:
+        return load_checkpoint_and_dispatch(
+            model, checkpoint, rng=rng, sample_args=sample_args, mesh=mesh,
+            dtype=dtype, strict=strict,
+            key_map=key_map, tensor_map=hf_llama_tensor_map, **kwargs,
+        )
+    except ValueError as e:
+        if "missing" in str(e) and "lm_head" in str(e):
+            raise ValueError(
+                "This T5 checkpoint stores no lm_head.weight — it ties the "
+                "head to the shared embedding (original T5). Build the model "
+                "with T5Config(tie_word_embeddings=True), or pass "
+                "strict=False to leave lm_head abstract."
+            ) from e
+        raise

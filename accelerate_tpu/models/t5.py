@@ -43,6 +43,9 @@ class T5Config:
     layer_norm_epsilon: float = 1e-6
     # per-layer jax.checkpoint, like LlamaConfig.remat (activation-
     # checkpointing analog, reference fsdp_utils.py:588)
+    # True (T5 v1.0): lm logits = rescaled decoder output @ shared embedding.
+    # False (v1.1 "t5-v1_1-*" exports): separate lm_head, no rescale.
+    tie_word_embeddings: bool = True
     remat: bool = False
     dtype: Any = jnp.bfloat16
 
@@ -229,9 +232,15 @@ class T5ForConditionalGeneration(nn.Module):
             y = dec_layer(cfg, name=f"dec_layers_{i}")(y, enc, dec_bias, attention_mask)
         y = RMSNorm(cfg.layer_norm_epsilon, cfg.dtype, name="dec_norm")(y)
 
-        # tied head with T5's rescaling
-        y = y * (cfg.d_model ** -0.5)
-        return embed.attend(y.astype(jnp.float32))
+        if cfg.tie_word_embeddings:
+            # tied head with T5's rescaling (transformers applies the
+            # d_model**-0.5 only when tied)
+            y = y * (cfg.d_model ** -0.5)
+            return embed.attend(y.astype(jnp.float32))
+        return nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="lm_head",
+        )(y).astype(jnp.float32)
 
 
 def shift_right(labels, decoder_start_token_id: int = 0, pad_token_id: int = 0):

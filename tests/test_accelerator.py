@@ -158,6 +158,39 @@ def test_fsdp_shards_params_and_opt_state(mesh8):
     assert state.params["tiny"].sharding.spec in (P(None, None), P())
 
 
+def test_hsdp_replicas_stay_bit_identical():
+    """dp_replicate x dp_shard (HSDP): after a step, devices differing only
+    in their replicate coordinate hold identical bytes — the cross-replica
+    grad psum is what this pins (the dryrun_multichip HSDP leg, as a unit)."""
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_replicate_size=2, dp_shard_size=4),
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=0),
+    )
+    params = {"w": jnp.ones((64, 8)) * 0.1, "b": jnp.zeros((8,))}
+    state = acc.create_train_state(params, acc.prepare(optax.sgd(0.1)))
+
+    def loss(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] @ p["b"][:, None] - batch["y"]) ** 2)
+
+    step = acc.prepare_train_step(loss)
+    rng = np.random.default_rng(0)
+    batch = {"x": jnp.asarray(rng.normal(size=(16, 64)), jnp.float32),
+             "y": jnp.asarray(rng.normal(size=(16, 1)), jnp.float32)}
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    coord_of = {d: tuple(i) for i, d in np.ndenumerate(acc.mesh.devices)}
+    rep_axis = acc.mesh.axis_names.index("dp_replicate")
+    by_pos = {}
+    for shard in state.params["w"].addressable_shards:
+        c = list(coord_of[shard.device])
+        c[rep_axis] = -1
+        by_pos.setdefault(tuple(c), []).append(np.asarray(shard.data))
+    assert any(len(v) > 1 for v in by_pos.values())  # replicas actually exist
+    for datas in by_pos.values():
+        for other in datas[1:]:
+            np.testing.assert_array_equal(datas[0], other)
+
+
 @pytest.mark.slow
 def test_cp_params_replicated_moments_joint_sharded():
     """Under cp, params consumed inside the ring shard_map stay

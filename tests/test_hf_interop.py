@@ -65,6 +65,74 @@ def test_hf_llama_logits_parity(hf_checkpoint):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
+@pytest.fixture(scope="module")
+def hf_t5_checkpoint(tmp_path_factory):
+    """A tiny random HF T5 (v1.1 layout: gated-gelu, untied head) and its
+    safetensors checkpoint on disk."""
+    hf_cfg = transformers.T5Config(
+        vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=32,
+        feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+        decoder_start_token_id=0,
+    )
+    torch.manual_seed(1)
+    hf_model = transformers.T5ForConditionalGeneration(hf_cfg).eval()
+    path = tmp_path_factory.mktemp("hf_t5_ckpt") / "model.safetensors"
+    sd = {
+        k: v.contiguous()
+        for k, v in hf_model.state_dict().items()
+        # real T5 exports store `shared.weight` once, not its two aliases
+        if not k.endswith("embed_tokens.weight")
+    }
+    safetensors_torch.save_file(sd, str(path))
+    return hf_model, path
+
+
+def test_hf_t5_key_map_covers_names(hf_t5_checkpoint):
+    from accelerate_tpu.models.hf_interop import hf_t5_key_map
+
+    hf_model, _ = hf_t5_checkpoint
+    for name in hf_model.state_dict():
+        mapped = hf_t5_key_map(name)
+        assert mapped is None or mapped.startswith("params."), (name, mapped)
+
+
+def test_hf_t5_logits_parity(hf_t5_checkpoint):
+    """Golden parity vs transformers.T5ForConditionalGeneration: encoder,
+    decoder, cross attention, relative-position bias, untied head."""
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+    from accelerate_tpu.models.hf_interop import load_hf_t5
+
+    hf_model, path = hf_t5_checkpoint
+    cfg = T5Config(
+        vocab_size=256, d_model=64, d_kv=16, d_ff=128, num_layers=2,
+        num_decoder_layers=2, num_heads=4, relative_attention_num_buckets=32,
+        tie_word_embeddings=False, dtype=jnp.float32,
+    )
+    model = T5ForConditionalGeneration(cfg)
+    params, _ = load_hf_t5(model, path, dtype=jnp.float32)
+
+    rng = np.random.default_rng(1)
+    enc_ids = rng.integers(0, 256, (2, 10))
+    dec_ids = rng.integers(0, 256, (2, 6))
+    ours = np.asarray(
+        model.apply(params, jnp.asarray(enc_ids, jnp.int32), jnp.asarray(dec_ids, jnp.int32))
+    )
+    with torch.no_grad():
+        theirs = hf_model(
+            input_ids=torch.from_numpy(enc_ids),
+            decoder_input_ids=torch.from_numpy(dec_ids),
+        ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_t5_ungated_checkpoint_targeted_error():
+    from accelerate_tpu.models.hf_interop import hf_t5_key_map
+
+    with pytest.raises(ValueError, match="ungated"):
+        hf_t5_key_map("encoder.block.0.layer.1.DenseReluDense.wi.weight")
+
+
 def test_tensor_map_transposes_kernels_only():
     a = np.arange(6, dtype=np.float32).reshape(2, 3)
     assert hf_llama_tensor_map("params/x/kernel", a).shape == (3, 2)
