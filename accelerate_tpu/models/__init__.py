@@ -1,9 +1,11 @@
 from .bert import BertConfig, BertForSequenceClassification, make_bert_loss_fn
 from .hf_interop import (
+    hf_bert_key_map,
     hf_llama_key_map,
     hf_llama_tensor_map,
     hf_mixtral_key_map,
     hf_t5_key_map,
+    load_hf_bert,
     load_hf_llama,
     load_hf_mixtral,
     load_hf_t5,

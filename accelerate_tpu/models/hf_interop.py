@@ -197,6 +197,87 @@ def load_hf_mixtral(model, checkpoint, *, mesh=None, dtype=None, rng=None,
     )
 
 
+# -- BERT (encoder classifier) -----------------------------------------------
+_BERT_RULES: list[tuple[str, str]] = [
+    (r"^bert\.embeddings\.word_embeddings\.weight$", r"params.word_embeddings.embedding"),
+    (r"^bert\.embeddings\.position_embeddings\.weight$", r"params.position_embeddings.embedding"),
+    (r"^bert\.embeddings\.LayerNorm\.weight$", r"params.embeddings_norm.scale"),
+    (r"^bert\.embeddings\.LayerNorm\.bias$", r"params.embeddings_norm.bias"),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.self\.(query|key|value)\.(weight|bias)$",
+     r"params.layer_\1.attention.\2.\3"),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.dense\.(weight|bias)$",
+     r"params.layer_\1.attention.dense.\2"),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.weight$",
+     r"params.layer_\1.attention_norm.scale"),
+    (r"^bert\.encoder\.layer\.(\d+)\.attention\.output\.LayerNorm\.bias$",
+     r"params.layer_\1.attention_norm.bias"),
+    (r"^bert\.encoder\.layer\.(\d+)\.intermediate\.dense\.(weight|bias)$",
+     r"params.layer_\1.intermediate.\2"),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.dense\.(weight|bias)$",
+     r"params.layer_\1.output.\2"),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.LayerNorm\.weight$",
+     r"params.layer_\1.output_norm.scale"),
+    (r"^bert\.encoder\.layer\.(\d+)\.output\.LayerNorm\.bias$",
+     r"params.layer_\1.output_norm.bias"),
+    (r"^bert\.pooler\.dense\.(weight|bias)$", r"params.pooler.\1"),
+    (r"^classifier\.(weight|bias)$", r"params.classifier.\1"),
+]
+
+
+def hf_bert_key_map(name: str) -> Optional[str]:
+    """HF BERT ``state_dict`` name -> this framework's param path.  torch
+    ``.weight`` on Dense layers becomes ``.kernel`` via the shared tensor
+    map; embeddings/norms keep their names."""
+    for pattern, template in _BERT_RULES:
+        if re.match(pattern, name):
+            out = re.sub(pattern, template, name)
+            # norms map to .scale and embeddings to .embedding explicitly in
+            # the rules, so any remaining .weight IS a Dense kernel
+            if out.endswith(".weight"):
+                out = out[: -len(".weight")] + ".kernel"
+            return out
+    return name
+
+
+def _fold_bert_token_types(checkpoint):
+    """This framework's BERT has no token-type embedding (single-segment
+    inputs); transformers adds ``token_type_embeddings[0]`` to every
+    position, which folds exactly into the position-embedding table."""
+    from ..big_modeling import _iter_checkpoint_tensors
+
+    pos, typ, pos_name = None, None, None
+    for name, tensor in _iter_checkpoint_tensors(checkpoint):
+        if name == "bert.embeddings.position_embeddings.weight":
+            pos, pos_name = np.asarray(tensor), name
+        elif name == "bert.embeddings.token_type_embeddings.weight":
+            typ = np.asarray(tensor)
+        else:
+            yield name, tensor
+        if pos is not None and typ is not None:
+            yield pos_name, pos + typ[0][None, :]
+            pos, typ = None, None
+    if pos is not None:  # checkpoint without token types: pass through
+        yield pos_name, pos
+
+
+def load_hf_bert(model, checkpoint, *, mesh=None, dtype=None, rng=None,
+                 sample_args=(), strict: bool = True, **kwargs):
+    """Stream an HF-format BERT sequence-classification checkpoint into the
+    in-tree model (token-type embeddings folded into positions — inputs are
+    single-segment).  Returns (params, offload_store)."""
+    import jax.numpy as jnp
+
+    from ..big_modeling import load_checkpoint_and_dispatch
+
+    if not sample_args:
+        sample_args = (jnp.ones((1, 8), jnp.int32), jnp.ones((1, 8), jnp.int32))
+    return load_checkpoint_and_dispatch(
+        model, _fold_bert_token_types(checkpoint), rng=rng,
+        sample_args=sample_args, mesh=mesh, dtype=dtype, strict=strict,
+        key_map=hf_bert_key_map, tensor_map=hf_llama_tensor_map, **kwargs,
+    )
+
+
 # -- T5 (encoder-decoder) ----------------------------------------------------
 # HF layout: shared embedding + per-block numbered sub-layers (layer.0 self
 # attention, layer.1 cross attention [decoder], last layer DenseReluDense);

@@ -133,6 +133,51 @@ def test_hf_t5_ungated_checkpoint_targeted_error():
         hf_t5_key_map("encoder.block.0.layer.1.DenseReluDense.wi.weight")
 
 
+@pytest.fixture(scope="module")
+def hf_bert_checkpoint(tmp_path_factory):
+    """A tiny random HF BERT sequence classifier and its checkpoint."""
+    hf_cfg = transformers.BertConfig(
+        vocab_size=512, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=128, type_vocab_size=2, num_labels=2,
+        hidden_act="gelu", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(2)
+    hf_model = transformers.BertForSequenceClassification(hf_cfg).eval()
+    path = tmp_path_factory.mktemp("hf_bert_ckpt") / "model.safetensors"
+    safetensors_torch.save_file(
+        {k: v.contiguous() for k, v in hf_model.state_dict().items()}, str(path)
+    )
+    return hf_model, path
+
+
+def test_hf_bert_logits_parity(hf_bert_checkpoint):
+    """Golden parity vs transformers.BertForSequenceClassification —
+    including the token-type-embedding fold into positions."""
+    from accelerate_tpu.models import BertConfig, BertForSequenceClassification
+    from accelerate_tpu.models.hf_interop import load_hf_bert
+
+    hf_model, path = hf_bert_checkpoint
+    cfg = BertConfig(
+        vocab_size=512, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=128, num_labels=2, dtype=jnp.float32,
+    )
+    model = BertForSequenceClassification(cfg)
+    params, _ = load_hf_bert(model, path, dtype=jnp.float32)
+
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 512, (2, 12))
+    mask = np.ones_like(ids)
+    ours = np.asarray(model.apply(params, jnp.asarray(ids, jnp.int32), jnp.asarray(mask, jnp.int32)))
+    with torch.no_grad():
+        theirs = hf_model(
+            input_ids=torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)
+        ).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
 def test_tensor_map_transposes_kernels_only():
     a = np.arange(6, dtype=np.float32).reshape(2, 3)
     assert hf_llama_tensor_map("params/x/kernel", a).shape == (3, 2)
