@@ -103,17 +103,20 @@ def test_device_memory_stats():
 
 
 class _Recorder:
-    """Attribute sink: every method call lands in .calls as (name, args, kwargs)."""
+    """Attribute sink: every call lands in the shared list as
+    (dotted.name, args, kwargs); attribute access nests, so both
+    ``run.log(...)`` and ``run.config.update(...)`` record."""
 
     def __init__(self, calls, prefix=""):
-        self._calls, self._prefix = calls, prefix
+        self._calls, self._prefix = calls, prefix.rstrip(".")
 
     def __getattr__(self, name):
-        def method(*args, **kwargs):
-            self._calls.append((self._prefix + name, args, kwargs))
-            return self
+        dot = "." if self._prefix else ""
+        return _Recorder(self._calls, f"{self._prefix}{dot}{name}")
 
-        return method
+    def __call__(self, *args, **kwargs):
+        self._calls.append((self._prefix, args, kwargs))
+        return _Recorder(self._calls, self._prefix + "()")
 
     def __setitem__(self, key, value):
         self._calls.append(("__setitem__", (key, value), {}))
@@ -187,6 +190,125 @@ def test_comet_tracker_contract(monkeypatch):
     assert ("exp.set_step", (2,), {}) in calls
     assert ("exp.log_metrics", ({"loss": 0.5},), {"step": 2}) in calls
     assert calls[-1][0] == "exp.end"
+
+
+def test_aim_tracker_contract(monkeypatch, tmp_path):
+    import sys
+    import types
+
+    calls = []
+    fake = types.ModuleType("aim")
+    fake.Run = lambda repo=None, experiment=None, **kw: calls.append(
+        ("Run", repo, experiment)
+    ) or _Recorder(calls, "run.")
+    monkeypatch.setitem(sys.modules, "aim", fake)
+    from accelerate_tpu.tracking import AimTracker
+
+    t = AimTracker("exp", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 0.1})
+    t.log({"loss": 1.0}, step=4)
+    t.finish()
+    assert calls[0] == ("Run", str(tmp_path), "exp")
+    assert ("__setitem__", ("hparams", {"lr": 0.1}), {}) in calls
+    assert ("run.track", (1.0,), {"name": "loss", "step": 4}) in calls
+    assert calls[-1][0] == "run.close"
+
+
+def test_clearml_tracker_contract(monkeypatch):
+    import sys
+    import types
+
+    calls = []
+    fake = types.ModuleType("clearml")
+
+    class _Task:
+        @staticmethod
+        def init(project_name=None, **kw):
+            calls.append(("Task.init", project_name))
+            return _Recorder(calls, "task.")
+
+    fake.Task = _Task
+    monkeypatch.setitem(sys.modules, "clearml", fake)
+    from accelerate_tpu.tracking import ClearMLTracker
+
+    t = ClearMLTracker("proj")
+    t.store_init_configuration({"lr": 0.1})
+    t.log({"train/loss": 2.0}, step=5)
+    t.finish()
+    assert calls[0] == ("Task.init", "proj")
+    assert ("task.connect_configuration", ({"lr": 0.1},), {}) in calls
+    # the logger object comes from task.get_logger(); report_scalar splits
+    # "train/loss" into title/series
+    assert ("task.get_logger().report_scalar", (), {
+        "title": "train", "series": "loss", "value": 2.0, "iteration": 5,
+    }) in calls
+    assert calls[-1][0] == "task.close"
+
+
+def test_dvclive_tracker_contract(monkeypatch):
+    import sys
+    import types
+
+    calls = []
+    fake = types.ModuleType("dvclive")
+    fake.Live = lambda **kw: _Recorder(calls, "live.")
+    monkeypatch.setitem(sys.modules, "dvclive", fake)
+    from accelerate_tpu.tracking import DVCLiveTracker
+
+    t = DVCLiveTracker("run")
+    t.store_init_configuration({"opt": {"lr": 0.1}})
+    t.log({"loss": 3.0})
+    t.finish()
+    assert ("live.log_params", ({"opt.lr": 0.1},), {}) in calls
+    assert ("live.log_metric", ("loss", 3.0), {}) in calls
+    assert [c[0] for c in calls if c[0] == "live.next_step"]
+    assert calls[-1][0] == "live.end"
+
+
+def test_swanlab_and_trackio_tracker_contracts(monkeypatch):
+    import sys
+    import types
+
+    for mod_name, tracker_name in [("swanlab", "SwanLabTracker"), ("trackio", "TrackioTracker")]:
+        calls = []
+        fake = types.ModuleType(mod_name)
+        fake.init = lambda project=None, **kw: calls.append(("init", project)) or _Recorder(calls, "run.")
+        fake.config = _Recorder(calls, "config.")
+        fake.log = lambda values, **kw: calls.append(("log", values))
+        fake.finish = lambda: calls.append(("finish", None))
+        monkeypatch.setitem(sys.modules, mod_name, fake)
+        import accelerate_tpu.tracking as tracking_mod
+
+        t = getattr(tracking_mod, tracker_name)("proj")
+        t.store_init_configuration({"lr": 0.1})
+        t.log({"loss": 1.5}, step=1)
+        t.finish()
+        assert calls[0] == ("init", "proj"), (mod_name, calls)
+        assert any("loss" in str(c) for c in calls), (mod_name, calls)
+
+
+def test_tensorboard_tracker_contract(monkeypatch, tmp_path):
+    import sys
+    import types
+
+    calls = []
+    tb = types.ModuleType("torch.utils.tensorboard")
+    tb.SummaryWriter = lambda d, **kw: calls.append(("SummaryWriter", d)) or _Recorder(calls, "w.")
+    monkeypatch.setitem(sys.modules, "torch.utils.tensorboard", tb)
+    import torch.utils as tu
+
+    monkeypatch.setattr(tu, "tensorboard", tb, raising=False)
+    from accelerate_tpu.tracking import TensorBoardTracker
+
+    t = TensorBoardTracker("run1", logging_dir=str(tmp_path))
+    t.store_init_configuration({"lr": 0.1})
+    t.log({"loss": 1.0, "note": "hi"}, step=2)
+    t.finish()
+    assert calls[0][0] == "SummaryWriter" and calls[0][1].endswith("run1")
+    assert ("w.add_hparams", ({"lr": 0.1},), {"metric_dict": {}}) in calls
+    assert ("w.add_scalar", ("loss", 1.0), {"global_step": 2}) in calls
+    assert ("w.add_text", ("note", "hi"), {"global_step": 2}) in calls
+    assert calls[-1][0] == "w.close"
 
 
 def test_profile_context(tmp_path):
