@@ -1127,15 +1127,20 @@ class Accelerator:
             bad = {k: v for k, v in
                    {"tp": pc.tp_size, "pp": pc.pp_size, "cp": pc.cp_size,
                     "sp": pc.sp_size, "ep": pc.ep_size}.items() if v > 1}
-            if bad or offload_opt or accum_steps > 1 or policy.needs_loss_scaling or has_aux:
+            width_knobs = self.grad_sync_kwargs.comm_dtype or self.grad_sync_kwargs.grad_dtype
+            if (bad or offload_opt or accum_steps > 1 or policy.needs_loss_scaling
+                    or has_aux or width_knobs):
                 raise ValueError(
                     "compression='powersgd' is the DDP comm-hook analog: pure "
                     "data parallelism, no cpu_offload, accumulation of 1, no "
-                    "fp16 scaling, no aux outputs. Offending config: "
+                    "fp16 scaling, no aux outputs, and no comm_dtype/"
+                    "grad_dtype (the factor psums are fp32 — a width knob "
+                    "would be silently ignored). Offending config: "
                     f"{bad or ''}{' offload' if offload_opt else ''}"
                     f"{' accum>1' if accum_steps > 1 else ''}"
                     f"{' fp16' if policy.needs_loss_scaling else ''}"
                     f"{' has_aux' if has_aux else ''}"
+                    f"{' comm_dtype/grad_dtype' if width_knobs else ''}"
                 )
             from .parallel.powersgd import compress_decompress
 

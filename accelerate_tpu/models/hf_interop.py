@@ -224,10 +224,19 @@ _BERT_RULES: list[tuple[str, str]] = [
 ]
 
 
+# non-parameter buffers (position_ids in pre-4.31 transformers exports) and
+# the token-type table the stream adapter folds away
+_BERT_SKIP = re.compile(
+    r"^bert\.embeddings\.(position_ids|token_type_ids|token_type_embeddings\.weight)$"
+)
+
+
 def hf_bert_key_map(name: str) -> Optional[str]:
     """HF BERT ``state_dict`` name -> this framework's param path.  torch
     ``.weight`` on Dense layers becomes ``.kernel`` via the shared tensor
     map; embeddings/norms keep their names."""
+    if _BERT_SKIP.match(name):
+        return None
     for pattern, template in _BERT_RULES:
         if re.match(pattern, name):
             out = re.sub(pattern, template, name)

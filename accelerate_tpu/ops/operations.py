@@ -373,24 +373,34 @@ def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
     return recursively_apply(_reduce, tensor, error_on_other_type=True)
 
 
-def _sum_across_processes(t: np.ndarray) -> np.ndarray:
+@functools.lru_cache(maxsize=1)
+def _reduce_plumbing():
+    """(mesh over [proc, dev], jitted replicated sum) — built once so repeat
+    reduce() calls hit the jit cache instead of re-tracing per call."""
     import jax.numpy as jnp
-    from jax.experimental import multihost_utils
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     n_proc = jax.process_count()
     devices = np.array(sorted(jax.devices(), key=lambda d: d.id))
     mesh = Mesh(devices.reshape(n_proc, -1), ("proc", "dev"))
-    global_arr = multihost_utils.host_local_array_to_global_array(
-        t[None], mesh, PartitionSpec("proc")
-    )
     summed = jax.jit(
         lambda x: jnp.sum(x, axis=0),
         out_shardings=NamedSharding(mesh, PartitionSpec()),
-    )(global_arr)
+    )
+    return mesh, summed
+
+
+def _sum_across_processes(t: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec
+
+    mesh, summed = _reduce_plumbing()
+    global_arr = multihost_utils.host_local_array_to_global_array(
+        t[None], mesh, PartitionSpec("proc")
+    )
     return np.asarray(
         multihost_utils.global_array_to_host_local_array(
-            summed, mesh, PartitionSpec()
+            summed(global_arr), mesh, PartitionSpec()
         )
     )
 

@@ -117,7 +117,8 @@ def wire_bytes_report(params, rank: int) -> dict:
         dense += size
         if eligible(leaf, rank):
             n, m = _matrix_view(leaf.shape)
-            compressed += 2 * rank * (n + m) * 4  # P psum + Q psum
+            # per step: the P psum moves n*r floats, the Q psum m*r
+            compressed += rank * (n + m) * 4
             n_eligible += 1
         else:
             compressed += size

@@ -120,7 +120,9 @@ class TPUProfiler:
                 "bytes_limit": end.get("bytes_limit", 0),
             }
         self.summary["cycles"] += 1
-        if self._handler.on_trace_ready is not None:
+        if self._handler.on_trace_ready is not None and trace_dir is not None:
+            # no trace dir = memory/flops-only profiling: there is no trace
+            # for the callback to consume (pre-schedule behavior)
             self._handler.on_trace_ready(trace_dir)
 
     @staticmethod
