@@ -878,6 +878,13 @@ class Accelerator:
             )
 
         use_fp8 = str(self.mixed_precision) == "fp8"
+        # DDP "sum" semantics: the GSPMD-implicit reduction produces the
+        # global-mean gradient (grad of the global-mean loss), so
+        # average_grads=False rescales the tree by the data-parallel world
+        # size — the optimizer then sees the sum across dp ranks.
+        _dp_axes = self._compression_axes()
+        dp_world = int(np.prod([self.mesh.shape[a] for a in _dp_axes])) if _dp_axes else 1
+        grad_scale = 1 if self.grad_sync_kwargs.average_grads else dp_world
         compute_width_grads = self.grad_sync_kwargs.grad_dtype is not None
         if compute_width_grads:
             if self.grad_sync_kwargs.grad_dtype != "bf16" or policy.needs_loss_scaling:
@@ -915,6 +922,10 @@ class Accelerator:
                 return loss, aux
 
             (loss, aux), grads = jax.value_and_grad(scaled_loss, has_aux=True)(params, batch)
+            if grad_scale != 1:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * jnp.asarray(grad_scale, g.dtype), grads
+                )
             if comm_dtype is not None:
                 grads = jax.tree_util.tree_map(lambda g: g.astype(comm_dtype), grads)
             if compute_width_grads:
@@ -1128,15 +1139,31 @@ class Accelerator:
                    {"tp": pc.tp_size, "pp": pc.pp_size, "cp": pc.cp_size,
                     "sp": pc.sp_size, "ep": pc.ep_size}.items() if v > 1}
             width_knobs = self.grad_sync_kwargs.comm_dtype or self.grad_sync_kwargs.grad_dtype
-            if (bad or offload_opt or accum_steps > 1 or policy.needs_loss_scaling
-                    or has_aux or width_knobs):
+            # DDP-style compression needs replicated params: under
+            # FULL_SHARD/HYBRID (ZeRO-3) the shard_map's replicated in_specs
+            # would force a full param all-gather every step plus replicated
+            # fp32 grad/error trees — inverting the wire-bytes/memory purpose
+            # on configs sized for ZeRO.  NO_SHARD/SHARD_GRAD_OP keep params
+            # replicated (SHARD_GRAD_OP shards only optimizer state, which
+            # never crosses the shard_map).
+            from .parallel.sharding import param_fsdp_axes, resolve_sharding_strategy
+
+            strategy = resolve_sharding_strategy(self.fsdp_plugin, pc)
+            params_sharded = bool(param_fsdp_axes(self.mesh, pc, strategy))
+            if (bad or params_sharded or offload_opt or accum_steps > 1
+                    or policy.needs_loss_scaling or has_aux or width_knobs):
                 raise ValueError(
                     "compression='powersgd' is the DDP comm-hook analog: pure "
-                    "data parallelism, no cpu_offload, accumulation of 1, no "
+                    "data parallelism with replicated params (sharding_strategy "
+                    "NO_SHARD or SHARD_GRAD_OP — FULL_SHARD/HYBRID would "
+                    "all-gather every param each step inside the shard_map), "
+                    "no cpu_offload, accumulation of 1, no "
                     "fp16 scaling, no aux outputs, and no comm_dtype/"
                     "grad_dtype (the factor psums are fp32 — a width knob "
                     "would be silently ignored). Offending config: "
-                    f"{bad or ''}{' offload' if offload_opt else ''}"
+                    f"{bad or ''}"
+                    f"{' params-sharded(' + str(strategy) + ')' if params_sharded else ''}"
+                    f"{' offload' if offload_opt else ''}"
                     f"{' accum>1' if accum_steps > 1 else ''}"
                     f"{' fp16' if policy.needs_loss_scaling else ''}"
                     f"{' has_aux' if has_aux else ''}"
@@ -1164,6 +1191,13 @@ class Accelerator:
                 g_hat, new_qs, new_errs = compress_decompress(
                     grads, qs, errs_local, axes, psgd_rank
                 )
+                if grad_scale != 1:
+                    # sum semantics: compression runs at mean scale (the EF
+                    # residual is self-consistent either way); the optimizer
+                    # sees the dp-sum like the dense path
+                    g_hat = jax.tree_util.tree_map(
+                        lambda g: g * jnp.asarray(grad_scale, g.dtype), g_hat
+                    )
                 new_errs = jax.tree_util.tree_map(lambda e: e[None], new_errs)
                 return jax.lax.pmean(loss, axes), g_hat, new_qs, new_errs
 

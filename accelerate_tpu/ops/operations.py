@@ -381,7 +381,10 @@ def _reduce_plumbing():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
     n_proc = jax.process_count()
-    devices = np.array(sorted(jax.devices(), key=lambda d: d.id))
+    # group rows by owning process explicitly: device ids are not guaranteed
+    # to be contiguous per host, and a row mixing hosts would hand
+    # host_local_array_to_global_array shards this process doesn't own
+    devices = np.array(sorted(jax.devices(), key=lambda d: (d.process_index, d.id)))
     mesh = Mesh(devices.reshape(n_proc, -1), ("proc", "dev"))
     summed = jax.jit(
         lambda x: jnp.sum(x, axis=0),

@@ -118,6 +118,38 @@ def _spec_for_leaf(
     return PartitionSpec(*spec)
 
 
+def resolve_sharding_strategy(
+    fsdp_plugin: Optional[FullyShardedDataParallelPlugin],
+    parallelism_config: Optional[ParallelismConfig],
+) -> ShardingStrategy:
+    """The effective strategy a config resolves to: an explicit plugin wins;
+    otherwise a non-trivial ``dp_shard`` axis implies FULL_SHARD (ZeRO-3 is
+    the point of asking for that axis) and anything else is NO_SHARD."""
+    if fsdp_plugin is not None:
+        return fsdp_plugin.sharding_strategy
+    cfg = parallelism_config or ParallelismConfig()
+    return ShardingStrategy.FULL_SHARD if cfg.dp_shard_size > 1 else ShardingStrategy.NO_SHARD
+
+
+def param_fsdp_axes(mesh: Mesh, cfg: ParallelismConfig, strategy: ShardingStrategy) -> tuple:
+    """Mesh axes *parameters* actually shard over under ``strategy``.
+
+    Empty means replicated params.  Under FULL_SHARD/HYBRID the axes come
+    from ``fsdp_dim_names`` (default ``dp_shard`` when non-trivial), minus
+    ``cp``: params consumed inside the cp ring shard_map (a *manual* region
+    over cp) must be cp-replicated there; sharding them over the joint
+    (dp_shard, cp) axes makes the partitioner replicate-then-reshard every
+    layer every step ("involuntary full rematerialization" — wasted ICI).
+    The optimizer state keeps the full joint ZeRO sharding (it never crosses
+    the shard_map) — see make_opt_state_sharding_plan.  NO_SHARD /
+    SHARD_GRAD_OP replicate parameters across dp (grad/optimizer sharding
+    for SHARD_GRAD_OP is applied to opt_state only)."""
+    if strategy not in (ShardingStrategy.FULL_SHARD, ShardingStrategy.HYBRID_SHARD):
+        return ()
+    fsdp_axes = cfg.fsdp_dim_names or (("dp_shard",) if mesh.shape.get("dp_shard", 1) > 1 else ())
+    return tuple(a for a in fsdp_axes if a != "cp" and mesh.shape.get(a, 1) > 1)
+
+
 def make_sharding_plan(
     params,
     mesh: Mesh,
@@ -134,26 +166,9 @@ def make_sharding_plan(
     cfg = parallelism_config or ParallelismConfig()
     tp_rules = list(tp_rules or [])
 
-    strategy = fsdp_plugin.sharding_strategy if fsdp_plugin is not None else (
-        ShardingStrategy.FULL_SHARD if cfg.dp_shard_size > 1 else ShardingStrategy.NO_SHARD
-    )
+    strategy = resolve_sharding_strategy(fsdp_plugin, cfg)
     min_size = fsdp_plugin.min_weight_size if fsdp_plugin is not None else 2**12
-
-    if strategy in (ShardingStrategy.FULL_SHARD, ShardingStrategy.HYBRID_SHARD):
-        fsdp_axes = cfg.fsdp_dim_names or (("dp_shard",) if mesh.shape.get("dp_shard", 1) > 1 else ())
-        # Params consumed inside the cp ring shard_map (a *manual* region
-        # over cp) must be cp-replicated there; sharding them over the joint
-        # (dp_shard, cp) axes makes the partitioner replicate-then-reshard
-        # every layer every step ("involuntary full rematerialization" —
-        # wasted ICI).  So params shard over the non-manual axes only; the
-        # optimizer state keeps the full joint ZeRO sharding (it never
-        # crosses the shard_map) — see make_opt_state_sharding_plan.
-        fsdp_axes = tuple(a for a in fsdp_axes if a != "cp")
-    else:
-        # NO_SHARD / SHARD_GRAD_OP: parameters replicated across dp
-        # (grad/optimizer sharding for SHARD_GRAD_OP is applied to opt_state
-        # only — see make_opt_state_sharding_plan)
-        fsdp_axes = ()
+    fsdp_axes = param_fsdp_axes(mesh, cfg, strategy)
 
     def _leaf(path, leaf):
         shape = tuple(leaf.shape) if hasattr(leaf, "shape") else ()

@@ -161,7 +161,11 @@ class GradSyncKwargs(KwargsHandler):
     """
 
     comm_dtype: Optional[str] = None  # None | "bf16" | "fp16" — grads cast before psum
-    average_grads: bool = True        # mean (DDP semantics) vs sum across dp
+    # mean (DDP semantics) vs sum across dp: GSPMD's implicit reduction
+    # yields the global-mean grad, so False rescales the tree by the dp
+    # world size before clip/update (honored in both the dense and the
+    # powersgd train-step paths)
+    average_grads: bool = True
     # None: grads carry master (fp32) width through clip/update (torch-DDP
     # semantics).  "bf16": differentiate wrt the compute-width param copy so
     # the whole grad tree stays bf16 — halves grad HBM; the per-leaf optimizer
@@ -171,7 +175,8 @@ class GradSyncKwargs(KwargsHandler):
     # "powersgd": error-feedback low-rank compression of the dp-axis grad
     # reduction (reference DDPCommunicationHookType.POWER_SGD analog; engine:
     # parallel/powersgd.py).  ``rank`` is the factor rank — wire bytes per
-    # eligible [n, m] leaf drop from n*m to 2*rank*(n+m).
+    # eligible [n, m] leaf drop from n*m to rank*(n+m) (the P psum moves
+    # n*rank floats, the Q psum m*rank — matching wire_bytes_report).
     compression: Optional[str] = None
     rank: int = 4
 
@@ -197,7 +202,12 @@ class ProfileKwargs(KwargsHandler):
     each cycle traces exactly steps ``[wait+warmup, wait+warmup+active)``
     as counted by ``profiler.step()`` calls; ``repeat`` bounds the number
     of cycles (0 = cycle until the block ends, each cycle under
-    ``cycle_<i>/``).  ``profile_memory`` reports device memory deltas over
+    ``cycle_<i>/``).  When **no schedule is given** (all of
+    ``wait``/``warmup``/``repeat`` at 0 and ``active`` left at ``None``)
+    the whole ``with`` block is ONE continuous trace window even if
+    ``profiler.step()`` is called — the reference's no-schedule
+    ``torch.profiler`` behavior — instead of a start/stop pair per step.
+    ``profile_memory`` reports device memory deltas over
     the active window in ``profiler.summary['memory']``; ``with_flops``
     accumulates :meth:`TPUProfiler.flops_estimate` results into
     ``summary['flops']``.  ``on_trace_ready(trace_dir)`` fires at the end
@@ -206,13 +216,23 @@ class ProfileKwargs(KwargsHandler):
 
     wait: int = 0
     warmup: int = 0
-    active: int = 1
+    # None = "no schedule declared" (continuous window); an explicit int
+    # turns on the per-cycle schedule
+    active: Optional[int] = None
     repeat: int = 0
     output_trace_dir: Optional[str] = None
     with_flops: bool = False
     profile_memory: bool = False
     create_perfetto_link: bool = False
     on_trace_ready: Optional[Callable] = None
+
+    def has_schedule(self) -> bool:
+        if self.active is not None and self.active < 1:
+            raise ValueError(
+                f"ProfileKwargs.active must be >= 1 when set (got {self.active}); "
+                "leave it at None for a single continuous trace window"
+            )
+        return bool(self.wait or self.warmup or self.repeat or self.active is not None)
 
 
 @dataclass

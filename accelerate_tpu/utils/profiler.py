@@ -69,9 +69,14 @@ class TPUProfiler:
 
     def __init__(self, handler, state=None):
         self._handler = handler
+        # An all-defaults handler declared no schedule: the whole block is
+        # one continuous window even when step() is called each iteration
+        # (the reference's no-schedule torch.profiler pattern) — otherwise a
+        # naive per-step step() would open/close a trace per training step.
+        self._no_schedule = not handler.has_schedule()
         self._schedule = _Schedule(
             wait=handler.wait, warmup=handler.warmup,
-            active=max(1, handler.active), repeat=handler.repeat,
+            active=max(1, handler.active or 1), repeat=handler.repeat,
         )
         self._state = state
         self.step_num = 0
@@ -142,6 +147,10 @@ class TPUProfiler:
         if in_active:
             self.summary["traced_steps"].append(self.step_num)
         self.step_num += 1
+        if self._no_schedule:
+            # continuous-window mode: the block edges own the trace window;
+            # step() only records which steps fell inside it
+            return
         cycle, phase = self._schedule.locate(self.step_num)
         if in_active and (phase != "active" or cycle != self._tracing_cycle):
             self._close_window()

@@ -106,6 +106,35 @@ def test_grad_dtype_rejects_fp16_scaling():
         acc.prepare_train_step(regression_loss_fn)
 
 
+def test_average_grads_false_gives_sum_semantics():
+    """average_grads=False (DDP sum semantics): the optimizer sees the
+    dp-world multiple of the implicit global-mean gradient (ADVICE r4)."""
+    from accelerate_tpu.utils.dataclasses import GradSyncKwargs
+
+    def one_step(average):
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(dp_shard_size=8),
+            fsdp_plugin=FullyShardedDataParallelPlugin(
+                sharding_strategy=ShardingStrategy.NO_SHARD
+            ),
+            kwargs_handlers=[GradSyncKwargs(average_grads=average)],
+        )
+        state = acc.create_train_state(regression_init_params(), acc.prepare(optax.sgd(1.0)))
+        step = acc.prepare_train_step(regression_loss_fn)
+        batch = next(iter(acc.prepare(make_regression_loader(batch_size=16))))
+        new_state, _ = step(state, batch)
+        p0 = regression_init_params()
+        return {k: float(new_state.params[k]) - float(p0[k]) for k in p0}
+
+    d_mean = one_step(True)
+    d_sum = one_step(False)
+    assert any(abs(v) > 1e-6 for v in d_mean.values())
+    for k in d_mean:
+        np.testing.assert_allclose(d_sum[k], 8 * d_mean[k], rtol=1e-4)
+
+
 def test_gradient_accumulation_in_step_parity():
     # accum over k microbatches == one big batch (SGD linearity)
     acc = Accelerator(gradient_accumulation_steps=4)

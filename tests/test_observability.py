@@ -382,6 +382,46 @@ def test_profile_bare_block_traces_whole_region(monkeypatch, tmp_path):
     assert profiler.summary["traced_steps"] == [0]
 
 
+def test_profile_no_schedule_is_one_continuous_window(monkeypatch, tmp_path):
+    """All-defaults ProfileKwargs + per-step step() = ONE window for the whole
+    block (the reference's no-schedule torch.profiler behavior), not a
+    start/stop pair and cycle_<i> dir per training step (ADVICE r4)."""
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    handler = ProfileKwargs(output_trace_dir=str(tmp_path))
+    profiler, events = _windowed_profiler(monkeypatch, handler)
+    profiler._enter()
+    for _ in range(5):
+        profiler.step()
+    profiler._exit()
+    assert [e[0] for e in events] == ["start", "stop"]
+    assert profiler.summary["cycles"] == 1
+    assert profiler.summary["traced_steps"] == [0, 1, 2, 3, 4]
+
+
+def test_profile_explicit_active_one_still_cycles(monkeypatch, tmp_path):
+    """An EXPLICIT active=1 keeps per-cycle windows — only the untouched
+    default is treated as 'no schedule'."""
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    handler = ProfileKwargs(active=1, output_trace_dir=str(tmp_path))
+    profiler, events = _windowed_profiler(monkeypatch, handler)
+    profiler._enter()
+    for _ in range(3):
+        profiler.step()
+    profiler._exit()
+    assert [e[0] for e in events] == ["start"] + ["stop", "start"] * 3 + ["stop"]
+    assert profiler.summary["cycles"] == 4
+
+
+def test_profile_explicit_active_zero_rejected():
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+    from accelerate_tpu.utils.profiler import TPUProfiler
+
+    with pytest.raises(ValueError, match="active"):
+        TPUProfiler(ProfileKwargs(active=0))
+
+
 def test_profile_memory_and_flops():
     from accelerate_tpu.utils.dataclasses import ProfileKwargs
     from accelerate_tpu.utils.profiler import TPUProfiler

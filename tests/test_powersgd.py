@@ -147,6 +147,25 @@ def test_powersgd_exact_when_rank_spans_gradient():
     assert float(jnp.abs(new_errs["w"]).max()) < 1e-4
 
 
+def test_powersgd_allows_declared_full_shard_with_replicated_params():
+    """FULL_SHARD with a trivial dp_shard axis shards nothing — params are
+    replicated (the DDP shape powersgd targets), so the guard must accept."""
+    _fresh()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_replicate_size=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            sharding_strategy=ShardingStrategy.FULL_SHARD
+        ),
+        kwargs_handlers=[GradSyncKwargs(compression="powersgd", rank=2)],
+    )
+    import optax
+
+    state = acc.create_train_state(_mlp_init(jax.random.key(0)), acc.prepare(optax.sgd(0.05)))
+    step = acc.prepare_train_step(_mlp_loss)
+    state, metrics = step(state, _make_batches(1)[0])
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_wire_bytes_report():
     params = {"w": jnp.zeros((1024, 1024)), "b": jnp.zeros((1024,))}
     rep = wire_bytes_report(params, rank=4)
@@ -179,4 +198,24 @@ def test_powersgd_rejects_bad_configs():
         kwargs_handlers=[GradSyncKwargs(compression="powersgd")],
     )
     with pytest.raises(ValueError, match="tp"):
+        acc.prepare_train_step(_mlp_loss)
+    _fresh()
+    # dp_shard>1 with no plugin defaults to FULL_SHARD: params sharded over
+    # dp would force a per-step param all-gather inside the shard_map,
+    # inverting the compression's wire-bytes purpose (ADVICE r4)
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        kwargs_handlers=[GradSyncKwargs(compression="powersgd")],
+    )
+    with pytest.raises(ValueError, match="params-sharded"):
+        acc.prepare_train_step(_mlp_loss)
+    _fresh()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            sharding_strategy=ShardingStrategy.HYBRID_SHARD
+        ),
+        kwargs_handlers=[GradSyncKwargs(compression="powersgd")],
+    )
+    with pytest.raises(ValueError, match="params-sharded"):
         acc.prepare_train_step(_mlp_loss)
