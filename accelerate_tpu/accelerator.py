@@ -378,6 +378,12 @@ class Accelerator:
         self._state_sharding = None
         self._save_model_state_pre_hooks: dict = {}
         self._load_model_state_pre_hooks: dict = {}
+        # in-flight async train-state write (save_state(async_save=True));
+        # awaited before the next save/GC/load and at end_training/exit.
+        # _async_checkpointer is the long-lived orbax AsyncCheckpointer it
+        # points at while a write is in flight.
+        self._pending_checkpointer = None
+        self._async_checkpointer = None
         self.step_count = 0
         self._in_accumulate = False
 
@@ -1660,6 +1666,15 @@ class Accelerator:
 
         return load_accelerator_state(self, input_dir, train_state=train_state, **load_kwargs)
 
+    def wait_for_checkpoint(self):
+        """Block until an in-flight ``save_state(async_save=True)`` write has
+        committed.  Called automatically before the next save_state (and its
+        retention GC), load_state, end_training, and at interpreter exit —
+        call it directly only to bound checkpoint latency explicitly."""
+        from .checkpointing import wait_for_pending_checkpoint
+
+        wait_for_pending_checkpoint(self)
+
     def save_model(self, train_state_or_params, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
         from .checkpointing import save_model
 
@@ -1696,8 +1711,15 @@ class Accelerator:
             tracker.log(values, step=step, **log_kwargs.get(tracker.name, {}))
 
     def end_training(self):
-        for tracker in self.trackers:
-            tracker.finish()
+        from .checkpointing import close_async_checkpointer
+
+        try:
+            close_async_checkpointer(self)
+        finally:
+            # a failed checkpoint flush must not also drop the trackers'
+            # buffered metrics
+            for tracker in self.trackers:
+                tracker.finish()
         self.wait_for_everyone()
 
     def __repr__(self):

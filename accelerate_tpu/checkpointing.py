@@ -62,6 +62,97 @@ def _ocp():
 
 
 # ---------------------------------------------------------------------------
+# async-save lifecycle
+# ---------------------------------------------------------------------------
+
+
+# Strong refs on purpose: a garbage-collected Accelerator must not orphan an
+# in-flight write (the checkpoint would be truncated at interpreter teardown).
+_LIVE_ASYNC_CKPTRS: set = set()
+_atexit_registered = False
+
+
+def _flush_live_checkpointers_at_exit() -> None:
+    while _LIVE_ASYNC_CKPTRS:
+        ckptr = _LIVE_ASYNC_CKPTRS.pop()
+        try:
+            ckptr.wait_until_finished()
+        except Exception:  # one failed write must not orphan the others
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            ckptr.close()
+
+
+def _register_exit_flush() -> None:
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    _atexit_registered = True
+    import threading
+
+    register = getattr(threading, "_register_atexit", None)
+    if register is not None:
+        # plain atexit is too late: Py_FinalizeEx runs threading._shutdown
+        # (which marks concurrent.futures shut down) BEFORE atexit hooks, and
+        # orbax's commit threads schedule executor futures while finalizing —
+        # an atexit flush dies with "cannot schedule new futures after
+        # interpreter shutdown" and leaves a truncated checkpoint (verified
+        # empirically).  threading atexits run LIFO, so registering after
+        # concurrent.futures' own hook puts this flush before executor
+        # shutdown, while worker threads can still be scheduled.
+        register(_flush_live_checkpointers_at_exit)
+    else:  # pragma: no cover - future CPython without the private hook
+        import atexit
+
+        atexit.register(_flush_live_checkpointers_at_exit)
+
+
+def _release_async_checkpointer(accelerator, ckptr) -> None:
+    _LIVE_ASYNC_CKPTRS.discard(ckptr)
+    if getattr(accelerator, "_async_checkpointer", None) is ckptr:
+        accelerator._async_checkpointer = None
+    ckptr.close()
+
+
+def wait_for_pending_checkpoint(accelerator) -> None:
+    """Block until this process's in-flight ``async_save`` train-state write
+    has committed.
+
+    No-op when nothing is pending.  Every consumer of checkpoint state goes
+    through this barrier: the next ``save_state`` (so retention GC never
+    deletes a directory whose write is still in flight, and two writers
+    never interleave), ``load_state``, ``end_training``, and an ``atexit``
+    hook (so interpreter teardown cannot truncate a "saved" checkpoint).
+    The AsyncCheckpointer itself is long-lived (cached on the accelerator,
+    orbax's reuse pattern) — it is only closed on failure, at
+    ``end_training`` and at exit."""
+    ckptr = getattr(accelerator, "_pending_checkpointer", None)
+    if ckptr is None:
+        return
+    # clear first: a failed finalization should surface once, not wedge every
+    # subsequent save/load behind the same broken checkpointer
+    accelerator._pending_checkpointer = None
+    try:
+        ckptr.wait_until_finished()
+    except BaseException:
+        # a failed write poisons the checkpointer: release its threads and
+        # drop it from the reuse cache rather than leaking them per retry
+        _release_async_checkpointer(accelerator, ckptr)
+        raise
+
+
+def close_async_checkpointer(accelerator) -> None:
+    """Terminal flush: await any pending write, then release the cached
+    AsyncCheckpointer's background threads (``end_training`` path)."""
+    wait_for_pending_checkpoint(accelerator)
+    ckptr = getattr(accelerator, "_async_checkpointer", None)
+    if ckptr is not None:
+        _release_async_checkpointer(accelerator, ckptr)
+
+
+# ---------------------------------------------------------------------------
 # naming + retention (reference accelerator.py:3587-3613)
 # ---------------------------------------------------------------------------
 
@@ -151,6 +242,12 @@ def save_accelerator_state(
     async_save: bool = False,
 ) -> str:
     ocp = _ocp()
+    # a previous async save must be on disk before retention GC may delete
+    # directories and before a second writer starts — on EVERY rank, not
+    # just this one (sharded writes put all ranks' shards in the same dir,
+    # and rmtree runs on the main process)
+    wait_for_pending_checkpoint(accelerator)
+    accelerator.wait_for_everyone()
     output_dir = _auto_checkpoint_dir(accelerator, output_dir)
     output_dir = Path(output_dir).absolute()
     output_dir.mkdir(parents=True, exist_ok=True)
@@ -163,10 +260,19 @@ def save_accelerator_state(
     if train_state is not None:
         arrays, treedef = jax.tree_util.tree_flatten(train_state)
         array_tree = {str(i): a for i, a in enumerate(arrays) if a is not None}
-        ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) if async_save else ocp.PyTreeCheckpointer()
-        ckptr.save(output_dir / TRAIN_STATE_DIR, array_tree, force=True)
         if async_save:
+            # one long-lived AsyncCheckpointer per accelerator (orbax's
+            # intended reuse pattern — no thread-pool churn per save)
+            ckptr = getattr(accelerator, "_async_checkpointer", None)
+            if ckptr is None:
+                ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+                accelerator._async_checkpointer = ckptr
+            _LIVE_ASYNC_CKPTRS.add(ckptr)
+            _register_exit_flush()
+            ckptr.save(output_dir / TRAIN_STATE_DIR, array_tree, force=True)
             accelerator._pending_checkpointer = ckptr
+        else:
+            ocp.PyTreeCheckpointer().save(output_dir / TRAIN_STATE_DIR, array_tree, force=True)
 
     process_index = accelerator.process_index
     # 2. RNG (per process)
@@ -206,6 +312,9 @@ def load_accelerator_state(
     TrainState (same structure/shardings — e.g. freshly built via
     ``create_train_state``); returns the restored TrainState (or None)."""
     ocp = _ocp()
+    # the latest checkpoint may still be writing asynchronously — on any rank
+    wait_for_pending_checkpoint(accelerator)
+    accelerator.wait_for_everyone()
     if input_dir is None:
         ckpts = list_checkpoints(accelerator.project_dir or ".")
         if not ckpts:

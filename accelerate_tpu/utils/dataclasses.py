@@ -226,12 +226,14 @@ class ProfileKwargs(KwargsHandler):
     create_perfetto_link: bool = False
     on_trace_ready: Optional[Callable] = None
 
-    def has_schedule(self) -> bool:
+    def __post_init__(self):
         if self.active is not None and self.active < 1:
             raise ValueError(
                 f"ProfileKwargs.active must be >= 1 when set (got {self.active}); "
                 "leave it at None for a single continuous trace window"
             )
+
+    def has_schedule(self) -> bool:
         return bool(self.wait or self.warmup or self.repeat or self.active is not None)
 
 

@@ -416,10 +416,9 @@ def test_profile_explicit_active_one_still_cycles(monkeypatch, tmp_path):
 
 def test_profile_explicit_active_zero_rejected():
     from accelerate_tpu.utils.dataclasses import ProfileKwargs
-    from accelerate_tpu.utils.profiler import TPUProfiler
 
     with pytest.raises(ValueError, match="active"):
-        TPUProfiler(ProfileKwargs(active=0))
+        ProfileKwargs(active=0)
 
 
 def test_profile_memory_and_flops():
