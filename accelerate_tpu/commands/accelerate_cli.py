@@ -23,10 +23,7 @@ from .test import test_command_parser
 from .tpu import tpu_command_parser
 
 
-def main():
-    # importing installs rich tracebacks iff ACCELERATE_ENABLE_RICH is set
-    from ..utils import rich as _rich  # noqa: F401
-
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         "accelerate-tpu",
         usage="accelerate-tpu <command> [<args>]",
@@ -44,7 +41,14 @@ def main():
     tpu_command_parser(subparsers)
     from_accelerate_command_parser(subparsers)
     cloud_command_parser(subparsers)
+    return parser
 
+
+def main():
+    # importing installs rich tracebacks iff ACCELERATE_ENABLE_RICH is set
+    from ..utils import rich as _rich  # noqa: F401
+
+    parser = build_parser()
     args = parser.parse_args()
     if not hasattr(args, "func"):
         parser.print_help()

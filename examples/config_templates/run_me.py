@@ -1,0 +1,42 @@
+"""Smoke script for the config templates (reference
+examples/config_yaml_templates/run_me.py): launch it with any template in
+this directory and it prints the topology the env transport delivered,
+then trains a toy regression for a few steps.
+
+    accelerate-tpu launch --config_file single_chip.yaml run_me.py
+"""
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils.training import (
+    make_regression_loader,
+    regression_init_params,
+    regression_loss_fn,
+)
+
+
+def main():
+    accelerator = Accelerator()
+    accelerator.print(
+        f"num_processes={accelerator.num_processes} "
+        f"process_index={accelerator.process_index} "
+        f"mixed_precision={accelerator.mixed_precision} "
+        f"mesh={dict(accelerator.mesh.shape)}"
+    )
+    dl = accelerator.prepare(make_regression_loader(batch_size=16))
+    state = accelerator.create_train_state(
+        regression_init_params(), accelerator.prepare(optax.sgd(0.1))
+    )
+    step = accelerator.prepare_train_step(regression_loss_fn)
+    for batch in dl:
+        state, metrics = step(state, batch)
+    accelerator.print(
+        f"final loss {float(metrics['loss']):.4f} "
+        f"a={float(state.params['a']):.3f} b={float(state.params['b']):.3f}"
+    )
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

