@@ -43,7 +43,7 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
-    attn_implementation: str = "native"  # native | flash | ring
+    attn_implementation: str = "native"  # native | flash | ring | ulysses
     # explicit flash kernel tiling (None = ops/flash_attention.py heuristic;
     # the heuristic's d>=128 clamp to block_q 512 exists for REMATTED
     # contexts hitting the Mosaic scoped-VMEM limit — remat-off configs at
@@ -189,6 +189,10 @@ def get_attention_impl(name: str) -> Callable:
         from ..parallel.context_parallel import ring_attention
 
         return ring_attention
+    if name == "ulysses":
+        from ..parallel.sequence_parallel import ulysses_attention
+
+        return ulysses_attention
     raise ValueError(f"unknown attention implementation {name!r}")
 
 

@@ -254,6 +254,7 @@ def ring_attention_sharded(
     return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
 
 
+@functools.lru_cache(maxsize=None)
 def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = "alltoall",
                         zigzag: bool = True, use_flash: Optional[bool] = None):
     """Build the mesh-bound ring attention usable inside a jitted model.
@@ -266,25 +267,34 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = 
         # (a CPU debug mesh on a TPU-attached host must take the XLA path)
         use_flash = mesh.devices.flat[0].platform == "tpu"
 
-    def attn(q, k, v, *, causal: bool = True, segment_ids=None):
+    # Partial-manual: only the ring axis is manualized; every other mesh
+    # axis stays under GSPMD inside the body, so a tp-sharded head dim or a
+    # dp-sharded batch dim keeps its sharding through the ring (a
+    # full-manual region would all-gather them per step — cp×tp and cp×dp
+    # compositions rely on this).  jax 0.9's eager partial-manual validator
+    # rejects multi-axis meshes spuriously, so the shard_map runs under a
+    # cached jit (inlined when the caller is itself jitted).
+    @functools.lru_cache(maxsize=None)
+    def _build(causal: bool, with_seg: bool):
         spec = P(None, axis_name, None, None)
-        seg_spec = P(None, axis_name)
         body = functools.partial(
             ring_attention_sharded, axis_name=axis_name, causal=causal,
             rotate_method=rotate_method, zigzag=zigzag, use_flash=use_flash,
         )
+        in_specs = (spec, spec, spec) + ((P(None, axis_name),) if with_seg else ())
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=spec,
+            axis_names={axis_name}, check_vma=False,
+        ))
+
+    def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         if segment_ids is None:
-            return shard_map(
-                body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-            )(q, k, v)
+            return _build(causal, False)(q, k, v)
         # NOTE: under zigzag layout the caller shards segment_ids with the
         # same zigzag_shard reorder as the tokens
         # (Accelerator.maybe_context_parallel does this for step buffers)
         # so local ids line up with local tokens.
-        return shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec, seg_spec), out_specs=spec,
-            check_vma=False,
-        )(q, k, v, jnp.asarray(segment_ids, jnp.int32))
+        return _build(causal, True)(q, k, v, jnp.asarray(segment_ids, jnp.int32))
 
     return attn
 

@@ -63,6 +63,7 @@ def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causa
     return head2seq(out_h)
 
 
+@functools.lru_cache(maxsize=None)
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Optional[Callable] = None):
     """Mesh-bound Ulysses attention on GLOBAL arrays (seq dim sharded over
     ``axis_name``)."""
@@ -75,6 +76,21 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
 
         inner_attn = flash_attention
 
+    # Partial-manual: only sp is manualized — the head dim may itself be
+    # tp-sharded outside and keeps that sharding through the all_to_alls
+    # (sp splits the LOCAL tp head shard; sp×tp needs H/tp % sp == 0), and a
+    # dp-sharded batch is not gathered into the body.  jax 0.9's eager
+    # partial-manual validator rejects multi-axis meshes spuriously, so the
+    # shard_map runs under a cached jit (inlined under an outer jit).
+    @functools.lru_cache(maxsize=None)
+    def _build(causal: bool, with_seg: bool):
+        spec = P(None, axis_name, None, None)
+        body = functools.partial(ulysses_attention_sharded, axis_name=axis_name, causal=causal,
+                                 inner_attn=inner_attn)
+        in_specs = (spec, spec, spec) + ((P(None, axis_name),) if with_seg else ())
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=spec,
+                                 axis_names={axis_name}, check_vma=False))
+
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         h_q, h_kv = q.shape[2], k.shape[2]
         sp = mesh.shape[axis_name]
@@ -86,15 +102,9 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
             v = jnp.repeat(v, rep, axis=2)
         if h_q % sp != 0:
             raise ValueError(f"num_heads {h_q} must be divisible by sp={sp}")
-        spec = P(None, axis_name, None, None)
-        body = functools.partial(ulysses_attention_sharded, axis_name=axis_name, causal=causal,
-                                 inner_attn=inner_attn)
         if segment_ids is None:
-            return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                             check_vma=False)(q, k, v)
-        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, P(None, axis_name)),
-                         out_specs=spec, check_vma=False)(
-            q, k, v, jnp.asarray(segment_ids, jnp.int32))
+            return _build(causal, False)(q, k, v)
+        return _build(causal, True)(q, k, v, jnp.asarray(segment_ids, jnp.int32))
 
     return attn
 

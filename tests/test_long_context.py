@@ -401,3 +401,25 @@ def test_ulysses_flash_inner_matches_native(sp_mesh, causal):
     qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
     out = attn(qs, ks, vs, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+def test_model_level_ulysses_matches_native():
+    """attn_implementation='ulysses' (the config-name entry added for sp×tp
+    composition) produces native-attention logits under an active sp mesh —
+    params are impl-independent, so one init serves both."""
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    Accelerator(parallelism_config=ParallelismConfig(sp_size=4, dp_shard_size=2))
+    rng_np = np.random.default_rng(0)
+    tokens = jnp.asarray(rng_np.integers(0, 256, (2, 32)), jnp.int32)
+    base = LlamaConfig.tiny(num_key_value_heads=4, dtype=jnp.float32)
+    native_model = LlamaForCausalLM(base)
+    params = native_model.init(jax.random.key(0), tokens[:, :8])
+    ref = np.asarray(native_model.apply(params, tokens))
+    uly = LlamaForCausalLM(
+        LlamaConfig.tiny(attn_implementation="ulysses", num_key_value_heads=4,
+                         dtype=jnp.float32)
+    )
+    out = np.asarray(uly.apply(params, tokens))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
