@@ -34,6 +34,72 @@ def test_two_process_self_launch():
     assert "ALL CHECKS PASSED" in result.stdout
 
 
+def test_four_process_self_launch():
+    """4-rank gang (VERDICT r4 #3): interior-source O(1) broadcasts, the
+    dispatcher's lookahead broadcast stream, PowerSGD factor psums across
+    real processes — the rank-math surfaces a 2-proc gang cannot exercise."""
+    cmd = get_launch_command(num_processes=4, num_cpu_devices=1) + [str(_script_path())]
+    result = execute_subprocess(cmd, env=_clean_env(), timeout=900)
+    assert "ALL CHECKS PASSED" in result.stdout
+    assert "dispatcher OK" in result.stdout
+    assert "powersgd OK" in result.stdout
+
+
+def test_four_process_save_kill_resume(tmp_path):
+    """save -> worker crash -> gang restart -> resume from the checkpoint,
+    all under the real launcher at 4 ranks (VERDICT r4 #3; reference
+    elasticity + checkpointing composition)."""
+    script = tmp_path / "resume.py"
+    script.write_text(
+        "import os, pathlib\n"
+        "import numpy as np\n"
+        "import jax, jax.numpy as jnp, optax\n"
+        "from accelerate_tpu import Accelerator\n"
+        "from accelerate_tpu.checkpointing import list_checkpoints\n"
+        "from accelerate_tpu.utils.dataclasses import ProjectConfiguration\n"
+        "work = pathlib.Path(os.environ['WORK_DIR'])\n"
+        "sentinel = work / 'crashed_once'\n"
+        "acc = Accelerator(project_config=ProjectConfiguration(\n"
+        "    project_dir=str(work), automatic_checkpoint_naming=True))\n"
+        "def loss_fn(p, b):\n"
+        "    return jnp.mean((b['x'] @ p['w'] - b['y']) ** 2)\n"
+        "state = acc.create_train_state({'w': jnp.zeros((4,))}, optax.sgd(0.1))\n"
+        "step = acc.prepare_train_step(loss_fn)\n"
+        "start = 0\n"
+        "ckpts = list_checkpoints(str(work))\n"
+        "if ckpts:\n"
+        "    state = acc.load_state(ckpts[-1], train_state=state)\n"
+        "    start = int(state.step)\n"
+        "    acc.print(f'RESUMED AT {start}')\n"
+        "rng = np.random.default_rng(0)\n"
+        "xs = rng.normal(size=(8, 4, 4)).astype(np.float32)\n"
+        "w_true = rng.normal(size=(4,)).astype(np.float32)\n"
+        "for i in range(start, 8):\n"
+        "    b = {'x': xs[i], 'y': xs[i] @ w_true}\n"
+        "    state, metrics = step(state, b)\n"
+        "    if i == 3:\n"
+        "        crash_now = not sentinel.exists() and acc.process_index == 2\n"
+        "        if crash_now:\n"
+        "            # write BEFORE save_state: its trailing barrier orders the\n"
+        "            # sentinel ahead of every rank's post-save progress (other\n"
+        "            # ranks free-run — the tiny step has no collectives)\n"
+        "            sentinel.write_text('x')\n"
+        "        acc.save_state(train_state=state)\n"
+        "        if crash_now:\n"
+        "            raise SystemExit(9)\n"
+        "assert int(state.step) == 8, int(state.step)\n"
+        "assert sentinel.exists()\n"
+        "acc.print(f'RESUME OK loss={float(metrics[\"loss\"]):.6f}')\n"
+    )
+    cmd = get_launch_command(num_processes=4, num_cpu_devices=1, max_restarts=1) + [str(script)]
+    result = execute_subprocess(
+        cmd, env=_clean_env(WORK_DIR=str(tmp_path)), timeout=900
+    )
+    assert "restarting all 4 workers (attempt 1/1)" in result.stderr
+    assert "RESUMED AT 4" in result.stdout
+    assert "RESUME OK" in result.stdout
+
+
 def test_launch_env_reaches_script(tmp_path):
     probe = tmp_path / "probe.py"
     probe.write_text(
