@@ -126,6 +126,7 @@ class TestMixtral:
         assert experts["gate_proj"].shape == (4, cfg.hidden_size, cfg.intermediate_size)
         assert experts["down_proj"].shape == (4, cfg.intermediate_size, cfg.hidden_size)
 
+    @pytest.mark.slow
     def test_loss_includes_router_aux(self):
         from accelerate_tpu.models import make_mixtral_loss_fn
 

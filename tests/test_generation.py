@@ -44,6 +44,7 @@ def test_cached_forward_matches_full(tiny_model):
         )
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_manual_argmax(tiny_model):
     """generate() greedy tokens == manually re-running the full model and
     taking argmax each step (no cache)."""

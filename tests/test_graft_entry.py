@@ -34,6 +34,7 @@ def test_sharded_over_hbm_decode_leg():
     assert "tp" in info  # params actually tp-sharded
 
 
+@pytest.mark.slow
 def test_plan_infer_report_70b():
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     from bench import plan_infer_report

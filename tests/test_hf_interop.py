@@ -88,6 +88,7 @@ def hf_t5_checkpoint(tmp_path_factory):
     return hf_model, path
 
 
+@pytest.mark.slow
 def test_hf_t5_key_map_covers_names(hf_t5_checkpoint):
     from accelerate_tpu.models.hf_interop import hf_t5_key_map
 
@@ -97,6 +98,7 @@ def test_hf_t5_key_map_covers_names(hf_t5_checkpoint):
         assert mapped is None or mapped.startswith("params."), (name, mapped)
 
 
+@pytest.mark.slow
 def test_hf_t5_logits_parity(hf_t5_checkpoint):
     """Golden parity vs transformers.T5ForConditionalGeneration: encoder,
     decoder, cross attention, relative-position bias, untied head."""
@@ -152,6 +154,7 @@ def hf_bert_checkpoint(tmp_path_factory):
     return hf_model, path
 
 
+@pytest.mark.slow
 def test_hf_bert_logits_parity(hf_bert_checkpoint):
     """Golden parity vs transformers.BertForSequenceClassification —
     including the token-type-embedding fold into positions."""

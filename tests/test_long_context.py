@@ -55,6 +55,7 @@ def test_flash_grads_match_native():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_flash_non_divisible_seq_len():
     """Sequence lengths not divisible by the block size must still be exact
     (padded tile rows/cols are masked, not garbage): fwd + both bwd kernels."""
@@ -180,6 +181,7 @@ def test_cross_rank_token_mean(sp_mesh):
     assert out == pytest.approx(float(jnp.mean(loss)))
 
 
+@pytest.mark.slow
 def test_flash_gqa_grads_no_repeat():
     """GQA path: dk/dv come back at kv-head shape (group-summed in-kernel)."""
     rng = np.random.default_rng(5)
@@ -196,6 +198,7 @@ def test_flash_gqa_grads_no_repeat():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_flash_segment_ids_in_kernel():
     """Packed sequences run inside the fused kernel (no native fallback):
     cross-segment attention masked in fwd and all three grads."""
@@ -403,6 +406,7 @@ def test_ulysses_flash_inner_matches_native(sp_mesh, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_model_level_ulysses_matches_native():
     """attn_implementation='ulysses' (the config-name entry added for sp×tp
     composition) produces native-attention logits under an active sp mesh —

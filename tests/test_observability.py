@@ -53,7 +53,14 @@ def test_multiprocess_logger(caplog):
     assert any("hello" in r.message for r in caplog.records)
 
 
-def test_find_executable_batch_size():
+def test_find_executable_batch_size(monkeypatch):
+    # stub the real cache clear: wiping the global jit cache mid-suite makes
+    # every later test recompile (measured ~11 s of collateral); asserting
+    # the call count keeps the behavior pinned without the poison
+    cleared = []
+    from accelerate_tpu.utils import memory as memory_mod
+
+    monkeypatch.setattr(memory_mod.jax, "clear_caches", lambda: cleared.append(1))
     attempts = []
 
     @find_executable_batch_size(starting_batch_size=64)
@@ -65,6 +72,7 @@ def test_find_executable_batch_size():
 
     assert train() == 16
     assert attempts == [64, 32, 16]
+    assert len(cleared) == 2  # one clear per OOM retry
 
 
 def test_find_executable_batch_size_non_oom_propagates():
