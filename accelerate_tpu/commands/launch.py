@@ -64,6 +64,10 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--debug", action="store_true", help="ACCELERATE_DEBUG_MODE collective shape checks.")
     parser.add_argument("--num_cpu_devices", type=int, default=None,
                         help="Virtual CPU devices per process (XLA_FLAGS host platform device count).")
+    parser.add_argument("--enable_cpu_affinity", action="store_true",
+                        help="Partition host CPU cores across co-located ranks (reference "
+                             "--enable_cpu_affinity; useful for local CPU gangs and "
+                             "multi-socket hosts, never needed on a standard TPU VM).")
     # parallelism axes
     for flag in _PARALLEL_FLAGS:
         parser.add_argument(f"--{flag}", type=int, default=None)
@@ -100,6 +104,10 @@ def _merge_args_into_config(args, config: LaunchConfig) -> LaunchConfig:
         config.use_cpu = True
     if args.debug:
         config.debug = True
+    if getattr(args, "enable_cpu_affinity", False):
+        # rides the free-form env passthrough (config_env forwards it);
+        # PartialState consumes it at init (reference state.py:314)
+        config.env["ACCELERATE_CPU_AFFINITY"] = "1"
     return config
 
 

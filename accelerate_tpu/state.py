@@ -100,6 +100,21 @@ class PartialState:
             return
         init_pg_kwargs = kwargs.pop("init_process_group_kwargs", None)
         self.debug = parse_flag_from_env("ACCELERATE_DEBUG_MODE")
+        if parse_flag_from_env("ACCELERATE_CPU_AFFINITY"):
+            # opt-in (reference state.py:314).  MUST run before the first
+            # backend touch: XLA's thread pools inherit the calling thread's
+            # mask only at spawn, so the rank/world come from the launcher's
+            # env, not from jax.  Only co-located ranks partition — on a real
+            # pod (TPU_WORKER_ID set, one process per host) every host owns
+            # all of its cores and there is nothing to split.
+            from .utils.environment import get_int_from_env, set_cpu_affinity
+
+            _n_local = get_int_from_env(["ACCELERATE_NUM_PROCESSES"], 1)
+            if _n_local > 1 and not os.environ.get("TPU_WORKER_ID"):
+                set_cpu_affinity(
+                    get_int_from_env(["ACCELERATE_PROCESS_ID"], 0),
+                    total_local_processes=_n_local,
+                )
         if cpu or parse_flag_from_env("ACCELERATE_USE_CPU"):
             jax.config.update("jax_platforms", "cpu")
         _maybe_init_jax_distributed(init_pg_kwargs)

@@ -154,3 +154,45 @@ def check_port_in_use(port: int, host: str = "localhost") -> bool:
             return False
         except OSError:
             return True
+
+
+@lru_cache
+def set_cpu_affinity(local_process_index: int, total_local_processes: int | None = None,
+                     verbose: bool | None = None) -> None:
+    """Partition the host's CPU cores across co-located ranks (reference
+    ``set_numa_affinity`` utils/environment.py:323 + thread pinning
+    state.py:266-281 — minus the GPU-NUMA lookup, which has no TPU analog).
+
+    Why this is NOT on the default launch path: a Cloud TPU host runs ONE
+    training process that owns the whole (single-socket) VM, and the TPU
+    runtime manages its own thread pools — there is no contended NUMA
+    boundary to pin across, so pinning can only take cores away.  The two
+    real uses are (a) the local CPU-gang rehearsal mode, where N spawned
+    ranks otherwise thrash each other's caches, and (b) multi-socket custom
+    hosts feeding host-side dataloader workers / the C++ staging ring, where
+    the caller knows the topology.  Both opt in explicitly (or via
+    ``ACCELERATE_CPU_AFFINITY=1``, the reference's env knob).
+
+    Cached per process index; no-op on platforms without
+    ``os.sched_setaffinity`` (macOS).
+    """
+    if not hasattr(os, "sched_setaffinity"):
+        return
+    cores = sorted(os.sched_getaffinity(0))
+    n = max(total_local_processes or get_int_from_env(["ACCELERATE_NUM_PROCESSES"], 1), 1)
+    idx = local_process_index % n
+    # striped assignment: cores[idx::n] distributes any remainder (no
+    # stranded tail cores) and keeps ranks disjoint; with more ranks than
+    # cores the overflow ranks degrade to one (shared) core each instead of
+    # grabbing the whole mask back
+    mine = cores[idx::n] if idx < len(cores) else []
+    if not mine:
+        mine = [cores[idx % len(cores)]]
+    os.sched_setaffinity(0, mine)
+    if verbose or (verbose is None and parse_flag_from_env("ACCELERATE_DEBUG_MODE")):
+        from ..logging import get_logger
+
+        get_logger(__name__).info(
+            "Pinned process %d to %d/%d cpu cores: %s",
+            local_process_index, len(mine), len(cores), mine,
+        )

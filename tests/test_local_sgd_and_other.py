@@ -190,3 +190,44 @@ def test_rich_helpers(monkeypatch):
         assert rich_mod.install_rich_tracebacks() is True
         console = rich_mod.get_console()
         assert hasattr(console, "print")
+
+
+def test_set_cpu_affinity_partitions_cores(monkeypatch):
+    """Minimal NUMA/affinity analog (reference set_numa_affinity
+    environment.py:323): co-located ranks split the visible cores without
+    overlap; rank index wraps; no-op without sched_setaffinity."""
+    import os
+
+    from accelerate_tpu.utils import environment as env_mod
+
+    if not hasattr(os, "sched_setaffinity"):
+        import pytest
+
+        pytest.skip("platform without sched_setaffinity")
+    pinned = {}
+    monkeypatch.setattr(env_mod.os, "sched_getaffinity", lambda pid: set(range(8)))
+    monkeypatch.setattr(env_mod.os, "sched_setaffinity", lambda pid, cores: pinned.update({"cores": sorted(cores)}))
+    monkeypatch.setenv("ACCELERATE_NUM_PROCESSES", "4")
+    env_mod.set_cpu_affinity.cache_clear()
+    # striped: remainder cores distribute, ranks stay disjoint
+    env_mod.set_cpu_affinity(0)
+    assert pinned["cores"] == [0, 4]
+    env_mod.set_cpu_affinity(3)
+    assert pinned["cores"] == [3, 7]
+    env_mod.set_cpu_affinity(5)  # wraps: 5 % 4 = 1
+    assert pinned["cores"] == [1, 5]
+    # more ranks than cores: overflow ranks get ONE shared core, never the
+    # whole mask back
+    env_mod.set_cpu_affinity(10, total_local_processes=16)
+    assert pinned["cores"] == [2]
+    env_mod.set_cpu_affinity.cache_clear()
+
+
+def test_launch_flag_transports_cpu_affinity():
+    from accelerate_tpu.commands.config import LaunchConfig
+    from accelerate_tpu.commands.launch import _merge_args_into_config, launch_command_parser
+    from accelerate_tpu.utils.launch import config_env
+
+    args = launch_command_parser().parse_args(["--enable_cpu_affinity", "x.py"])
+    cfg = _merge_args_into_config(args, LaunchConfig())
+    assert config_env(cfg)["ACCELERATE_CPU_AFFINITY"] == "1"
