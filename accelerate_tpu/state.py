@@ -110,7 +110,8 @@ class PartialState:
             from .utils.environment import get_int_from_env, set_cpu_affinity
 
             _n_local = get_int_from_env(["ACCELERATE_NUM_PROCESSES"], 1)
-            if _n_local > 1 and not os.environ.get("TPU_WORKER_ID"):
+            _on_pod = os.environ.get("TPU_WORKER_ID") or os.environ.get("CLOUD_TPU_TASK_ID")
+            if _n_local > 1 and not _on_pod:
                 set_cpu_affinity(
                     get_int_from_env(["ACCELERATE_PROCESS_ID"], 0),
                     total_local_processes=_n_local,

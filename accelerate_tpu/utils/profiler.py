@@ -158,6 +158,19 @@ class TPUProfiler:
         if not in_active and phase == "active":
             self._open_window(cycle)
 
+    def key_averages(self, device_substr: str = "TPU") -> dict:
+        """Per-op-class device-time shares from the captured trace — the
+        ``torch.profiler`` ``key_averages()`` table analog, decoded from the
+        xplane artifact in-process (``utils/xplane.py``).  Call after the
+        trace window has closed (outside the ``with`` block or after the
+        cycle ended)."""
+        from .xplane import op_class_breakdown
+
+        base = self._handler.output_trace_dir
+        if base is None:
+            raise ValueError("key_averages needs output_trace_dir (no trace was captured)")
+        return op_class_breakdown(base, device_substr)
+
     def flops_estimate(self, fn, *args, **kwargs) -> float:
         """FLOPs of one call of a jittable ``fn`` at these arguments, from
         XLA's compiled-executable cost analysis; accumulates into

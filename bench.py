@@ -389,6 +389,10 @@ def main():
     ap.add_argument("--no-selftest", action="store_true",
                     help="skip the on-chip flash-vs-native parity check")
     ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--trace", default=None, metavar="DIR",
+                    help="capture an xplane trace of 2 post-warmup steps into DIR and "
+                         "report the per-op-class device-time breakdown (the MFU "
+                         "attribution table; utils/xplane.py decodes it in-process)")
     ap.add_argument("--scan-block", type=int, default=None,
                     help="override scan_block_size (layers per scan iteration)")
     ap.add_argument("--precision", choices=["bf16", "fp8"], default="bf16",
@@ -630,6 +634,22 @@ def main():
     for _ in range(2):
         state, metrics = step(state, b)
         float(metrics["loss"])
+
+    if args.trace:
+        # separate from the timed loop: tracing costs a few % and the
+        # attribution wants clean shares, not a perturbed headline number
+        jax.profiler.start_trace(args.trace)
+        for _ in range(2):
+            state, metrics = step(state, b)
+        float(metrics["loss"])
+        jax.profiler.stop_trace()
+        from accelerate_tpu.utils.xplane import op_class_breakdown, top_ops
+
+        dev_substr = "TPU" if on_tpu else "CPU"
+        extra_report["op_breakdown"] = op_class_breakdown(args.trace, dev_substr)
+        extra_report["top_ops"] = [
+            (name, round(ms, 2)) for name, ms in top_ops(args.trace, 12, dev_substr)
+        ]
 
     t0 = time.perf_counter()
     for _ in range(iters):

@@ -442,3 +442,36 @@ def test_profile_memory_and_flops():
     assert profiler.summary["flops"] == flops
     mem = profiler.summary["memory"]
     assert {"bytes_in_use", "bytes_delta", "peak_bytes_in_use", "bytes_limit"} <= set(mem)
+
+
+def test_profiler_key_averages_from_trace(tmp_path):
+    """key_averages (torch profiler table analog): capture a real trace,
+    decode the xplane artifact in-process, shares sum to 1."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+    from accelerate_tpu.utils.profiler import TPUProfiler
+
+    f = jax.jit(lambda x: jnp.tanh(x @ x).sum())
+    x = np.ones((256, 256), np.float32)
+    float(f(x))  # compile outside the window
+    prof = TPUProfiler(ProfileKwargs(output_trace_dir=str(tmp_path)))
+    prof._enter()
+    for _ in range(2):
+        float(f(x))
+    prof._exit()
+    table = prof.key_averages(device_substr="CPU")
+    assert table["_total_ms"] > 0
+    classes = {k: v for k, v in table.items() if not k.startswith("_")}
+    assert classes, "no op classes decoded"
+    assert abs(sum(v["share"] for v in classes.values()) - 1.0) < 0.02
+    assert all(v["ms"] >= 0 for v in classes.values())
+
+
+def test_key_averages_without_trace_dir_raises():
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+    from accelerate_tpu.utils.profiler import TPUProfiler
+
+    prof = TPUProfiler(ProfileKwargs())
+    with pytest.raises(ValueError, match="output_trace_dir"):
+        prof.key_averages()
