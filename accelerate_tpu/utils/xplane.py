@@ -227,6 +227,17 @@ def classify_op(name: str) -> str:
     if any(k in low for k in ("all-reduce", "all-gather", "reduce-scatter",
                               "all-to-all", "collective-permute")):
         return "collective"
+    full = name.lower()
+    if low.startswith("call"):
+        # XLA host-compute regions (compute_on("device_host")) surface as
+        # call / call-start / call-done spans whose operand layouts carry
+        # host-space markers (S(5) memory space / L(...) linear layouts) —
+        # at 7B-offload these ARE the step (the chunked host optimizer
+        # update + its PCIe transfers).  Device-side call subcomputations
+        # (no host markers) stay out of the host bucket.
+        if "s(5)" in full or "l(1024)" in full:
+            return "host_compute"
+        return "other"
     if low.startswith(("copy", "send", "recv", "infeed", "outfeed")):
         return "copy"
     if low.startswith("while"):

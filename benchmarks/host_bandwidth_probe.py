@@ -1,0 +1,46 @@
+"""Host memory bandwidth on THIS TPU-VM host — the denominator of the
+7B-offload accounting (docs/performance.md).  The offloaded lion update is
+host-side streaming arithmetic over the fp32 masters + bf16 momentum/grads;
+its floor is host DRAM bandwidth, measured here STREAM-style with numpy
+(copy and triad over 1 GiB operands), plus a pinned-host<->device move is
+measured separately by pcie_probe.py."""
+
+import json
+import time
+
+import numpy as np
+
+
+def _bw(fn, bytes_moved, iters=6):
+    fn()  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    dt = time.perf_counter() - t0
+    return bytes_moved * iters / dt / 2**30
+
+
+def main():
+    n = 256 * 1024 * 1024  # 1 GiB fp32
+    a = np.ones(n, np.float32)
+    b = np.ones(n, np.float32)
+    c = np.empty(n, np.float32)
+    out = {
+        # copy: read 4B + write 4B per element
+        "copy_gib_s": round(_bw(lambda: np.copyto(c, a), 8 * n), 2),
+        # triad a = b + 0.5*c: read 8B + write 4B
+        "triad_gib_s": round(_bw(lambda: np.add(b, 0.5 * c, out=a), 16 * n), 2),
+    }
+    # the lion-shaped op: sign(momentum-combined) applied to fp32 master
+    m = np.ones(n // 2, np.float16)  # stand-in for bf16 momentum width
+
+    def lion_like():
+        np.subtract(a[: n // 2], 1e-4 * np.sign(m, dtype=np.float16).astype(np.float32),
+                    out=a[: n // 2])
+
+    out["lion_like_gib_s"] = round(_bw(lion_like, (4 + 2 + 4) * (n // 2)), 2)
+    print(json.dumps({"metric": "host_memory_bandwidth", "unit": "GiB/s", **out}))
+
+
+if __name__ == "__main__":
+    main()

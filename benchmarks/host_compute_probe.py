@@ -1,0 +1,65 @@
+"""Measured throughput of an XLA host-compute region on the REAL worker
+host — the denominator of the 7B-offload accounting.
+
+The 7B step's host region performs a lion-shaped streaming update over the
+pinned-host masters/momentum; this probe times the same op shape (read
+fp32 master + bf16 momentum + bf16 grad, write fp32 master + bf16
+momentum) over a 1 GiB master tree as a whole program, giving effective
+GiB/s of the worker host's memory system under XLA host compute.  (A numpy
+STREAM on the *operator* box measures the wrong machine — under axon the
+host regions execute on the remote TPU-VM host.)"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.compute_on import compute_on
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def main():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("d",))
+    host = NamedSharding(mesh, P(), memory_kind="pinned_host")
+    dev = NamedSharding(mesh, P(), memory_kind="device")
+    n = 256 * 1024 * 1024  # 1 GiB fp32 master
+    master = jax.device_put(jnp.zeros((n,), jnp.float32), host)
+    mom = jax.device_put(jnp.zeros((n,), jnp.bfloat16), host)
+    grad = jax.device_put(jnp.ones((n,), jnp.bfloat16), host)
+
+    @jax.jit
+    def host_lion(master, mom, grad, salt):
+        with compute_on("device_host"):
+            g = grad.astype(jnp.float32) + salt  # varying input defeats caching
+            m = mom.astype(jnp.float32)
+            new_master = master - 1e-4 * jnp.sign(0.9 * m + 0.1 * g)
+            new_mom = (0.99 * m + 0.01 * g).astype(jnp.bfloat16)
+            checksum = new_master[0] + new_master[-1]
+        return (
+            jax.device_put(new_master, host),
+            jax.device_put(new_mom, host),
+            jax.device_put(checksum, dev),
+        )
+
+    salt0 = jax.device_put(jnp.float32(0.0), host)
+    master, mom, cs = host_lion(master, mom, grad, salt0)  # compile + warm
+    float(cs)
+    iters = 4
+    t0 = time.perf_counter()
+    for i in range(iters):
+        salt = jax.device_put(jnp.float32(i + 1.0), host)
+        master, mom, cs = host_lion(master, mom, grad, salt)
+        float(cs)  # scalar fetch sync
+    dt = time.perf_counter() - t0
+    bytes_per = n * (4 + 2 + 2 + 4 + 2)  # r master+mom+grad, w master+mom
+    print(json.dumps({
+        "metric": "worker_host_compute_bandwidth",
+        "unit": "GiB/s",
+        "lion_like_gib_s": round(bytes_per * iters / dt / 2**30, 2),
+        "secs_per_gib_master": round(dt / iters, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Compile-only HBM accounting for the long-context configs: lowers the full
+train step at a given sequence length and prints XLA's compiled memory
+analysis (temp/argument/output bytes).  This is the arithmetic behind the
+112k-works / 131k-crashes cliff in docs/long_context.md — no execution, so
+it is safe at lengths that crash the worker at run time."""
+
+import argparse
+import json
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-len", type=int, required=True)
+    ap.add_argument("--scan-block", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
+
+    seq = args.seq_len
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=seq, attn_implementation="flash",
+        remat=True, dtype=jnp.bfloat16,
+        remat_policy="offload" if seq > 98304 else "full",
+        scan_layers=seq > 98304,
+        scan_block_size=(args.scan_block or (2 if seq > 114688 else 1)) if seq > 98304 else 1,
+    )
+    model = LlamaForCausalLM(cfg)
+    acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=jax.device_count()),
+                      mixed_precision="bf16")
+    ids = jnp.ones((1, seq), jnp.int32)
+    params = acc.init_params(model, jax.random.key(0), ids[:, :8])
+    tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
+    state = acc.create_train_state(params, tx, apply_fn=model.apply)
+    chunks = max(16, seq // 2048)
+    step = acc.prepare_train_step(make_llama_loss_fn(model, fused_vocab_chunks=chunks))
+    batch = {"input_ids": ids, "labels": ids}
+    # prepare_train_step exposes its jitted core as step._jitted
+    compiled = step._jitted.lower(state, batch).compile()
+    ma = compiled.memory_analysis()
+    fields = {}
+    for k in ("temp_size_in_bytes", "argument_size_in_bytes", "output_size_in_bytes",
+              "alias_size_in_bytes", "generated_code_size_in_bytes", "host_temp_size_in_bytes"):
+        v = getattr(ma, k, None)
+        if v is not None:
+            fields[k] = int(v)
+    live = fields.get("temp_size_in_bytes", 0) + fields.get("argument_size_in_bytes", 0) \
+        + fields.get("output_size_in_bytes", 0) - fields.get("alias_size_in_bytes", 0)
+    print(json.dumps({
+        "metric": "longctx_compiled_memory", "seq_len": seq,
+        "scan_block": cfg.scan_block_size, **fields,
+        "peak_estimate_gib": round(live / 2**30, 2),
+        "hbm_gib": round((jax.devices()[0].memory_stats() or {}).get("bytes_limit", 0) / 2**30, 2)
+        if getattr(jax.devices()[0], "memory_stats", lambda: None)() else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Measured host<->device bandwidth on THIS chip environment — the number the
+7B offload accounting multiplies bytes by (docs/performance.md).  Whole-
+program measurement per the microbench rules (vary inputs, scalar-fetch
+sync); prints one JSON line."""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def main():
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("d",))
+    host = NamedSharding(mesh, P(), memory_kind="pinned_host")
+    dev = NamedSharding(mesh, P(), memory_kind="device")
+    n = 512 * 1024 * 1024  # 1 GiB of bf16
+    out = {}
+
+    @jax.jit
+    def bump(x):
+        return x + jnp.bfloat16(1.0)
+
+    for name, src_sh, dst_sh in (("h2d", host, dev), ("d2h", dev, host)):
+        x = jax.device_put(jnp.zeros((n,), jnp.bfloat16), src_sh)
+
+        @jax.jit
+        def move(v):
+            return jax.device_put(v, dst_sh)
+
+        move(x)  # compile + warm
+        iters = 8
+        t0 = time.perf_counter()
+        for i in range(iters):
+            x = jax.device_put(bump(x), src_sh) if name == "h2d" else x
+            y = move(x)
+            jax.block_until_ready(y)
+        dt = time.perf_counter() - t0
+        gib = 2 * n / 2**30
+        out[name + "_gib_s"] = round(gib * iters / dt, 2)
+    print(json.dumps({"metric": "pcie_bandwidth", "unit": "GiB/s", **out}))
+
+
+if __name__ == "__main__":
+    main()
