@@ -53,7 +53,8 @@ def abstract_init(module, rng, *sample_args, **sample_kwargs):
     return jax.eval_shape(lambda: module.init(rng, *sample_args, **sample_kwargs))
 
 
-def init_params_leafwise(model, accelerator, sample_ids, *, scale: float = 0.02):
+def init_params_leafwise(model, accelerator, sample_ids, *, scale: float = 0.02,
+                         dtype=None):
     """Materialize params leaf-by-leaf straight into their planned shards —
     peak device memory is one leaf, like the streaming checkpoint loader.
 
@@ -70,6 +71,14 @@ def init_params_leafwise(model, accelerator, sample_ids, *, scale: float = 0.02)
     from .parallel.sharding import host_offload_supported, host_plan, path_str
 
     abstract = jax.eval_shape(lambda: model.init(jax.random.key(0), sample_ids))
+    if dtype is not None:
+        # storage-dtype override: bf16 "masters" for the stochastic-rounding
+        # optimizer path (halves the host/PCIe bytes of every param leaf)
+        abstract = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, dtype)
+            if jnp.issubdtype(s.dtype, jnp.floating) else s,
+            abstract,
+        )
     plan = accelerator._params_plan(abstract)
     if accelerator._offload_flags()[1] and host_offload_supported():
         plan = host_plan(plan)

@@ -1,0 +1,190 @@
+"""Stochastic rounding + the bf16-master lion optimizer.
+
+The 7B host-offload step is host-DRAM-bound and its dominant traffic is
+the fp32 master r/w (54 GB of the ~108 GB/step — docs/performance.md "The
+7B-offload ceiling, accounted").  Keeping masters in bf16 halves that, but
+plain bf16 masters diverge: with lion's tiny updates (|Δ| = lr) the
+nearest-even round kills every update smaller than half a bf16 ulp of the
+weight.  **Stochastic rounding** makes the round unbiased
+(E[round(x)] = x), which is why bf16-master + SR training matches fp32
+masters in practice (Gupta et al. 2015; standard on large TPU runs).
+
+``lion_bf16_sr`` is an optax-compatible transform whose ``update`` is
+per-leaf independent elementwise math — the exact contract the chunked
+host-compute update region requires (accelerator.py
+``host_update_chunk_gib``): no cross-leaf stats, deterministic key
+derivation from a carried counter (no host RNG state).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def stochastic_round_to_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """Round fp32 ``x`` to bf16, randomly up/down with probability equal to
+    the fractional position between the two neighboring bf16 values —
+    unbiased: ``E[result] = x`` (up to fp32 arithmetic).
+
+    Implementation: add uniform noise over the truncation gap to the fp32
+    bit pattern, then truncate the mantissa (round-to-negative-infinity in
+    magnitude after the add == stochastic round).  bf16 keeps the top 16
+    bits of the fp32 pattern, so the gap is the low 16 bits.
+    """
+    x = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.randint(
+        key, x.shape, 0, 1 << 16, dtype=jnp.uint32
+    )
+    rounded = jax.lax.bitcast_convert_type(bits + noise, jnp.float32)
+    # truncation of the low 16 bits == bf16 conversion of the bumped value
+    return jax.lax.convert_element_type(
+        jax.lax.bitcast_convert_type(
+            jax.lax.bitcast_convert_type(rounded, jnp.uint32) & jnp.uint32(0xFFFF0000),
+            jnp.float32,
+        ),
+        jnp.bfloat16,
+    )
+
+
+def stochastic_round_to_bf16_hashed(x: jax.Array, salt: jax.Array,
+                                    consts: Optional[dict] = None,
+                                    entropy: Optional[jax.Array] = None) -> jax.Array:
+    """Stochastic round via a murmur-style hash of the value bits, a
+    per-(step, leaf) ``salt``, and optional per-element ``entropy`` (the
+    gradient, in the optimizer) — the host-region-safe variant.
+
+    ``jax.random`` cannot run inside ``compute_on("device_host")``: its
+    internal literal constants are device-space and elementwise ops reject
+    mixed memory spaces (observed on v5e at 7B).  Hashing the fp32 bit
+    pattern with traced scalars uses only elementwise ops, and when
+    ``consts`` carries the hash constants as *traced* scalars (see
+    ``lion_bf16_sr``) no literal-born full-leaf broadcast is materialized
+    in the host region either.  ``entropy`` decorrelates elements whose
+    values coincide (an all-equal leaf would otherwise round in lockstep);
+    with both value and entropy constant across a leaf the noise is shared
+    — unbiasedness per element still holds, only spatial variance grows.
+    """
+    c = consts or {}
+    m1 = c.get("m1", jnp.uint32(0x9E3779B1))
+    m2 = c.get("m2", jnp.uint32(0x85EBCA77))
+    s16 = c.get("s16", jnp.uint32(16))
+    s13 = c.get("s13", jnp.uint32(13))
+    mask16 = c.get("mask16", jnp.uint32(0xFFFF))
+    hi16 = c.get("hi16", jnp.uint32(0xFFFF0000))
+
+    x = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    h = bits ^ salt.astype(jnp.uint32)
+    if entropy is not None:
+        e = jax.lax.bitcast_convert_type(entropy.astype(jnp.float32), jnp.uint32)
+        h = h ^ (e * m2)
+    h = h * m1
+    h = h ^ (h >> s16)
+    h = h * m2
+    h = h ^ (h >> s13)
+    noise = h & mask16
+    bumped = bits + noise
+    return jax.lax.convert_element_type(
+        jax.lax.bitcast_convert_type(bumped & hi16, jnp.float32), jnp.bfloat16
+    )
+
+
+class LionSRState(NamedTuple):
+    count: jax.Array  # step counter; folds into the per-leaf SR key
+    mu: optax.Updates  # bf16 momentum
+    # hyperparams ride the state as TRACED scalars: under the XLA host-
+    # compute lowering a *literal* scalar materializes as a full-leaf-size
+    # fp32 broadcast (measured OOM at 7B — same issue inject_hyperparams
+    # solves for the stock optimizers, bench.py 7B notes).  A dict, not a
+    # tuple: the chunked host update slices params-congruent subtrees by
+    # tree structure, and a 4-tuple could false-match a 4-leaf group.
+    hyperparams: dict
+
+
+def lion_bf16_sr(
+    learning_rate: float = 1e-4,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    weight_decay: float = 0.0,
+    seed: int = 0,
+) -> optax.GradientTransformation:
+    """Lion whose *parameters themselves* stay bf16 (no fp32 master tree).
+
+    Math runs in fp32 transiently per leaf; the new weight is written back
+    with stochastic rounding, so the expected update survives even when
+    ``lr`` is below the local bf16 ulp.  State is the bf16 momentum plus a
+    step counter (keys derive deterministically: fold_in(count, leaf_idx)
+    — bit-exact resume without RNG state in the checkpoint).
+
+    Use with ``mixed_precision="bf16"`` and bf16 params: vs
+    ``optax.lion(mu_dtype=bfloat16)`` over fp32 masters, host/HBM bytes
+    per step drop from 14 B/param to 8 B/param.
+    """
+
+    def init(params):
+        hyper = {
+            k: jnp.float32(v)
+            for k, v in (("lr", learning_rate), ("b1", b1), ("b2", b2),
+                         ("wd", weight_decay))
+        }
+        # hash/mask constants ride the state as traced uint32 scalars too:
+        # inside the host region a LITERAL scalar materializes as a
+        # full-leaf-size broadcast (hoisted = resident, unhoisted = OOM —
+        # bench.py 7B notes), a traced host scalar broadcasts for free
+        hyper.update({
+            "seed": jnp.uint32(seed),
+            "m1": jnp.uint32(0x9E3779B1), "m2": jnp.uint32(0x85EBCA77),
+            "s16": jnp.uint32(16), "s13": jnp.uint32(13),
+            "mask16": jnp.uint32(0xFFFF), "hi16": jnp.uint32(0xFFFF0000),
+        })
+        return LionSRState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.bfloat16), params),
+            hyperparams=hyper,
+        )
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("lion_bf16_sr is a weight update: pass params")
+        hp = state.hyperparams
+        lr_t, b1_t, b2_t, wd_t = hp["lr"], hp["b1"], hp["b2"], hp["wd"]
+        count = state.count + 1
+        # per-step scalar base salt (all scalar math — no leaf-size tensors)
+        base_salt = (count.astype(jnp.uint32) + jnp.uint32(1)) * hp["m1"] ^ hp["seed"]
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        p_leaves = treedef.flatten_up_to(params)
+        m_leaves = treedef.flatten_up_to(state.mu)
+        new_p, new_m = [], []
+        for i, (g, p, m) in enumerate(zip(leaves, p_leaves, m_leaves)):
+            g32 = g.astype(jnp.float32)
+            m32 = m.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            direction = jnp.sign(b1_t * m32 + (1.0 - b1_t) * g32)
+            step = lr_t * (direction + wd_t * p32)
+            # leaf-distinct salt; i is group-relative under the chunked host
+            # update, so the leaf size folds in as a stable-ish identity
+            salt = base_salt ^ jnp.uint32((i * 2654435761 + p.size) & 0xFFFFFFFF)
+            new_p.append(stochastic_round_to_bf16_hashed(p32 - step, salt, hp, entropy=g32))
+            new_m.append((b2_t * m32 + (1.0 - b2_t) * g32).astype(jnp.bfloat16))
+        # optax contract: return the DELTA.  It stays fp32: the difference
+        # of two bf16 values is exact in fp32 (both have 8-bit mantissas and
+        # a lion step keeps their exponents close), and optax.apply_updates
+        # computes p + u in the promoted dtype before casting back to
+        # p.dtype — so the stochastically-rounded weight is reconstructed
+        # bit-for-bit.  A bf16 delta would round a second time.
+        deltas = [
+            np_.astype(jnp.float32) - p.astype(jnp.float32)
+            for np_, p in zip(new_p, p_leaves)
+        ]
+        return (
+            jax.tree_util.tree_unflatten(treedef, deltas),
+            LionSRState(count=count, mu=jax.tree_util.tree_unflatten(treedef, new_m),
+                        hyperparams=hp),
+        )
+
+    return optax.GradientTransformation(init, update)

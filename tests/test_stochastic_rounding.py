@@ -1,0 +1,131 @@
+"""bf16-master lion with stochastic rounding (ops/stochastic_rounding.py) —
+the 7B host-offload traffic lever (docs/performance.md).  Pins: the round is
+unbiased, survives sub-ulp updates that nearest-even kills, reconstructs
+bit-exactly through optax.apply_updates, and tracks fp32-master lion."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.ops.stochastic_rounding import (
+    lion_bf16_sr,
+    stochastic_round_to_bf16,
+)
+
+
+def test_sr_is_unbiased_and_bounded():
+    """E[SR(x)] = x; every sample is one of the two neighboring bf16s."""
+    x = jnp.float32(1.0 + 1.0 / 512.0)  # sits strictly between bf16 neighbors
+    lo, hi = jnp.bfloat16(1.0), jnp.bfloat16(1.0078125)
+    keys = jax.random.split(jax.random.key(0), 4096)
+    samples = jax.vmap(lambda k: stochastic_round_to_bf16(x, k))(keys)
+    vals = np.asarray(samples, np.float32)
+    assert set(np.unique(vals)) <= {float(lo), float(hi)}
+    # fractional position of x in [lo, hi] is the expected P(hi)
+    frac = (float(x) - float(lo)) / (float(hi) - float(lo))
+    p_hi = float((vals == float(hi)).mean())
+    assert abs(p_hi - frac) < 0.03, (p_hi, frac)
+    mean = float(vals.mean())
+    assert abs(mean - float(x)) < 2e-4, (mean, float(x))
+
+
+def test_sr_exact_values_pass_through():
+    """Values already representable in bf16 never move."""
+    xs = jnp.float32(np.array([0.0, 1.0, -2.5, 384.0, 1e-3]))
+    for i in range(8):
+        out = stochastic_round_to_bf16(xs, jax.random.key(i))
+        np.testing.assert_array_equal(
+            np.asarray(out, np.float32), np.asarray(xs.astype(jnp.bfloat16), np.float32)
+        )
+
+
+def test_hashed_sr_is_unbiased_over_salts():
+    """The host-region-safe hashed variant: over many salts, E[SR(x)] = x
+    and P(up) equals the fractional position."""
+    from accelerate_tpu.ops.stochastic_rounding import stochastic_round_to_bf16_hashed
+
+    x = jnp.float32(1.0 + 1.0 / 512.0)
+    lo, hi = 1.0, 1.0078125
+    salts = jnp.arange(4096, dtype=jnp.uint32) * jnp.uint32(0x9E3779B1)
+    samples = jax.vmap(lambda s: stochastic_round_to_bf16_hashed(x, s))(salts)
+    vals = np.asarray(samples, np.float32).reshape(-1)
+    assert set(np.unique(vals)) <= {lo, hi}
+    frac = (float(x) - lo) / (hi - lo)
+    p_hi = float((vals == hi).mean())
+    assert abs(p_hi - frac) < 0.03, (p_hi, frac)
+
+
+def test_sub_ulp_updates_survive_on_average():
+    """lr far below the weight's bf16 ulp: nearest-even would freeze the
+    weight forever; SR moves it by the right amount in expectation.  Grads
+    vary per lane (the entropy channel) as in any real training step."""
+    w = jnp.full((4096,), 1.0, jnp.bfloat16)  # ulp(1.0) = 1/128 in bf16
+    lr = 1e-4  # ~77x below half-ulp
+    tx = lion_bf16_sr(learning_rate=lr, b1=0.9, b2=0.99)
+    params = {"w": w}
+    state = tx.init(params)
+    rng = np.random.default_rng(0)
+    for _ in range(100):
+        # positive, per-lane-distinct gradients: sign(update) stays +1
+        g = {"w": jnp.asarray(rng.uniform(0.5, 1.5, (4096,)).astype(np.float32))}
+        updates, state = tx.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+    drift = 1.0 - float(np.asarray(params["w"], np.float32).mean())
+    # expected drift after 100 steps of -lr: 0.01; SR noise averages out
+    # across 4096 lanes
+    assert 0.007 < drift < 0.013, drift
+
+
+def test_apply_updates_reconstructs_bitwise():
+    """The fp32 delta through optax.apply_updates lands exactly on the
+    stochastically rounded weight (no second rounding)."""
+    key = jax.random.key(3)
+    p = {"w": jax.random.normal(key, (512,), jnp.float32).astype(jnp.bfloat16)}
+    g = {"w": jax.random.normal(jax.random.fold_in(key, 1), (512,), jnp.float32)}
+    tx = lion_bf16_sr(learning_rate=3e-3)
+    state = tx.init(p)
+    updates, state = tx.update(g, state, p)
+    applied = optax.apply_updates(p, updates)
+    # reconstruct what update() rounded to, independently
+    expect = np.asarray(p["w"], np.float32) + np.asarray(updates["w"], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(applied["w"], np.float32), expect.astype(jnp.bfloat16).astype(np.float32)
+    )
+    assert applied["w"].dtype == jnp.bfloat16
+
+
+def test_sr_lion_tracks_fp32_master_lion():
+    """Convergence parity on a regression: bf16-SR masters reach the same
+    loss neighborhood as fp32 masters under plain lion."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    w_true = rng.normal(size=(16,)).astype(np.float32)
+    y = x @ w_true
+
+    def loss_fn(p):
+        return jnp.mean((jnp.asarray(x) @ p["w"].astype(jnp.float32) - jnp.asarray(y)) ** 2)
+
+    def train(tx, w0):
+        params = {"w": w0}
+        state = tx.init(params)
+        for _ in range(400):
+            grads = jax.grad(loss_fn)(params)
+            grads = {"w": grads["w"].astype(jnp.float32)}
+            updates, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        return float(loss_fn(params))
+
+    base = train(optax.lion(3e-3, b1=0.9, b2=0.99, mu_dtype=jnp.bfloat16),
+                 jnp.zeros((16,), jnp.float32))
+    sr = train(lion_bf16_sr(3e-3, b1=0.9, b2=0.99), jnp.zeros((16,), jnp.bfloat16))
+    # same optimizer, quarter the master precision: within a small factor
+    assert sr < max(4 * base, 5e-3), (sr, base)
+
+
+def test_update_requires_params():
+    tx = lion_bf16_sr()
+    state = tx.init({"w": jnp.zeros((4,), jnp.bfloat16)})
+    with pytest.raises(ValueError, match="params"):
+        tx.update({"w": jnp.zeros((4,), jnp.bfloat16)}, state)
