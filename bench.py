@@ -234,10 +234,12 @@ def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool,
     plan = make_sharding_plan(params, mesh, parallelism_config=pcfg)
     p_bytes = plan_bytes_per_device(params, plan)  # fp32 leaves as initialized
     bf16 = p_bytes // 2          # compute copy
-    fp32 = p_bytes               # master
-    # matches the bench's 7b optimizer choice: lion = bf16 momentum only,
-    # adamw = fp32 m + v
-    opt_state = p_bytes // 2 if optimizer == "lion" else 2 * p_bytes
+    # masters: fp32 tree (lion/adamw) or none at all (lion-sr stores the
+    # params themselves in bf16 — the compute copy IS the master)
+    fp32 = 0 if optimizer == "lion-sr" else p_bytes
+    # matches the bench optimizer choices: lion/lion-sr = bf16 momentum
+    # only, adamw = fp32 m + v
+    opt_state = p_bytes // 2 if optimizer in ("lion", "lion-sr") else 2 * p_bytes
     if offload:
         # grads stream D2H as backward produces them (clipping off — see
         # docs/offload.md); resident at once: ~the largest leaf, in bf16
@@ -253,7 +255,9 @@ def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool,
     # plus the flash workspace; fused CE avoids [B, T, V] logits
     act = batch_per_device * seq * cfg.hidden_size * 2 * (cfg.num_hidden_layers + 2)
     hbm = bf16 + grads + act + (0 if offload else fp32 + opt_state)
-    host = (fp32 + opt_state) if offload else 0
+    # offloaded host set: the master tree (bf16 params themselves under
+    # lion-sr) + optimizer state
+    host = ((bf16 if optimizer == "lion-sr" else fp32) + opt_state) if offload else 0
     gib = lambda b: round(b / 2**30, 2)
     return {
         "model": "llama2-7b", "n_devices": n_devices,
@@ -397,11 +401,13 @@ def main():
                     help="override scan_block_size (layers per scan iteration)")
     ap.add_argument("--precision", choices=["bf16", "fp8"], default="bf16",
                     help="mixed_precision for the train step (fp8: scaled-e4m3 matmuls)")
-    ap.add_argument("--optimizer", choices=["lion", "adamw", "lion-sr"], default="lion",
-                    help="7b mode only: lion (bf16 momentum, fp32 masters, ~13.5GiB "
-                         "host state), adamw (full m+v, needs ~67GiB host RAM), or "
-                         "lion-sr (bf16 masters with stochastic rounding — no fp32 "
-                         "master tree; host bytes/step drop from ~16 to ~10 B/param)")
+    ap.add_argument("--optimizer", choices=["lion", "adamw", "lion-sr"], default=None,
+                    help="default lion-sr (bf16 masters with stochastic rounding — "
+                         "no fp32 master tree; the measured-best recipe at every "
+                         "scale: 600m 66.0%% vs 63.0%% MFU, 1b 70.3%% vs 64.9%%, "
+                         "7b 859 vs 602 tok/s — host bytes 16 -> 10 B/param). "
+                         "lion restores fp32 masters + bf16 momentum; adamw (7b: "
+                         "full m+v, needs ~67GiB host RAM).")
     ap.add_argument("--chunk-gib", type=float, default=None,
                     help="host-update chunk size in GiB (bounds the host's transient "
                          "working set; default 1.0 under --offload/7b, 0 = monolithic)")
@@ -410,10 +416,6 @@ def main():
     ap.add_argument("--plan-task", choices=["train", "infer"], default="train",
                     help="--plan flavor: 7B training (default) or sharded 70B inference")
     args = ap.parse_args()
-    if args.optimizer == "lion-sr" and args.model != "7b":
-        # the 1b/600m branches pick their optimizer by a lion/adamw binary;
-        # falling through would silently measure adamw under a lion-sr label
-        ap.error("--optimizer lion-sr is the 7B host-offload recipe (--model 7b)")
 
     if args.plan:
         if args.plan_task == "infer":
@@ -426,7 +428,8 @@ def main():
             print(json.dumps({
                 "metric": "llama2_7b_memory_plan", "value": args.plan, "unit": "devices",
                 "extra": plan_report(args.plan, args.seq_len or 2048, args.batch or 1,
-                                     offload=args.offload, optimizer=args.optimizer),
+                                     offload=args.offload,
+                                     optimizer=args.optimizer or "lion-sr"),
             }))
         return
 
@@ -443,6 +446,23 @@ def main():
     from accelerate_tpu.models.llama import count_params, flops_per_token
 
     on_tpu = jax.default_backend() == "tpu"
+    if args.optimizer is None:
+        # lion-sr measured best at every TPU scale (see --optimizer help);
+        # CPU runs keep the historical recipes (lion at 7b/1b, adamw smoke)
+        args.optimizer = "lion-sr" if on_tpu else "lion"
+
+    def lion_sr_recipe(params):
+        """bf16 masters + stochastic rounding (ops/stochastic_rounding.py):
+        the shared resident-model setup — cast the stored params to bf16
+        (they ARE the masters) and return the SR transform."""
+        from accelerate_tpu.ops.stochastic_rounding import lion_bf16_sr
+
+        cast = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+        return lion_bf16_sr(1e-4, b1=0.9, b2=0.99), cast
     extra_report = {}
     if on_tpu and not args.no_selftest:
         selftest(extra_report)
@@ -462,7 +482,10 @@ def main():
         # 3+ OOMs at every policy with fp32 masters resident)
         seq = args.seq_len or 2048
         cfg = _1b_config(jnp, seq, args.remat or "none")
-        batch = args.batch or 2
+        # lion-sr frees the fp32 master tree (~8GiB with its transients):
+        # batch 3 fits and is the measured sweet spot (70.3% MFU; batch 4
+        # fits too at 70.0%); fp32-master recipes cap at batch 2
+        batch = args.batch or (3 if args.optimizer == "lion-sr" else 2)
         iters = args.iters or 8
     elif on_tpu:
         seq = args.seq_len or 2048
@@ -598,12 +621,22 @@ def main():
         # lion: momentum-only optimizer state (bf16-able) — fp32 masters
         # (5.4GiB) + bf16 momentum (2.7GiB) is the only optimizer budget
         # that leaves room for cheap remat at 1.3B on 16GiB (adamw's fp32
-        # second moment alone adds 5.4GiB, measured OOM at every batch)
-        tx = (optax.lion(1e-4, b1=0.9, b2=0.99, mu_dtype=jnp.bfloat16)
-              if args.optimizer == "lion"
-              else optax.adamw(3e-4, mu_dtype=jnp.bfloat16))
+        # second moment alone adds 5.4GiB, measured OOM at every batch).
+        # lion-sr drops the fp32 masters entirely (params stay bf16 with
+        # stochastic rounding): ~8GiB freed for batch headroom.
+        if args.optimizer == "lion-sr":
+            tx, params = lion_sr_recipe(params)
+        else:
+            tx = (optax.lion(1e-4, b1=0.9, b2=0.99, mu_dtype=jnp.bfloat16)
+                  if args.optimizer == "lion"
+                  else optax.adamw(3e-4, mu_dtype=jnp.bfloat16))
     elif on_tpu:
-        tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
+        if args.optimizer == "lion-sr":
+            tx, params = lion_sr_recipe(params)
+        elif args.optimizer == "lion":
+            tx = optax.lion(1e-4, b1=0.9, b2=0.99, mu_dtype=jnp.bfloat16)
+        else:
+            tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
     else:
         tx = optax.adamw(3e-4)
     state = acc.create_train_state(params, tx, apply_fn=model.apply)
@@ -692,7 +725,7 @@ def main():
         "extra": {
             **extra_report,
             "precision": args.precision,
-            **({"optimizer": args.optimizer} if args.model == "7b" else {}),
+            **({"optimizer": args.optimizer} if on_tpu else {}),
             "mfu": round(mfu, 4),
             "params": count_params(state.params),
             "batch": batch, "seq_len": seq,
