@@ -11,6 +11,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seq-len", type=int, required=True)
     ap.add_argument("--scan-block", type=int, default=None)
+    ap.add_argument("--optimizer", choices=["adamw", "lion-sr"], default="adamw")
     args = ap.parse_args()
 
     import jax
@@ -36,7 +37,15 @@ def main():
                       mixed_precision="bf16")
     ids = jnp.ones((1, seq), jnp.int32)
     params = acc.init_params(model, jax.random.key(0), ids[:, :8])
-    tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
+    if args.optimizer == "lion-sr":
+        from accelerate_tpu.ops.stochastic_rounding import lion_bf16_sr
+
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+        tx = lion_bf16_sr(1e-4, b1=0.9, b2=0.99)
+    else:
+        tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
     state = acc.create_train_state(params, tx, apply_fn=model.apply)
     chunks = max(16, seq // 2048)
     step = acc.prepare_train_step(make_llama_loss_fn(model, fused_vocab_chunks=chunks))
@@ -53,7 +62,7 @@ def main():
     live = fields.get("temp_size_in_bytes", 0) + fields.get("argument_size_in_bytes", 0) \
         + fields.get("output_size_in_bytes", 0) - fields.get("alias_size_in_bytes", 0)
     print(json.dumps({
-        "metric": "longctx_compiled_memory", "seq_len": seq,
+        "metric": "longctx_compiled_memory", "seq_len": seq, "optimizer": args.optimizer,
         "scan_block": cfg.scan_block_size, **fields,
         "peak_estimate_gib": round(live / 2**30, 2),
         "hbm_gib": round((jax.devices()[0].memory_stats() or {}).get("bytes_limit", 0) / 2**30, 2)
