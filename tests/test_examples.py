@@ -96,6 +96,7 @@ def test_complete_nlp_example(tmp_path):
         ("grad_comm_compression.py", "bf16 gradient collectives"),
         ("zero_offload.py", "targets 2, 3"),
         ("fp8_training.py", "fp8 matmuls, bf16 activations"),
+        ("bf16_master_sr.py", "x smaller with SR"),
     ],
 )
 def test_by_feature_examples(script, needle):
