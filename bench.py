@@ -663,7 +663,11 @@ def main():
     # regardless of grad magnitude, so the clip would change only the
     # momentum accumulation while costing a measured 9% step time (the
     # barrier blocks the update from overlapping the tail of backward).
-    max_norm = None if args.model in ("7b", "1b") else 1.0
+    # The same argument applies to any lion-family optimizer at any scale
+    # (incl. the long-context 600m configs, where the barrier also pins
+    # the whole grad tree across the scanned stack).
+    max_norm = (None if args.model in ("7b", "1b")
+                or args.optimizer in ("lion", "lion-sr") else 1.0)
     if args.clip >= 0:
         max_norm = args.clip or None
     step = acc.prepare_train_step(
