@@ -1,4 +1,4 @@
-"""Stochastic rounding + the bf16-master lion optimizer.
+"""Stochastic rounding + the bf16-master lion and adamw optimizers.
 
 The 7B host-offload step is host-DRAM-bound and its dominant traffic is
 the fp32 master r/w (54 GB of the ~108 GB/step — docs/performance.md "The
@@ -94,6 +94,44 @@ def stochastic_round_to_bf16_hashed(x: jax.Array, salt: jax.Array,
     )
 
 
+def _sr_hash_consts(seed: int) -> dict:
+    """The shared deterministic-SR key material, as traced uint32 scalars
+    (inside a host region a LITERAL scalar materializes as a full-leaf-size
+    broadcast — hoisted = resident, unhoisted = OOM; bench.py 7B notes).
+    Both SR optimizers carry exactly these keys so the hash scheme can only
+    change in one place."""
+    return {
+        "seed": jnp.uint32(seed),
+        "m1": jnp.uint32(0x9E3779B1), "m2": jnp.uint32(0x85EBCA77),
+        "s16": jnp.uint32(16), "s13": jnp.uint32(13),
+        "mask16": jnp.uint32(0xFFFF), "hi16": jnp.uint32(0xFFFF0000),
+    }
+
+
+def _base_salt(count: jax.Array, hp: dict) -> jax.Array:
+    """Per-step scalar salt (all scalar math — no leaf-size tensors)."""
+    return (count.astype(jnp.uint32) + jnp.uint32(1)) * hp["m1"] ^ hp["seed"]
+
+
+def _leaf_salt(base_salt: jax.Array, i: int, size: int) -> jax.Array:
+    """Leaf-distinct salt; ``i`` is group-relative under the chunked host
+    update, so the leaf size folds in as a stable-ish identity."""
+    return base_salt ^ jnp.uint32((i * 2654435761 + size) & 0xFFFFFFFF)
+
+
+def _fp32_deltas(new_leaves: list, old_leaves: list) -> list:
+    """The optax delta contract: return fp32 differences.  Exact — the
+    difference of two bf16 values is exact in fp32 (both have 8-bit
+    mantissas and an optimizer step keeps their exponents close), and
+    ``optax.apply_updates`` computes p + u in the promoted dtype before
+    casting back to p.dtype, so the stochastically-rounded weight is
+    reconstructed bit-for-bit.  A bf16 delta would round a second time."""
+    return [
+        np_.astype(jnp.float32) - p.astype(jnp.float32)
+        for np_, p in zip(new_leaves, old_leaves)
+    ]
+
+
 class LionSRState(NamedTuple):
     count: jax.Array  # step counter; folds into the per-leaf SR key
     mu: optax.Updates  # bf16 momentum
@@ -134,16 +172,7 @@ def lion_bf16_sr(
             for k, v in (("lr", learning_rate), ("b1", b1), ("b2", b2),
                          ("wd", weight_decay))
         }
-        # hash/mask constants ride the state as traced uint32 scalars too:
-        # inside the host region a LITERAL scalar materializes as a
-        # full-leaf-size broadcast (hoisted = resident, unhoisted = OOM —
-        # bench.py 7B notes), a traced host scalar broadcasts for free
-        hyper.update({
-            "seed": jnp.uint32(seed),
-            "m1": jnp.uint32(0x9E3779B1), "m2": jnp.uint32(0x85EBCA77),
-            "s16": jnp.uint32(16), "s13": jnp.uint32(13),
-            "mask16": jnp.uint32(0xFFFF), "hi16": jnp.uint32(0xFFFF0000),
-        })
+        hyper.update(_sr_hash_consts(seed))
         return LionSRState(
             count=jnp.zeros([], jnp.int32),
             mu=jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.bfloat16), params),
@@ -156,8 +185,7 @@ def lion_bf16_sr(
         hp = state.hyperparams
         lr_t, b1_t, b2_t, wd_t = hp["lr"], hp["b1"], hp["b2"], hp["wd"]
         count = state.count + 1
-        # per-step scalar base salt (all scalar math — no leaf-size tensors)
-        base_salt = (count.astype(jnp.uint32) + jnp.uint32(1)) * hp["m1"] ^ hp["seed"]
+        base_salt = _base_salt(count, hp)
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         p_leaves = treedef.flatten_up_to(params)
         m_leaves = treedef.flatten_up_to(state.mu)
@@ -168,25 +196,123 @@ def lion_bf16_sr(
             p32 = p.astype(jnp.float32)
             direction = jnp.sign(b1_t * m32 + (1.0 - b1_t) * g32)
             step = lr_t * (direction + wd_t * p32)
-            # leaf-distinct salt; i is group-relative under the chunked host
-            # update, so the leaf size folds in as a stable-ish identity
-            salt = base_salt ^ jnp.uint32((i * 2654435761 + p.size) & 0xFFFFFFFF)
+            salt = _leaf_salt(base_salt, i, p.size)
             new_p.append(stochastic_round_to_bf16_hashed(p32 - step, salt, hp, entropy=g32))
             new_m.append((b2_t * m32 + (1.0 - b2_t) * g32).astype(jnp.bfloat16))
-        # optax contract: return the DELTA.  It stays fp32: the difference
-        # of two bf16 values is exact in fp32 (both have 8-bit mantissas and
-        # a lion step keeps their exponents close), and optax.apply_updates
-        # computes p + u in the promoted dtype before casting back to
-        # p.dtype — so the stochastically-rounded weight is reconstructed
-        # bit-for-bit.  A bf16 delta would round a second time.
-        deltas = [
-            np_.astype(jnp.float32) - p.astype(jnp.float32)
-            for np_, p in zip(new_p, p_leaves)
-        ]
+        deltas = _fp32_deltas(new_p, p_leaves)
         return (
             jax.tree_util.tree_unflatten(treedef, deltas),
             LionSRState(count=count, mu=jax.tree_util.tree_unflatten(treedef, new_m),
                         hyperparams=hp),
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+class AdamWSRState(NamedTuple):
+    count: jax.Array  # step counter; bias correction + per-leaf SR key
+    mu: optax.Updates  # bf16 first moment (nearest round — see adamw_bf16_sr)
+    nu: optax.Updates  # bf16 second moment, written back with SR
+    hyperparams: dict  # traced scalars — same host-region contract as LionSRState
+
+
+def adamw_bf16_sr(
+    learning_rate: float = 1e-4,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    seed: int = 0,
+) -> optax.GradientTransformation:
+    """AdamW whose parameters AND both moments stay bf16 (no fp32 trees).
+
+    Three bf16 trees, three rounding regimes, each chosen by the size of a
+    step's increment relative to the stored value's bf16 ulp (2^-8 relative):
+
+    - **params**: the update ``lr * m_hat / (sqrt(v_hat)+eps)`` is routinely
+      below the weight's half-ulp, so the write-back uses **stochastic
+      rounding** (exactly the lion_bf16_sr argument).
+    - **mu**: moves by ``(1-b1)(g - m)`` per step — ~10% relative with the
+      default b1=0.9, far above the bf16 ulp, so **nearest-even** is lossless
+      in expectation (same as optax's own ``mu_dtype=bfloat16``).
+    - **nu**: moves by ``(1-b2)(g² - v)`` — ~0.1% relative with b2=0.999,
+      *below* the 0.39% bf16 ulp, so nearest-even freezes nu once it is
+      warmed up and the effective lr silently stops adapting.  **SR** keeps
+      ``E[nu]`` exact; the extra variance enters through ``sqrt`` (halved in
+      relative terms) and is averaged by the b2 EMA itself.
+
+    Per-step host traffic under ZeRO-offload: param r+w 4 + mu r+w 4 +
+    nu r+w 4 + grad r 2 = **14 B/param**, vs the fp32-master adamw recipe's
+    28 (masters 8, fp32 mu 8, fp32 nu 8, grad 2, bf16 compute-copy write 2)
+    — an even larger relative cut than lion's 16 → 10.
+
+    Same contracts as :func:`lion_bf16_sr`: per-leaf independent (safe under
+    ``host_update_chunk_gib`` slicing), deterministic hashed SR (no RNG
+    state; ``jax.random`` cannot run in host regions), traced-scalar
+    hyperparams (a literal would materialize leaf-sized in the host region),
+    fp32 delta return (exact — ``optax.apply_updates`` reconstructs the
+    rounded weight bit-for-bit).
+    """
+
+    def init(params):
+        hyper = {
+            k: jnp.float32(v)
+            for k, v in (("lr", learning_rate), ("b1", b1), ("b2", b2),
+                         ("eps", eps), ("wd", weight_decay))
+        }
+        hyper.update(_sr_hash_consts(seed))
+        # decorrelates the nu write's noise stream from the param write's
+        hyper["nu_salt"] = jnp.uint32(0x27D4EB2F)
+        zeros_bf16 = lambda p: jnp.zeros_like(p, jnp.bfloat16)
+        return AdamWSRState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(zeros_bf16, params),
+            nu=jax.tree_util.tree_map(zeros_bf16, params),
+            hyperparams=hyper,
+        )
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("adamw_bf16_sr is a weight update: pass params")
+        hp = state.hyperparams
+        lr_t, b1_t, b2_t = hp["lr"], hp["b1"], hp["b2"]
+        eps_t, wd_t = hp["eps"], hp["wd"]
+        count = state.count + 1
+        c32 = count.astype(jnp.float32)
+        # bias corrections as traced scalars (integer_pow needs a static
+        # exponent, so b^t goes through exp(t*log(b)))
+        bc1 = 1.0 - jnp.exp(c32 * jnp.log(b1_t))
+        bc2 = 1.0 - jnp.exp(c32 * jnp.log(b2_t))
+        base_salt = _base_salt(count, hp)
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        p_leaves = treedef.flatten_up_to(params)
+        m_leaves = treedef.flatten_up_to(state.mu)
+        v_leaves = treedef.flatten_up_to(state.nu)
+        new_p, new_m, new_v = [], [], []
+        for i, (g, p, m, v) in enumerate(zip(leaves, p_leaves, m_leaves, v_leaves)):
+            g32 = g.astype(jnp.float32)
+            m32 = b1_t * m.astype(jnp.float32) + (1.0 - b1_t) * g32
+            v32 = b2_t * v.astype(jnp.float32) + (1.0 - b2_t) * g32 * g32
+            p32 = p.astype(jnp.float32)
+            step = lr_t * ((m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps_t) + wd_t * p32)
+            salt = _leaf_salt(base_salt, i, p.size)
+            new_p.append(stochastic_round_to_bf16_hashed(p32 - step, salt, hp, entropy=g32))
+            new_m.append(m32.astype(jnp.bfloat16))
+            # nu's own SR stream: salted apart from the param write, entropy
+            # from the (pre-EMA) squared grad so equal-valued lanes decouple
+            new_v.append(
+                stochastic_round_to_bf16_hashed(v32, salt ^ hp["nu_salt"], hp,
+                                                entropy=g32 * g32)
+            )
+        deltas = _fp32_deltas(new_p, p_leaves)
+        return (
+            jax.tree_util.tree_unflatten(treedef, deltas),
+            AdamWSRState(
+                count=count,
+                mu=jax.tree_util.tree_unflatten(treedef, new_m),
+                nu=jax.tree_util.tree_unflatten(treedef, new_v),
+                hyperparams=hp,
+            ),
         )
 
     return optax.GradientTransformation(init, update)

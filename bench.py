@@ -236,10 +236,11 @@ def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool,
     bf16 = p_bytes // 2          # compute copy
     # masters: fp32 tree (lion/adamw) or none at all (lion-sr stores the
     # params themselves in bf16 — the compute copy IS the master)
-    fp32 = 0 if optimizer == "lion-sr" else p_bytes
+    fp32 = 0 if optimizer in ("lion-sr", "adamw-sr") else p_bytes
     # matches the bench optimizer choices: lion/lion-sr = bf16 momentum
-    # only, adamw = fp32 m + v
-    opt_state = p_bytes // 2 if optimizer in ("lion", "lion-sr") else 2 * p_bytes
+    # only, adamw-sr = bf16 m + v (SR-maintained), adamw = fp32 m + v
+    opt_state = (p_bytes // 2 if optimizer in ("lion", "lion-sr")
+                 else p_bytes if optimizer == "adamw-sr" else 2 * p_bytes)
     if offload:
         # grads stream D2H as backward produces them (clipping off — see
         # docs/offload.md); resident at once: ~the largest leaf, in bf16
@@ -257,7 +258,8 @@ def plan_report(n_devices: int, seq: int, batch_per_device: int, offload: bool,
     hbm = bf16 + grads + act + (0 if offload else fp32 + opt_state)
     # offloaded host set: the master tree (bf16 params themselves under
     # lion-sr) + optimizer state
-    host = ((bf16 if optimizer == "lion-sr" else fp32) + opt_state) if offload else 0
+    host = ((bf16 if optimizer in ("lion-sr", "adamw-sr") else fp32)
+            + opt_state) if offload else 0
     gib = lambda b: round(b / 2**30, 2)
     return {
         "model": "llama2-7b", "n_devices": n_devices,
@@ -401,11 +403,14 @@ def main():
                     help="override scan_block_size (layers per scan iteration)")
     ap.add_argument("--precision", choices=["bf16", "fp8"], default="bf16",
                     help="mixed_precision for the train step (fp8: scaled-e4m3 matmuls)")
-    ap.add_argument("--optimizer", choices=["lion", "adamw", "lion-sr"], default=None,
+    ap.add_argument("--optimizer", choices=["lion", "adamw", "lion-sr", "adamw-sr"],
+                    default=None,
                     help="default lion-sr (bf16 masters with stochastic rounding — "
                          "no fp32 master tree; the measured-best recipe at every "
                          "scale: 600m 66.0%% vs 63.0%% MFU, 1b 70.3%% vs 64.9%%, "
                          "7b 859 vs 602 tok/s — host bytes 16 -> 10 B/param). "
+                         "adamw-sr is the adam-shaped SR recipe (bf16 params + "
+                         "bf16 m/v, host bytes 28 -> 14 B/param at 7b). "
                          "lion restores fp32 masters + bf16 momentum; adamw (7b: "
                          "full m+v, needs ~67GiB host RAM).")
     ap.add_argument("--chunk-gib", type=float, default=None,
@@ -449,20 +454,24 @@ def main():
     if args.optimizer is None:
         # lion-sr measured best at every TPU scale (see --optimizer help);
         # CPU runs keep the historical recipes (lion at 7b/1b, adamw smoke)
-        args.optimizer = "lion-sr" if on_tpu else "lion"
+        args.optimizer = ("lion-sr" if on_tpu
+                          else "lion" if args.model in ("7b", "1b") else "adamw")
 
-    def lion_sr_recipe(params):
+    def sr_recipe(params, kind="lion-sr"):
         """bf16 masters + stochastic rounding (ops/stochastic_rounding.py):
         the shared resident-model setup — cast the stored params to bf16
-        (they ARE the masters) and return the SR transform."""
-        from accelerate_tpu.ops.stochastic_rounding import lion_bf16_sr
+        (they ARE the masters) and return the SR transform (lion- or
+        adam-shaped, both per-leaf independent + traced-hyperparam)."""
+        from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr, lion_bf16_sr
 
         cast = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.bfloat16)
             if jnp.issubdtype(p.dtype, jnp.floating) else p,
             params,
         )
-        return lion_bf16_sr(1e-4, b1=0.9, b2=0.99), cast
+        tx = (lion_bf16_sr(1e-4, b1=0.9, b2=0.99) if kind == "lion-sr"
+              else adamw_bf16_sr(3e-4, b1=0.9, b2=0.999))
+        return tx, cast
     extra_report = {}
     if on_tpu and not args.no_selftest:
         selftest(extra_report)
@@ -581,7 +590,7 @@ def main():
         # of 27, and half the per-step master read/write traffic
         params = init_params_leafwise(
             model, acc, ids[:, :8],
-            dtype=jnp.bfloat16 if args.optimizer == "lion-sr" else None,
+            dtype=jnp.bfloat16 if args.optimizer in ("lion-sr", "adamw-sr") else None,
         )
     else:
         # init directly into the plan's shards (host shards under --offload)
@@ -595,13 +604,15 @@ def main():
         # scalars as full-leaf-size fp32 broadcasts (6 x 500MiB at 7B —
         # measured OOM), while traced host scalars broadcast on the host
         # for free.
-        if args.optimizer == "lion-sr":
+        if args.optimizer in ("lion-sr", "adamw-sr"):
             # hyperparams already ride the state as traced scalars (the
             # transform's own inject_hyperparams analog), and the update is
             # per-leaf independent — chunked-host-region compatible
-            from accelerate_tpu.ops.stochastic_rounding import lion_bf16_sr
+            from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr, lion_bf16_sr
 
-            tx = lion_bf16_sr(learning_rate=1e-4, b1=0.9, b2=0.99)
+            tx = (lion_bf16_sr(learning_rate=1e-4, b1=0.9, b2=0.99)
+                  if args.optimizer == "lion-sr"
+                  else adamw_bf16_sr(learning_rate=3e-4, b1=0.9, b2=0.999))
         elif args.optimizer == "adamw":
             tx = optax.inject_hyperparams(optax.adamw, static_args=("mu_dtype",))(
                 learning_rate=3e-4, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
@@ -624,21 +635,21 @@ def main():
         # second moment alone adds 5.4GiB, measured OOM at every batch).
         # lion-sr drops the fp32 masters entirely (params stay bf16 with
         # stochastic rounding): ~8GiB freed for batch headroom.
-        if args.optimizer == "lion-sr":
-            tx, params = lion_sr_recipe(params)
+        if args.optimizer in ("lion-sr", "adamw-sr"):
+            tx, params = sr_recipe(params, args.optimizer)
         else:
             tx = (optax.lion(1e-4, b1=0.9, b2=0.99, mu_dtype=jnp.bfloat16)
                   if args.optimizer == "lion"
                   else optax.adamw(3e-4, mu_dtype=jnp.bfloat16))
-    elif on_tpu:
-        if args.optimizer == "lion-sr":
-            tx, params = lion_sr_recipe(params)
+    else:
+        # same choice logic on TPU and in the CPU smoke mode: the report
+        # labels the run with args.optimizer, so the recipe must match
+        if args.optimizer in ("lion-sr", "adamw-sr"):
+            tx, params = sr_recipe(params, args.optimizer)
         elif args.optimizer == "lion":
             tx = optax.lion(1e-4, b1=0.9, b2=0.99, mu_dtype=jnp.bfloat16)
         else:
-            tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16)
-    else:
-        tx = optax.adamw(3e-4)
+            tx = optax.adamw(3e-4, mu_dtype=jnp.bfloat16 if on_tpu else None)
     state = acc.create_train_state(params, tx, apply_fn=model.apply)
     if args.offload and on_tpu:
         # the whole point of offload: moments live in pinned host memory

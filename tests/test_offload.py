@@ -349,3 +349,60 @@ def test_offload_lion_sr_bf16_masters_trains():
     assert jax.tree_util.tree_leaves(params_chunk)[0].dtype == jnp.bfloat16
     assert np.isfinite(losses_chunk).all()
     np.testing.assert_allclose(losses_chunk, ref_losses, rtol=0.35)
+
+
+def test_offload_adamw_sr_bf16_masters_trains():
+    """adamw_bf16_sr (bf16 params + bf16 SR-maintained m/v) through the
+    offload machinery: same contracts as the lion-sr test — offload ==
+    resident bitwise (deterministic SR keys), chunked trains, and the SR
+    recipe tracks fp32 adamw at the same hyperparams."""
+    from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr
+    from accelerate_tpu.utils.dataclasses import GradSyncKwargs
+
+    def run(offload, chunk_gib=None):
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        plugin = FullyShardedDataParallelPlugin(
+            min_weight_size=0, cpu_offload=offload, host_update_chunk_gib=chunk_gib
+        )
+        acc = Accelerator(
+            parallelism_config=ParallelismConfig(dp_shard_size=8),
+            fsdp_plugin=plugin, mixed_precision="bf16",
+            kwargs_handlers=[GradSyncKwargs(grad_dtype="bf16")],
+        )
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), _mlp_params()
+        )
+        state = acc.create_train_state(params, acc.prepare(adamw_bf16_sr(3e-3)))
+        step = acc.prepare_train_step(_mlp_loss, max_grad_norm=None)
+        losses = []
+        for batch in _batches(n=6):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+        return losses, jax.device_get(state.params)
+
+    losses_res, params_res = run(False)
+    losses_off, params_off = run(True)
+    assert jax.tree_util.tree_leaves(params_res)[0].dtype == jnp.bfloat16
+    np.testing.assert_allclose(losses_off, losses_res, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params_off, params_res
+    )
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc_ref = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8),
+                          mixed_precision="bf16")
+    ref_state = acc_ref.create_train_state(
+        _mlp_params(), acc_ref.prepare(optax.adamw(3e-3)))
+    ref_step = acc_ref.prepare_train_step(_mlp_loss, max_grad_norm=None)
+    ref_losses = []
+    for batch in _batches(n=6):
+        ref_state, m = ref_step(ref_state, batch)
+        ref_losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses_res, ref_losses, rtol=0.35)
+
+    losses_chunk, params_chunk = run(True, chunk_gib=1e-6)
+    assert jax.tree_util.tree_leaves(params_chunk)[0].dtype == jnp.bfloat16
+    assert np.isfinite(losses_chunk).all()
+    np.testing.assert_allclose(losses_chunk, ref_losses, rtol=0.35)

@@ -129,3 +129,91 @@ def test_update_requires_params():
     state = tx.init({"w": jnp.zeros((4,), jnp.bfloat16)})
     with pytest.raises(ValueError, match="params"):
         tx.update({"w": jnp.zeros((4,), jnp.bfloat16)}, state)
+
+
+def test_adamw_sr_nu_tracks_where_nearest_freezes():
+    """The adamw-specific motivation: with b2=0.999 the nu increment
+    (1-b2)(g²-v) is ~0.1% relative — below the bf16 half-ulp (~0.2-0.4%) —
+    so a nearest-even bf16 nu stalls far from its fixed point E[g²], while
+    the SR nu reaches it in expectation."""
+    from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr
+
+    rng = np.random.default_rng(0)
+    n, steps, b2 = 2048, 3000, 0.999
+    gs = rng.uniform(0.9, 1.1, (steps, n)).astype(np.float32)
+    eg2 = float((gs**2).mean())
+    target = eg2 * (1.0 - b2**steps)  # fp32 EMA of g² from zero
+
+    # what a naive bf16-nearest second moment does: freezes around v ~ g²/5
+    v_near = np.zeros((n,), np.float32)
+    for t in range(steps):
+        v_near = np.asarray(
+            jnp.asarray(b2 * v_near + (1 - b2) * gs[t] ** 2).astype(jnp.bfloat16),
+            np.float32,
+        )
+    assert v_near.mean() < 0.5 * target, (v_near.mean(), target)
+
+    tx = adamw_bf16_sr(learning_rate=0.0, b1=0.9, b2=b2)  # lr 0: isolate nu
+    params = {"w": jnp.ones((n,), jnp.bfloat16)}
+    state = tx.init(params)
+    for t in range(steps):
+        _, state = tx.update({"w": jnp.asarray(gs[t])}, state, params)
+    v_sr = float(np.asarray(state.nu["w"], np.float32).mean())
+    assert abs(v_sr - target) < 0.1 * target, (v_sr, target, float(v_near.mean()))
+
+
+def test_adamw_sr_tracks_fp32_adamw():
+    """Convergence parity on a regression: bf16 params + bf16 SR moments
+    reach the same loss neighborhood as stock fp32 adamw."""
+    from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    w_true = rng.normal(size=(16,)).astype(np.float32)
+    y = x @ w_true
+
+    def loss_fn(p):
+        return jnp.mean((jnp.asarray(x) @ p["w"].astype(jnp.float32) - jnp.asarray(y)) ** 2)
+
+    def train(tx, w0):
+        params = {"w": w0}
+        state = tx.init(params)
+        for _ in range(400):
+            grads = {"w": jax.grad(loss_fn)(params)["w"].astype(jnp.float32)}
+            updates, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        return float(loss_fn(params))
+
+    base = train(optax.adamw(3e-2), jnp.zeros((16,), jnp.float32))
+    sr = train(adamw_bf16_sr(3e-2), jnp.zeros((16,), jnp.bfloat16))
+    assert sr < max(4 * base, 5e-3), (sr, base)
+
+
+def test_adamw_sr_apply_updates_reconstructs_bitwise():
+    """Same optax delta contract as lion_bf16_sr: the fp32 delta through
+    apply_updates lands exactly on the stochastically rounded weight."""
+    from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr
+
+    key = jax.random.key(7)
+    p = {"w": jax.random.normal(key, (512,), jnp.float32).astype(jnp.bfloat16)}
+    g = {"w": jax.random.normal(jax.random.fold_in(key, 1), (512,), jnp.float32)}
+    tx = adamw_bf16_sr(learning_rate=3e-3)
+    state = tx.update(g, tx.init(p), p)[1]
+    updates, state = tx.update(g, state, p)
+    applied = optax.apply_updates(p, updates)
+    expect = np.asarray(p["w"], np.float32) + np.asarray(updates["w"], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(applied["w"], np.float32), expect.astype(jnp.bfloat16).astype(np.float32)
+    )
+    assert applied["w"].dtype == jnp.bfloat16
+    assert state.mu["w"].dtype == jnp.bfloat16
+    assert state.nu["w"].dtype == jnp.bfloat16
+
+
+def test_adamw_sr_update_requires_params():
+    from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr
+
+    tx = adamw_bf16_sr()
+    state = tx.init({"w": jnp.zeros((4,), jnp.bfloat16)})
+    with pytest.raises(ValueError, match="params"):
+        tx.update({"w": jnp.zeros((4,), jnp.bfloat16)}, state)
