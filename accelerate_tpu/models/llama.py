@@ -71,12 +71,26 @@ class LlamaConfig:
     # 3x slower than N=1 at 112k) — use the smallest N that fits.  Must
     # divide num_hidden_layers.
     scan_block_size: int = 1
+    # fraction of each offloaded boundary (along the sequence dim) that goes
+    # to pinned host memory; the rest is SAVED IN DEVICE HBM.  <1.0 splits
+    # the scan's stacked residual buffer between the two pools — the lever
+    # when the HOST's pinned-allocation ceiling binds before device HBM does
+    # (the measured situation at 131k on the bench rig: device 11.68 GiB
+    # fits, 6.44 GiB pinned dies while 5.63 GiB runs — docs/long_context.md).
+    # Only consulted by remat_policy="offload" under scan_layers.
+    boundary_offload_fraction: float = 1.0
     dtype: Any = jnp.bfloat16
 
     def __post_init__(self):
         if self.remat_policy not in ("full", "dots", "offload"):
             raise ValueError(
                 f"remat_policy must be 'full', 'dots' or 'offload', got {self.remat_policy!r}"
+            )
+        if not 0.0 < self.boundary_offload_fraction <= 1.0:
+            raise ValueError(
+                f"boundary_offload_fraction={self.boundary_offload_fraction} "
+                "must be in (0, 1] (1.0 = all boundaries pinned-host; smaller "
+                "keeps the tail slice of each boundary in device HBM)"
             )
         if self.scan_block_size != 1:
             if not self.scan_layers:
@@ -349,7 +363,22 @@ class _ScanBody(nn.Module):
         from jax.ad_checkpoint import checkpoint_name
 
         cfg = self.config
-        x = checkpoint_name(x, "block_boundary")
+        frac = getattr(cfg, "boundary_offload_fraction", 1.0)
+        if frac < 1.0 and cfg.remat and cfg.remat_policy == "offload":
+            # hybrid boundary residency: the head slice of the sequence goes
+            # to pinned host ("block_boundary", offloaded by the policy), the
+            # tail slice stays in device HBM ("block_boundary_device", saved).
+            # Slice sizes are static; align the split to 1024 tokens so the
+            # D2H DMA stays on friendly tile boundaries (small sequences —
+            # tests — align to 8 so the two-slice path is actually exercised).
+            t = x.shape[1]
+            align = 1024 if t >= 4096 else 8
+            k = min(t, max(align, (int(t * frac) // align) * align))
+            x_host = checkpoint_name(x[:, :k], "block_boundary")
+            x_dev = checkpoint_name(x[:, k:], "block_boundary_device")
+            x = jnp.concatenate([x_host, x_dev], axis=1) if k < t else x_host
+        else:
+            x = checkpoint_name(x, "block_boundary")
         bs = getattr(cfg, "scan_block_size", 1)
         if bs == 1:
             return self.block_cls(cfg, name="block")(x, positions, segment_ids), None
@@ -445,7 +474,9 @@ class LlamaForCausalLM(nn.Module):
             if cfg.remat:
                 if offload_remat:
                     policy = jax.checkpoint_policies.save_and_offload_only_these_names(
-                        names_which_can_be_saved=[],
+                        # "block_boundary_device" only exists when
+                        # boundary_offload_fraction < 1 (hybrid residency)
+                        names_which_can_be_saved=["block_boundary_device"],
                         names_which_can_be_offloaded=["block_boundary"],
                         offload_src="device", offload_dst="pinned_host",
                     )

@@ -401,6 +401,10 @@ def main():
                          "attribution table; utils/xplane.py decodes it in-process)")
     ap.add_argument("--scan-block", type=int, default=None,
                     help="override scan_block_size (layers per scan iteration)")
+    ap.add_argument("--boundary-frac", type=float, default=None,
+                    help="boundary_offload_fraction for offload-remat scan configs: "
+                         "<1 keeps the tail slice of each boundary in device HBM, "
+                         "shrinking the pinned-host residual buffer (the 131k lever)")
     ap.add_argument("--precision", choices=["bf16", "fp8"], default="bf16",
                     help="mixed_precision for the train step (fp8: scaled-e4m3 matmuls)")
     ap.add_argument("--optimizer", choices=["lion", "adamw", "lion-sr", "adamw-sr"],
@@ -522,10 +526,15 @@ def main():
             scan_block_size=(
                 args.scan_block or (2 if seq > 114688 else 1)
             ) if seq > 98304 else 1,
+            boundary_offload_fraction=(
+                args.boundary_frac if args.boundary_frac is not None else 1.0
+            ),
         )
         # batch 10 is the HBM sweet spot without remat (8: -4%, 12: OOM)
         batch = args.batch or (1 if long_ctx else 10)
         iters = args.iters or (4 if long_ctx else 10)
+        if args.boundary_frac is not None:
+            extra_report["boundary_offload_fraction"] = args.boundary_frac
     else:  # CPU smoke mode
         cfg = LlamaConfig.tiny()
         batch, seq, iters = args.batch or 4, args.seq_len or 128, args.iters or 3

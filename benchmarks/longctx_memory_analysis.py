@@ -12,6 +12,18 @@ def main():
     ap.add_argument("--seq-len", type=int, required=True)
     ap.add_argument("--scan-block", type=int, default=None)
     ap.add_argument("--optimizer", choices=["adamw", "lion-sr"], default="adamw")
+    ap.add_argument("--boundary-frac", type=float, default=1.0,
+                    help="boundary_offload_fraction: <1 keeps the tail slice of "
+                         "each scan boundary in device HBM instead of pinned host")
+    ap.add_argument("--layers", type=int, default=16,
+                    help="num_hidden_layers (bisecting the T>=2^17 crash: fewer "
+                         "layers = fewer in-flight boundaries at identical T)")
+    ap.add_argument("--execute", action="store_true",
+                    help="actually run 2 steps after compiling (default: "
+                         "compile-only, safe at crash-prone lengths)")
+    ap.add_argument("--compiler-opt", action="append", default=[],
+                    metavar="K=V", help="extra XLA compiler option(s) for the "
+                    "step compile, e.g. xla_tpu_enable_latency_hiding_scheduler=false")
     args = ap.parse_args()
 
     import jax
@@ -25,12 +37,16 @@ def main():
     seq = args.seq_len
     cfg = LlamaConfig(
         vocab_size=32000, hidden_size=1536, intermediate_size=4096,
-        num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
+        num_hidden_layers=args.layers, num_attention_heads=16, num_key_value_heads=8,
         max_position_embeddings=seq, attn_implementation="flash",
         remat=True, dtype=jnp.bfloat16,
         remat_policy="offload" if seq > 98304 else "full",
         scan_layers=seq > 98304,
-        scan_block_size=(args.scan_block or (2 if seq > 114688 else 1)) if seq > 98304 else 1,
+        scan_block_size=(
+            args.scan_block
+            or (2 if seq > 114688 and args.layers % 2 == 0 else 1)
+        ) if seq > 98304 else 1,
+        boundary_offload_fraction=args.boundary_frac,
     )
     model = LlamaForCausalLM(cfg)
     acc = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=jax.device_count()),
@@ -51,7 +67,13 @@ def main():
     step = acc.prepare_train_step(make_llama_loss_fn(model, fused_vocab_chunks=chunks))
     batch = {"input_ids": ids, "labels": ids}
     # prepare_train_step exposes its jitted core as step._jitted
-    compiled = step._jitted.lower(state, batch).compile()
+    copts = {}
+    for kv in args.compiler_opt:
+        k, _, v = kv.partition("=")
+        copts[k] = {"true": True, "false": False}.get(v.lower(), v)
+    compiled = step._jitted.lower(state, batch).compile(
+        compiler_options=copts or None
+    )
     ma = compiled.memory_analysis()
     fields = {}
     for k in ("temp_size_in_bytes", "argument_size_in_bytes", "output_size_in_bytes",
@@ -61,13 +83,25 @@ def main():
             fields[k] = int(v)
     live = fields.get("temp_size_in_bytes", 0) + fields.get("argument_size_in_bytes", 0) \
         + fields.get("output_size_in_bytes", 0) - fields.get("alias_size_in_bytes", 0)
-    print(json.dumps({
+    report = {
         "metric": "longctx_compiled_memory", "seq_len": seq, "optimizer": args.optimizer,
-        "scan_block": cfg.scan_block_size, **fields,
+        "scan_block": cfg.scan_block_size, "layers": args.layers, **fields,
         "peak_estimate_gib": round(live / 2**30, 2),
         "hbm_gib": round((jax.devices()[0].memory_stats() or {}).get("bytes_limit", 0) / 2**30, 2)
         if getattr(jax.devices()[0], "memory_stats", lambda: None)() else None,
-    }))
+    }
+    if args.compiler_opt:
+        report["compiler_options"] = copts
+    if args.execute:
+        import time
+        for i in range(2):
+            t0 = time.perf_counter()
+            state, metrics = compiled(state, batch)
+            loss = float(metrics["loss"])  # scalar fetch = sync
+            report[f"step{i}_s"] = round(time.perf_counter() - t0, 2)
+            report[f"step{i}_loss"] = round(loss, 4)
+        report["executed"] = True
+    print(json.dumps(report))
 
 
 if __name__ == "__main__":

@@ -416,6 +416,42 @@ def test_scan_layers_matches_unrolled():
     assert jax.tree_util.tree_structure(rt) == jax.tree_util.tree_structure(params)
 
 
+def test_boundary_offload_fraction_is_identity_math():
+    """The hybrid boundary-residency split (boundary_offload_fraction < 1,
+    docs/long_context.md) is slice+concat inside the scan body — pure
+    placement, so logits and grads must match the frac=1.0 scan model
+    exactly.  (On the bench rig the split measurably did NOT move the
+    T>=131,072 crash wall — the knob is kept for hosts where pinned is the
+    genuine binding pool; this pins that it can never change numerics.)"""
+    from accelerate_tpu.models.llama import stack_layer_params
+
+    base = LlamaConfig.tiny(remat=True, remat_policy="offload", scan_layers=True,
+                            dtype=jnp.float32)
+    split = LlamaConfig.tiny(remat=True, remat_policy="offload", scan_layers=True,
+                             boundary_offload_fraction=0.5, dtype=jnp.float32)
+    m_base, m_split = LlamaForCausalLM(base), LlamaForCausalLM(split)
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 255, (2, 16)), jnp.int32)
+    unrolled = LlamaForCausalLM(LlamaConfig.tiny(dtype=jnp.float32))
+    stacked = stack_layer_params(unrolled.init(jax.random.PRNGKey(0), ids))
+
+    np.testing.assert_array_equal(
+        np.asarray(m_base.apply(stacked, ids)), np.asarray(m_split.apply(stacked, ids)))
+    batch = {"input_ids": ids, "labels": ids}
+    l_a, g_a = jax.value_and_grad(make_llama_loss_fn(m_base))(stacked, batch)
+    l_b, g_b = jax.value_and_grad(make_llama_loss_fn(m_split))(stacked, batch)
+    assert float(l_a) == float(l_b)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        g_a, g_b)
+
+
+def test_boundary_offload_fraction_validation():
+    with pytest.raises(ValueError, match="boundary_offload_fraction"):
+        LlamaConfig.tiny(boundary_offload_fraction=0.0)
+    with pytest.raises(ValueError, match="boundary_offload_fraction"):
+        LlamaConfig.tiny(boundary_offload_fraction=1.5)
+
+
 @pytest.mark.slow
 def test_scan_layers_init_and_tp_sharding():
     """Direct init in the scan layout + the sharding planner's shifted TP
