@@ -497,8 +497,10 @@ def main():
         cfg = _1b_config(jnp, seq, args.remat or "none")
         # lion-sr frees the fp32 master tree (~8GiB with its transients):
         # batch 3 fits and is the measured sweet spot (70.3% MFU; batch 4
-        # fits too at 70.0%); fp32-master recipes cap at batch 2
-        batch = args.batch or (3 if args.optimizer == "lion-sr" else 2)
+        # fits too at 70.0%); fp32-master recipes cap at batch 2.  adamw-sr
+        # also fits batch 3 (64.9% MFU measured) — fp32-master adamw OOMs
+        # at EVERY batch here (the fp32 second moment alone adds 5.4GiB)
+        batch = args.batch or (3 if args.optimizer in ("lion-sr", "adamw-sr") else 2)
         iters = args.iters or 8
     elif on_tpu:
         seq = args.seq_len or 2048
