@@ -57,6 +57,10 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "quantize_params", "quantized_apply",
     ]),
     "powersgd": ("accelerate_tpu.parallel.powersgd", None),
+    "stochastic_rounding": ("accelerate_tpu.ops.stochastic_rounding", [
+        "lion_bf16_sr", "adamw_bf16_sr", "stochastic_round_to_bf16",
+        "stochastic_round_to_bf16_hashed",
+    ]),
     "profiler": ("accelerate_tpu.utils.profiler", ["TPUProfiler"]),
     "dataclasses": ("accelerate_tpu.utils.dataclasses", [
         "GradSyncKwargs", "ProfileKwargs", "GradientAccumulationPlugin",
