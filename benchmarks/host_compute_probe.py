@@ -9,6 +9,7 @@ GiB/s of the worker host's memory system under XLA host compute.  (A numpy
 STREAM on the *operator* box measures the wrong machine — under axon the
 host regions execute on the remote TPU-VM host.)"""
 
+import argparse
 import json
 import time
 
@@ -20,44 +21,62 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--streams", type=int, default=1,
+                    help="number of INDEPENDENT host regions in one program "
+                         "(disjoint trees, no data deps): measures whether the "
+                         "worker host's bandwidth scales with host-region "
+                         "concurrency — the 7B chunked update currently "
+                         "token-serializes into one chain")
+    ap.add_argument("--gib", type=float, default=1.0,
+                    help="fp32 master GiB per stream")
+    args = ap.parse_args()
+
     mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("d",))
     host = NamedSharding(mesh, P(), memory_kind="pinned_host")
     dev = NamedSharding(mesh, P(), memory_kind="device")
-    n = 256 * 1024 * 1024  # 1 GiB fp32 master
-    master = jax.device_put(jnp.zeros((n,), jnp.float32), host)
-    mom = jax.device_put(jnp.zeros((n,), jnp.bfloat16), host)
-    grad = jax.device_put(jnp.ones((n,), jnp.bfloat16), host)
+    S = args.streams
+    n = int(args.gib * 256 * 1024 * 1024)  # fp32 elements per stream
+    masters = [jax.device_put(jnp.zeros((n,), jnp.float32), host) for _ in range(S)]
+    moms = [jax.device_put(jnp.zeros((n,), jnp.bfloat16), host) for _ in range(S)]
+    grads = [jax.device_put(jnp.ones((n,), jnp.bfloat16), host) for _ in range(S)]
 
-    @jax.jit
-    def host_lion(master, mom, grad, salt):
+    def one_stream(master, mom, grad, salt):
         with compute_on("device_host"):
             g = grad.astype(jnp.float32) + salt  # varying input defeats caching
             m = mom.astype(jnp.float32)
             new_master = master - 1e-4 * jnp.sign(0.9 * m + 0.1 * g)
             new_mom = (0.99 * m + 0.01 * g).astype(jnp.bfloat16)
             checksum = new_master[0] + new_master[-1]
+        return new_master, new_mom, checksum
+
+    @jax.jit
+    def host_lion(masters, moms, grads, salt):
+        outs = [one_stream(ma, mo, g, salt) for ma, mo, g in zip(masters, moms, grads)]
         return (
-            jax.device_put(new_master, host),
-            jax.device_put(new_mom, host),
-            jax.device_put(checksum, dev),
+            [jax.device_put(o[0], host) for o in outs],
+            [jax.device_put(o[1], host) for o in outs],
+            jax.device_put(sum(o[2] for o in outs), dev),
         )
 
     salt0 = jax.device_put(jnp.float32(0.0), host)
-    master, mom, cs = host_lion(master, mom, grad, salt0)  # compile + warm
+    masters, moms, cs = host_lion(masters, moms, grads, salt0)  # compile + warm
     float(cs)
     iters = 4
     t0 = time.perf_counter()
     for i in range(iters):
         salt = jax.device_put(jnp.float32(i + 1.0), host)
-        master, mom, cs = host_lion(master, mom, grad, salt)
+        masters, moms, cs = host_lion(masters, moms, grads, salt)
         float(cs)  # scalar fetch sync
     dt = time.perf_counter() - t0
-    bytes_per = n * (4 + 2 + 2 + 4 + 2)  # r master+mom+grad, w master+mom
+    bytes_per = n * (4 + 2 + 2 + 4 + 2) * S  # r master+mom+grad, w master+mom
     print(json.dumps({
         "metric": "worker_host_compute_bandwidth",
         "unit": "GiB/s",
-        "lion_like_gib_s": round(bytes_per * iters / dt / 2**30, 2),
-        "secs_per_gib_master": round(dt / iters, 3),
+        "streams": S,
+        "gib_per_stream": args.gib,
+        "aggregate_gib_s": round(bytes_per * iters / dt / 2**30, 2),
+        "secs_per_iter": round(dt / iters, 3),
     }))
 
 
