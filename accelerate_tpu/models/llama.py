@@ -393,6 +393,13 @@ class _ScanBody(nn.Module):
                 "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             }[cfg.remat_policy]
             blk = nn.remat(blk, policy=policy)
+        # NOTE (measured, r5): at long context XLA's latency-hiding
+        # scheduler overlaps both blocks' recompute+backward live sets,
+        # costing ~5 GB of device temps vs scan_block=1 at equal T.  An
+        # inter-block optimization_barrier does NOT fix it (survives
+        # tracing, no scheduling effect); compiling with
+        # xla_tpu_enable_latency_hiding_scheduler=false does (temps return
+        # to the sb=1 level — docs/long_context.md).
         for j in range(bs):
             x = blk(cfg, name=f"block_{j}")(x, positions, segment_ids)
         return x, None

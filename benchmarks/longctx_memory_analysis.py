@@ -18,6 +18,10 @@ def main():
     ap.add_argument("--layers", type=int, default=16,
                     help="num_hidden_layers (bisecting the T>=2^17 crash: fewer "
                          "layers = fewer in-flight boundaries at identical T)")
+    ap.add_argument("--hidden", type=int, default=1536,
+                    help="hidden_size (the byte-size-vs-shape discriminator for "
+                         "the T>=2^17 crash: smaller hidden = smaller boundary "
+                         "bytes at identical (T, L) shape)")
     ap.add_argument("--execute", action="store_true",
                     help="actually run 2 steps after compiling (default: "
                          "compile-only, safe at crash-prone lengths)")
@@ -36,7 +40,7 @@ def main():
 
     seq = args.seq_len
     cfg = LlamaConfig(
-        vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+        vocab_size=32000, hidden_size=args.hidden, intermediate_size=4096,
         num_hidden_layers=args.layers, num_attention_heads=16, num_key_value_heads=8,
         max_position_embeddings=seq, attn_implementation="flash",
         remat=True, dtype=jnp.bfloat16,
@@ -85,7 +89,8 @@ def main():
         + fields.get("output_size_in_bytes", 0) - fields.get("alias_size_in_bytes", 0)
     report = {
         "metric": "longctx_compiled_memory", "seq_len": seq, "optimizer": args.optimizer,
-        "scan_block": cfg.scan_block_size, "layers": args.layers, **fields,
+        "scan_block": cfg.scan_block_size, "layers": args.layers,
+        "hidden": args.hidden, **fields,
         "peak_estimate_gib": round(live / 2**30, 2),
         "hbm_gib": round((jax.devices()[0].memory_stats() or {}).get("bytes_limit", 0) / 2**30, 2)
         if getattr(jax.devices()[0], "memory_stats", lambda: None)() else None,

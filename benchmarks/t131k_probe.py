@@ -10,10 +10,12 @@ the crashing component can be bisected out of the full train step:
 
 Outcome (2026-08-01, this rig, v5e tunnel): every component PASSES
 standalone at T=131,072, which ruled a per-component dimension limit OUT.
-The full-step crash instead tracks the TOTAL scan-boundary footprint
-(scan_iterations x T x hidden x 2 B, threshold ~6.4 GB) independent of the
-pinned/device placement split — the complete 11-run characterization lives
-in docs/long_context.md "Where the single-chip ceiling actually is".
+The full-step crash set (capacity-fitting configs only) is the exact shape
+cell {T >= 2^17, scanned layers >= 16, hidden 1536}; neighboring cells
+(15L, 17L at shorter T, hidden 1024) run, and every capacity metric is
+non-monotone with crashing — a shape-conditioned runtime bug.  The
+complete run matrix lives in docs/long_context.md "Where the single-chip
+ceiling actually is".
 """
 
 import argparse
