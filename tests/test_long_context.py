@@ -427,3 +427,49 @@ def test_model_level_ulysses_matches_native():
     )
     out = np.asarray(uly.apply(params, tokens))
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
+
+
+def test_cp_composes_with_scanned_offload_ladder():
+    """The multi-chip long-context claim (docs/long_context.md: ">=131k via
+    cp=2 by the same per-shard ladder") requires ring CP to compose with the
+    single-chip ladder itself: scan_layers + remat_policy="offload" (+ the
+    hybrid boundary split).  Pin that the composed stack trains — loss
+    decreases over steps — through the full Accelerator path on the CPU
+    mesh (offload storage degrades to device memory there; the scan/remat/
+    boundary-naming structure is identical)."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
+    from accelerate_tpu.models.llama import stack_layer_params
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    import optax
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(cp_size=2, dp_shard_size=4),
+        mixed_precision="bf16",
+    )
+    cfg = LlamaConfig.tiny(
+        attn_implementation="ring", remat=True, remat_policy="offload",
+        scan_layers=True, boundary_offload_fraction=0.5, dtype=jnp.float32,
+    )
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    seq = 32  # divisible by 2*cp (zigzag chunk pairs)
+    tokens = rng.integers(0, cfg.vocab_size, (4, seq)).astype(np.int32)
+    shift_labels = np.roll(tokens, -1, axis=1)
+    shift_labels[:, -1] = -100
+    unrolled = LlamaForCausalLM(
+        LlamaConfig.tiny(attn_implementation="ring", dtype=jnp.float32))
+    params = stack_layer_params(unrolled.init(jax.random.key(0), jnp.asarray(tokens[:, :8])))
+    state = acc.create_train_state(params, optax.adamw(1e-3), apply_fn=model.apply)
+    step = acc.prepare_train_step(make_llama_loss_fn(model), max_grad_norm=1.0)
+    losses = []
+    for _ in range(4):
+        with acc.maybe_context_parallel(
+            buffers=[tokens, shift_labels], buffer_seq_dims=[1, 1]
+        ) as (ids, labels):
+            state, metrics = step(state, {"input_ids": ids, "shift_labels": labels})
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
