@@ -473,3 +473,44 @@ def test_cp_composes_with_scanned_offload_ladder():
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_sp_composes_with_scanned_offload_ladder():
+    """Ulysses SP variant of the composition pin above: sequence-sharded
+    inputs through a scan_layers + offload-remat model (docs/long_context.md
+    names `sp=2` as the other route past the single-chip ceiling)."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
+    from accelerate_tpu.models.llama import stack_layer_params
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    import optax
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(sp_size=2, dp_shard_size=4),
+        mixed_precision="bf16",
+    )
+    cfg = LlamaConfig.tiny(
+        attn_implementation="ulysses", remat=True, remat_policy="offload",
+        scan_layers=True, boundary_offload_fraction=0.5, dtype=jnp.float32,
+    )
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    unrolled = LlamaForCausalLM(
+        LlamaConfig.tiny(attn_implementation="ulysses", dtype=jnp.float32))
+    params = stack_layer_params(unrolled.init(jax.random.key(0), jnp.asarray(tokens[:, :8])))
+    state = acc.create_train_state(params, optax.adamw(1e-3), apply_fn=model.apply)
+    step = acc.prepare_train_step(make_llama_loss_fn(model), max_grad_norm=1.0)
+    spec = acc._default_batch_spec()(tokens)
+    batch = {
+        "input_ids": jax.device_put(jnp.asarray(tokens), NamedSharding(acc.mesh, spec)),
+        "labels": jax.device_put(jnp.asarray(tokens), NamedSharding(acc.mesh, spec)),
+    }
+    losses = []
+    for _ in range(4):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
