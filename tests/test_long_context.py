@@ -429,6 +429,7 @@ def test_model_level_ulysses_matches_native():
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_cp_composes_with_scanned_offload_ladder():
     """The multi-chip long-context claim (docs/long_context.md: ">=131k via
     cp=2 by the same per-shard ladder") requires ring CP to compose with the
@@ -475,6 +476,7 @@ def test_cp_composes_with_scanned_offload_ladder():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_sp_composes_with_scanned_offload_ladder():
     """Ulysses SP variant of the composition pin above: sequence-sharded
     inputs through a scan_layers + offload-remat model (docs/long_context.md

@@ -131,6 +131,7 @@ def test_update_requires_params():
         tx.update({"w": jnp.zeros((4,), jnp.bfloat16)}, state)
 
 
+@pytest.mark.slow
 def test_adamw_sr_nu_tracks_where_nearest_freezes():
     """The adamw-specific motivation: with b2=0.999 the nu increment
     (1-b2)(g²-v) is ~0.1% relative — below the bf16 half-ulp (~0.2-0.4%) —
