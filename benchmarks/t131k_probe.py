@@ -44,9 +44,15 @@ def main():
     ap.add_argument("--splits", type=int, default=1,
                     help="scanflash only: number of consecutive independent "
                          "scans the layer stack is divided into")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (smoke mode; flash/offload "
+                         "components need the TPU for their real form)")
     args = ap.parse_args()
 
     import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 

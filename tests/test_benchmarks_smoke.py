@@ -1,0 +1,42 @@
+"""The measurement harnesses in benchmarks/ back every number in the docs
+(benchmarks/README.md maps each doc figure to its script); these smokes pin
+that the CPU-runnable ones stay executable — the TPU-only paths are gated
+inside the scripts themselves."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(args):
+    out = subprocess.run(
+        [sys.executable, *args], cwd=REPO, capture_output=True, text=True,
+        timeout=600,
+        env={"PYTHONPATH": f"{REPO}:/root/.axon_site", "PATH": "/usr/bin:/bin:/usr/local/bin"},
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_sr_quality_harness_runs():
+    rep = _run(["benchmarks/sr_quality.py", "--cpu", "--steps", "4",
+                "--eval-every", "2", "--optimizer", "adamw-sr"])
+    assert rep["metric"] == "sr_quality_shuffled_stream"
+    assert rep["sr"]["optimizer"] == "adamw-sr" and rep["ref"]["optimizer"] == "adamw"
+    assert rep["final_held_out_gap_pct"] is not None
+
+
+@pytest.mark.slow
+def test_t131k_probe_cpu_components_run():
+    # matmul + offload skeleton run on any backend (--cpu forces the CPU
+    # backend even under the axon sitecustomize); flash needs the TPU
+    for comp in ("matmul", "offload"):
+        rep = _run(["benchmarks/t131k_probe.py", "--seq-len", "512",
+                    "--component", comp, "--cpu"])
+        assert rep["component"] == comp and "value" in rep
