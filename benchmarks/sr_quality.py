@@ -6,8 +6,11 @@ memorizes a repeated batch faster — docs/performance.md).  This harness is
 the quality measurement: train on a stream of DISTINCT Zipf-distributed
 batches (identical stream for both runs), track a held-out batch, and
 compare the SR recipe against its fp32-master reference at the same
-hyperparameters.  The r5 lion-sr run measured held-out 4.6262 (SR) vs
-4.6244 (fp32 masters) at 1.35B over 80 steps — 0.04% apart.
+hyperparameters.  Measured (r5, one v5e chip): 1.35B lion-sr over 80
+steps — held-out 4.6262 vs 4.6244 (0.04%); 600m over 200 steps —
+lion-sr 0.035%, adamw-sr 0.002% (5.0849 vs 5.0848), with the gaps
+SHRINKING from the 60-step points (0.047% adamw-sr) — the SR noise
+averages out with horizon rather than accumulating.
 
   python benchmarks/sr_quality.py --optimizer adamw-sr --steps 80
   python benchmarks/sr_quality.py --optimizer lion-sr --model 1b
