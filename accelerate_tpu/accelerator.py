@@ -559,8 +559,22 @@ class Accelerator:
         return model
 
     def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:
+        """Wrap an optax transform — or build one of the named recipes
+        (``optimizer.OPTIMIZER_RECIPES``, e.g. ``"lion-sr8"``) at its
+        benchmarked hyperparameters; the -sr8 int8-state recipes take their
+        per-block scale granularity from the FSDP plugin's
+        ``int8_state_block_size`` knob."""
         if isinstance(optimizer, AcceleratedOptimizer):
             return optimizer
+        if isinstance(optimizer, str):
+            from .optimizer import make_optimizer
+
+            block = (
+                self.fsdp_plugin.int8_state_block_size
+                if self.fsdp_plugin is not None and optimizer.endswith("-sr8")
+                else None
+            )
+            optimizer = make_optimizer(optimizer, block_size=block)
         wrapped = AcceleratedOptimizer(optimizer)
         self._optimizers.append(wrapped)
         return wrapped
@@ -688,14 +702,15 @@ class Accelerator:
     def create_train_state(
         self,
         params,
-        optimizer: Union[AcceleratedOptimizer, optax.GradientTransformation],
+        optimizer: Union[AcceleratedOptimizer, optax.GradientTransformation, str],
         apply_fn: Optional[Callable] = None,
         rng: Optional[jax.Array] = None,
         sharded: bool = True,
     ) -> "TrainState":
         """Build the sharded TrainState (params placed on the plan, optimizer
-        state *initialized directly sharded* — the ZeRO property)."""
-        if isinstance(optimizer, optax.GradientTransformation):
+        state *initialized directly sharded* — the ZeRO property).
+        ``optimizer`` may be a recipe name (see :meth:`prepare_optimizer`)."""
+        if isinstance(optimizer, (str, optax.GradientTransformation)):
             optimizer = self.prepare_optimizer(optimizer)
         tx = optimizer.tx
         if rng is None:
@@ -1182,8 +1197,12 @@ class Accelerator:
             err_spec = PartitionSpec(axes)
             try:
                 from jax import shard_map as _shard_map
-            except ImportError:  # older jax
+
+                _no_check = {"check_vma": False}
+            except ImportError:  # older jax: check_vma was still check_rep
                 from jax.experimental.shard_map import shard_map as _shard_map
+
+                _no_check = {"check_rep": False}
 
             def _psgd_local(params, mb, use_rng, qs, errs):
                 def loss_only(p):
@@ -1217,7 +1236,7 @@ class Accelerator:
                     in_specs=(PartitionSpec(), batch_specs, PartitionSpec(),
                               PartitionSpec(), err_spec),
                     out_specs=(PartitionSpec(), PartitionSpec(), PartitionSpec(), err_spec),
-                    check_vma=False,
+                    **_no_check,
                 )
                 loss, g_hat, new_qs, new_errs = fn(state.params, batch, use_rng, qs, errs)
                 new_state, metrics = apply_update(

@@ -61,6 +61,17 @@ def _ocp():
     return ocp
 
 
+def _is_key_array(a) -> bool:
+    """Typed PRNG key array (extended dtype) — stored as raw key data in the
+    checkpoint and re-wrapped on restore."""
+    import jax.numpy as jnp
+
+    try:
+        return isinstance(a, jax.Array) and jnp.issubdtype(a.dtype, jax.dtypes.prng_key)
+    except Exception:  # pragma: no cover - exotic leaves
+        return False
+
+
 # ---------------------------------------------------------------------------
 # async-save lifecycle
 # ---------------------------------------------------------------------------
@@ -259,7 +270,14 @@ def save_accelerator_state(
     # 1. train state (sharded orbax write; every process participates)
     if train_state is not None:
         arrays, treedef = jax.tree_util.tree_flatten(train_state)
-        array_tree = {str(i): a for i, a in enumerate(arrays) if a is not None}
+        # typed PRNG keys are stored as their raw counter data (orbax cannot
+        # serialize extended dtypes on every jax version); load_accelerator_
+        # state re-wraps them with the template's key impl
+        array_tree = {
+            str(i): (jax.random.key_data(a) if _is_key_array(a) else a)
+            for i, a in enumerate(arrays)
+            if a is not None
+        }
         if async_save:
             # one long-lived AsyncCheckpointer per accelerator (orbax's
             # intended reuse pattern — no thread-pool churn per save)
@@ -342,7 +360,12 @@ def load_accelerator_state(
         for i, a in enumerate(arrays):
             if a is None:
                 continue
-            if isinstance(a, jax.Array):
+            if _is_key_array(a):
+                # stored as raw key data (see save_accelerator_state)
+                kd = jax.random.key_data(a)
+                template[str(i)] = ocp.utils.to_shape_dtype_struct(kd)
+                restore_args[str(i)] = ocp.ArrayRestoreArgs(sharding=kd.sharding)
+            elif isinstance(a, jax.Array):
                 template[str(i)] = ocp.utils.to_shape_dtype_struct(a)
                 restore_args[str(i)] = ocp.ArrayRestoreArgs(sharding=a.sharding)
             else:
@@ -352,6 +375,12 @@ def load_accelerator_state(
         restored = ckptr.restore(
             input_dir / TRAIN_STATE_DIR, item=template, restore_args=restore_args
         )
+        for i, a in enumerate(arrays):
+            key = str(i)
+            if key in restored and _is_key_array(a):
+                restored[key] = jax.random.wrap_key_data(
+                    restored[key], impl=jax.random.key_impl(a)
+                )
 
         def _restore_placement(x, a):
             # safety net: if a restore path ignored the sharding request,

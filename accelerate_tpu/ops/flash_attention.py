@@ -35,6 +35,8 @@ try:
     from jax.experimental.pallas import tpu as pltpu
 
     _HAS_PLTPU = True
+    # renamed TPUCompilerParams -> CompilerParams around jax 0.7
+    _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 except ImportError:  # pragma: no cover
     _HAS_PLTPU = False
 
@@ -267,7 +269,7 @@ def _flash_fwd(q, k, v, seg_q, seg_kv, pos_q, pos_kv, causal: bool, sm_scale: fl
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ]
-        compiler_params = pltpu.CompilerParams(
+        compiler_params = _CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         )
     else:  # pragma: no cover
@@ -449,7 +451,7 @@ def _flash_bwd(q, k, v, seg_q, seg_kv, pos_q, pos_kv, out, lse, g, g_lse, causal
     delta = delta[:, None, :]
     lse3 = lse[:, None, :]
 
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
 

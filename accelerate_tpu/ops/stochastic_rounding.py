@@ -70,15 +70,31 @@ def stochastic_round_to_bf16_hashed(x: jax.Array, salt: jax.Array,
     — unbiasedness per element still holds, only spatial variance grows.
     """
     c = consts or {}
+    hi16 = c.get("hi16", jnp.uint32(0xFFFF0000))
+    x = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    noise = sr_noise_bits(x, salt, c, entropy=entropy)
+    bumped = bits + noise
+    return jax.lax.convert_element_type(
+        jax.lax.bitcast_convert_type(bumped & hi16, jnp.float32), jnp.bfloat16
+    )
+
+
+def sr_noise_bits(x: jax.Array, salt: jax.Array, consts: Optional[dict] = None,
+                  entropy: Optional[jax.Array] = None) -> jax.Array:
+    """The ONE deterministic-SR noise stream: 16 uniform bits (uint32 in
+    [0, 2^16)) hashed murmur-style from ``x``'s fp32 bit pattern, the salt,
+    and the optional entropy channel.  Every SR consumer — the bf16 param
+    write above, the int8/log-uint8 state requants (ops/int8_state.py) —
+    draws through here, so the hash scheme can only change in one place
+    (the ``_sr_hash_consts`` contract)."""
+    c = consts or {}
     m1 = c.get("m1", jnp.uint32(0x9E3779B1))
     m2 = c.get("m2", jnp.uint32(0x85EBCA77))
     s16 = c.get("s16", jnp.uint32(16))
     s13 = c.get("s13", jnp.uint32(13))
     mask16 = c.get("mask16", jnp.uint32(0xFFFF))
-    hi16 = c.get("hi16", jnp.uint32(0xFFFF0000))
-
-    x = x.astype(jnp.float32)
-    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     h = bits ^ salt.astype(jnp.uint32)
     if entropy is not None:
         e = jax.lax.bitcast_convert_type(entropy.astype(jnp.float32), jnp.uint32)
@@ -87,11 +103,7 @@ def stochastic_round_to_bf16_hashed(x: jax.Array, salt: jax.Array,
     h = h ^ (h >> s16)
     h = h * m2
     h = h ^ (h >> s13)
-    noise = h & mask16
-    bumped = bits + noise
-    return jax.lax.convert_element_type(
-        jax.lax.bitcast_convert_type(bumped & hi16, jnp.float32), jnp.bfloat16
-    )
+    return h & mask16
 
 
 def _sr_hash_consts(seed: int) -> dict:

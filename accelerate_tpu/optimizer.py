@@ -20,9 +20,95 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from .state import AcceleratorState, GradientState
+
+
+# ---------------------------------------------------------------------------
+# Named optimizer recipes (the measured operating points of bench.py /
+# docs/performance.md, constructible by name).  Families:
+#   <base>      — fp32 masters, bf16 first moment (the stock recipe)
+#   <base>-sr   — bf16 params with stochastic rounding, bf16 moments
+#                 (ops/stochastic_rounding.py; no fp32 master tree)
+#   <base>-sr8  — bf16 SR params + int8 blockwise moment state with
+#                 SR-dithered requantization (ops/int8_state.py; the
+#                 host-byte floor of the offload ladder)
+# ---------------------------------------------------------------------------
+
+OPTIMIZER_RECIPES: dict[str, str] = {
+    "lion": "optax.lion, fp32 masters + bf16 momentum",
+    "adamw": "optax.adamw, fp32 masters + bf16 first moment",
+    "lion-sr": "bf16 SR params + bf16 momentum (16 -> 10 host-B/param)",
+    "adamw-sr": "bf16 SR params + bf16 m/v (28 -> 14 host-B/param)",
+    "lion-sr8": "bf16 SR params + int8 momentum (10 -> ~8 host-B/param)",
+    "adamw-sr8": "bf16 SR params + int8 m + uint8 v (14 -> ~10 host-B/param)",
+}
+
+
+def reference_recipe(name: str) -> str:
+    """The fp32-master reference recipe an -sr/-sr8 recipe is validated
+    against (benchmarks/sr_quality.py): ``lion-sr8`` -> ``lion``."""
+    return name.split("-", 1)[0]
+
+
+def make_optimizer(
+    name: str,
+    learning_rate: Optional[float] = None,
+    *,
+    weight_decay: float = 0.0,
+    block_size: Optional[int] = None,
+    seed: int = 0,
+) -> optax.GradientTransformation:
+    """Build a named optimizer recipe at its benchmarked hyperparameters.
+
+    ``learning_rate`` defaults to the bench operating points (lion family
+    1e-4, adam family 3e-4).  ``weight_decay`` is passed **explicitly** to
+    every recipe — including the stock optax references, whose own defaults
+    differ (optax.adamw 1e-4, optax.lion 1e-3) — so an SR-vs-reference
+    comparison built from this registry really runs at the same
+    hyperparameters (the sr_quality harness contract).  ``block_size``
+    applies to the -sr8 recipes only (per-block scale granularity,
+    default :data:`~.ops.int8_state.DEFAULT_BLOCK_SIZE`); ``seed`` keys
+    the deterministic SR hash of the -sr/-sr8 recipes.
+    """
+    from .ops.int8_state import DEFAULT_BLOCK_SIZE, adamw_int8_sr, lion_int8_sr
+    from .ops.stochastic_rounding import adamw_bf16_sr, lion_bf16_sr
+
+    if name not in OPTIMIZER_RECIPES:
+        raise ValueError(
+            f"unknown optimizer recipe {name!r}; options: {sorted(OPTIMIZER_RECIPES)}"
+        )
+    if block_size is not None:
+        if not name.endswith("-sr8"):
+            raise ValueError(
+                f"block_size only applies to the -sr8 int8-state recipes, got {name!r}"
+            )
+        if block_size < 1:
+            # mirror the plugin knob's validation — the same value arriving
+            # via --int8-block must not silently fall back or, worse, pass
+            # a negative through to int8_scale_shape (one scale PER ELEMENT)
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+    lion_family = reference_recipe(name) == "lion"
+    lr = learning_rate if learning_rate is not None else (1e-4 if lion_family else 3e-4)
+    block = DEFAULT_BLOCK_SIZE if block_size is None else block_size
+    if name == "lion":
+        return optax.lion(lr, b1=0.9, b2=0.99, weight_decay=weight_decay,
+                          mu_dtype=jnp.bfloat16)
+    if name == "adamw":
+        return optax.adamw(lr, b1=0.9, b2=0.999, eps=1e-8,
+                           weight_decay=weight_decay, mu_dtype=jnp.bfloat16)
+    if name == "lion-sr":
+        return lion_bf16_sr(lr, b1=0.9, b2=0.99, weight_decay=weight_decay, seed=seed)
+    if name == "adamw-sr":
+        return adamw_bf16_sr(lr, b1=0.9, b2=0.999, eps=1e-8,
+                             weight_decay=weight_decay, seed=seed)
+    if name == "lion-sr8":
+        return lion_int8_sr(lr, b1=0.9, b2=0.99, weight_decay=weight_decay,
+                            seed=seed, block_size=block)
+    return adamw_int8_sr(lr, b1=0.9, b2=0.999, eps=1e-8,
+                         weight_decay=weight_decay, seed=seed, block_size=block)
 
 
 class AcceleratedOptimizer:

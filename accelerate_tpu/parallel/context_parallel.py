@@ -29,8 +29,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import axis_size, partial_manual_kwargs
 
 NEG_INF = -1e30
 
@@ -137,7 +143,7 @@ def ring_attention_sharded(
     position-based causal mask, and blocks combine via the kernel's
     differentiable logsumexp output.  Off-TPU the XLA blockwise path runs.
     """
-    cp = lax.axis_size(axis_name)
+    cp = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     t_global = t_local * cp
@@ -284,7 +290,7 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "cp", rotate_method: str = 
         in_specs = (spec, spec, spec) + ((P(None, axis_name),) if with_seg else ())
         return jax.jit(shard_map(
             body, mesh=mesh, in_specs=in_specs, out_specs=spec,
-            axis_names={axis_name}, check_vma=False,
+            **partial_manual_kwargs({axis_name}),
         ))
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):

@@ -33,7 +33,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import partial_manual_kwargs
 
 
 class RoutingResult(NamedTuple):
@@ -156,12 +163,12 @@ def expert_parallel_apply(
         e = x_grouped.shape[0]
         return expert_fn(jnp.arange(e), x_grouped)
     spec = P(None, axis_name, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ep_body, axis_name=axis_name, expert_fn=expert_fn),
         mesh=mesh,
         in_specs=(spec,),
         out_specs=spec,
-        check_vma=False,
+        **partial_manual_kwargs({axis_name}),
     )
     return fn(x_grouped)
 

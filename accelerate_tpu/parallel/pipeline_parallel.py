@@ -33,8 +33,15 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .collectives import axis_size, partial_manual_kwargs
 
 
 # ---------------------------------------------------------------------------
@@ -100,7 +107,7 @@ def _gpipe_body(
     records finished microbatches; a masked psum replicates them to every
     stage at the end.
     """
-    pp = lax.axis_size(axis_name)
+    pp = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     total_ticks = num_microbatches + pp - 1
     perm = [(i, (i + 1) % pp) for i in range(pp)]
@@ -188,8 +195,7 @@ def pipeline_blocks(
         mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
-        axis_names={axis_name},
-        check_vma=False,
+        **partial_manual_kwargs({axis_name}),
     )(staged, x_mbs)
     if cpu_bf16:
         out = out.astype(orig_dtype)

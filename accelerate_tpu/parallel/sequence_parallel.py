@@ -20,8 +20,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import axis_size, partial_manual_kwargs
 
 
 def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causal: bool = True,
@@ -38,7 +44,7 @@ def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causa
     all-gathered to the full sequence each rank attends over (packed
     sequences; int16-sized traffic, negligible next to KV).
     """
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     b, t_local, h, d = q.shape
 
     def seq2head(x):
@@ -89,7 +95,7 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
                                  inner_attn=inner_attn)
         in_specs = (spec, spec, spec) + ((P(None, axis_name),) if with_seg else ())
         return jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=spec,
-                                 axis_names={axis_name}, check_vma=False))
+                                 **partial_manual_kwargs({axis_name})))
 
     def attn(q, k, v, *, causal: bool = True, segment_ids=None):
         h_q, h_kv = q.shape[2], k.shape[2]

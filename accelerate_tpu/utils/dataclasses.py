@@ -302,6 +302,20 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
                                             # clip_by_global_norm inside tx — use the
                                             # train step's max_grad_norm instead).
                                             # None = one monolithic region.
+    int8_state_block_size: Optional[int] = None
+                                            # per-block fp32-scale granularity for the
+                                            # -sr8 int8 optimizer-state recipes
+                                            # (ops/int8_state.py; smaller blocks = finer
+                                            # scales = lower quant noise, more scale
+                                            # bytes: 8/block B/param/moment of extra host
+                                            # traffic).  Config transport only — the
+                                            # recipes are built through
+                                            # optimizer.make_optimizer(name,
+                                            # block_size=...) or
+                                            # Accelerator.prepare_optimizer("<name>"),
+                                            # which reads this knob.  Default 128 (one
+                                            # TPU lane width); env
+                                            # ACCELERATE_INT8_STATE_BLOCK.
     activation_checkpointing: Optional[bool] = None  # jax.checkpoint on remat-policy blocks
     remat_policy: str = "nothing_saveable"  # name of a jax.checkpoint policy
     use_orig_params: bool = True            # API parity; always true under GSPMD
@@ -320,6 +334,12 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
             self.cpu_offload = parse_flag_from_env("FSDP_OFFLOAD_PARAMS")
         if self.offload_params is None:
             self.offload_params = self.cpu_offload
+        if self.int8_state_block_size is None:
+            self.int8_state_block_size = int(env.get("ACCELERATE_INT8_STATE_BLOCK", 128))
+        if self.int8_state_block_size < 1:
+            raise ValueError(
+                f"int8_state_block_size must be >= 1, got {self.int8_state_block_size}"
+            )
         if self.activation_checkpointing is None:
             self.activation_checkpointing = parse_flag_from_env("FSDP_ACTIVATION_CHECKPOINTING")
 

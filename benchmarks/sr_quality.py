@@ -22,13 +22,18 @@ import json
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--optimizer", choices=["lion-sr", "adamw-sr"], default="adamw-sr")
+    ap.add_argument("--optimizer",
+                    choices=["lion-sr", "adamw-sr", "lion-sr8", "adamw-sr8"],
+                    default="adamw-sr")
     ap.add_argument("--model", choices=["600m", "1b"], default="600m")
     ap.add_argument("--steps", type=int, default=80)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq-len", type=int, default=2048)
     ap.add_argument("--eval-every", type=int, default=10)
     ap.add_argument("--lr", type=float, default=None)
+    ap.add_argument("--int8-block", type=int, default=None,
+                    help="per-block scale granularity for the -sr8 recipes "
+                         "(default 128)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (smoke mode; the axon "
                          "sitecustomize preempts JAX_PLATFORMS env vars)")
@@ -40,7 +45,6 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
-    import optax
 
     from accelerate_tpu import Accelerator, ParallelismConfig
     from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn
@@ -80,15 +84,16 @@ def main():
     lr = args.lr or (1e-4 if "lion" in args.optimizer else 3e-4)
 
     def make_tx(kind):
-        from accelerate_tpu.ops.stochastic_rounding import adamw_bf16_sr, lion_bf16_sr
+        # the recipe registry passes weight_decay explicitly to EVERY recipe
+        # (including the optax references, whose own defaults are non-zero:
+        # adamw 1e-4, lion 1e-3) — the SR-vs-reference comparison really
+        # runs at the same hyperparameters
+        from accelerate_tpu.optimizer import make_optimizer
 
-        if kind == "lion-sr":
-            return lion_bf16_sr(lr, b1=0.9, b2=0.99)
-        if kind == "adamw-sr":
-            return adamw_bf16_sr(lr, b1=0.9, b2=0.999)
-        if kind == "lion":
-            return optax.lion(lr, b1=0.9, b2=0.99, mu_dtype=jnp.bfloat16)
-        return optax.adamw(lr, b1=0.9, b2=0.999, mu_dtype=jnp.bfloat16)
+        return make_optimizer(
+            kind, lr, weight_decay=0.0,
+            block_size=args.int8_block if kind.endswith("-sr8") else None,
+        )
 
     def run(kind):
         AcceleratorState._reset_state(reset_partial_state=True)
@@ -100,7 +105,7 @@ def main():
         model = LlamaForCausalLM(cfg)
         ids = jnp.ones((batch, 8), jnp.int32)
         params = acc.init_params(model, jax.random.key(0), ids)
-        if kind.endswith("-sr"):
+        if kind.endswith(("-sr", "-sr8")):
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(jnp.bfloat16)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
@@ -119,11 +124,18 @@ def main():
         return curve, evals
 
     sr_kind = args.optimizer
-    ref_kind = "lion" if sr_kind == "lion-sr" else "adamw"
+    from accelerate_tpu.optimizer import reference_recipe
+
+    ref_kind = reference_recipe(sr_kind)
     sr_curve, sr_evals = run(sr_kind)
     ref_curve, ref_evals = run(ref_kind)
     print(json.dumps({
-        "metric": "sr_quality_shuffled_stream", "model": args.model,
+        "metric": "sr_quality_shuffled_stream",
+        # report the EFFECTIVE config: off-TPU the harness substitutes the
+        # tiny CPU model, so labeling the output with the requested TPU
+        # model name would misattribute smoke numbers
+        "model": args.model if on_tpu else "tiny-cpu",
+        "backend": jax.default_backend(),
         "steps": args.steps, "batch": batch, "seq_len": seq, "lr": lr,
         "sr": {"optimizer": sr_kind, "train_every10": sr_curve[9::10],
                "held_out": sr_evals},

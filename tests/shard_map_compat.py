@@ -1,0 +1,14 @@
+"""One place for the jax.shard_map import fallback the test suite uses
+(mirrors parallel/collectives.partial_manual_kwargs for the package side):
+new jax exports ``jax.shard_map`` and spells the replication-check knob
+``check_vma``; old jax has only ``jax.experimental.shard_map`` with
+``check_rep``.  Tests that need the check off unpack ``**NO_CHECK``."""
+
+try:
+    from jax import shard_map
+
+    NO_CHECK = {"check_vma": False}
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+    NO_CHECK = {"check_rep": False}

@@ -4,6 +4,7 @@ that the CPU-runnable ones stay executable — the TPU-only paths are gated
 inside the scripts themselves."""
 
 import json
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -14,10 +15,17 @@ REPO = Path(__file__).resolve().parent.parent
 
 
 def _run(args):
+    # inherit the full environment (HOME, JAX/XLA vars, any rig-specific
+    # site dirs ride along via PYTHONPATH) and prepend the repo root so the
+    # subprocess imports THIS checkout — portable across machines/CI,
+    # unlike a hardcoded site path with a stripped env
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
     out = subprocess.run(
         [sys.executable, *args], cwd=REPO, capture_output=True, text=True,
-        timeout=600,
-        env={"PYTHONPATH": f"{REPO}:/root/.axon_site", "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        timeout=600, env=env,
     )
     assert out.returncode == 0, out.stderr[-2000:]
     return json.loads(out.stdout.strip().splitlines()[-1])
@@ -29,6 +37,16 @@ def test_sr_quality_harness_runs():
                 "--eval-every", "2", "--optimizer", "adamw-sr"])
     assert rep["metric"] == "sr_quality_shuffled_stream"
     assert rep["sr"]["optimizer"] == "adamw-sr" and rep["ref"]["optimizer"] == "adamw"
+    assert rep["final_held_out_gap_pct"] is not None
+    # smoke mode reports the EFFECTIVE config, not the requested TPU model
+    assert rep["model"] == "tiny-cpu" and rep["backend"] == "cpu"
+
+
+@pytest.mark.slow
+def test_sr_quality_harness_runs_sr8():
+    rep = _run(["benchmarks/sr_quality.py", "--cpu", "--steps", "4",
+                "--eval-every", "2", "--optimizer", "lion-sr8"])
+    assert rep["sr"]["optimizer"] == "lion-sr8" and rep["ref"]["optimizer"] == "lion"
     assert rep["final_held_out_gap_pct"] is not None
 
 

@@ -1,0 +1,374 @@
+"""Int8 optimizer state with SR requantization (ops/int8_state.py) — the
+host-byte floor of the offload ladder (docs/performance.md).  Pins: the
+blockwise quant round-trips within its scale bound, SR requant is unbiased
+(linear map in value space, log map in log space), the -sr8 optimizers track
+their fp32 references, nu survives where nearest rounding freezes, the optax
+delta contract reconstructs bitwise, and int8 state + scales round-trip
+through save_state/load_state bit-exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from accelerate_tpu.ops.int8_state import (
+    LOG_RANGE_BITS,
+    adamw_int8_sr,
+    dequantize_int8_blockwise,
+    dequantize_u8_log_blockwise,
+    int8_scale_shape,
+    lion_int8_sr,
+    quantize_int8_blockwise,
+    quantize_u8_log_blockwise,
+)
+
+
+# ---------------------------------------------------------------------------
+# quant/dequant primitives
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(512,), (8, 64), (100,), (1,), (3, 5), (130,)])
+def test_int8_linear_roundtrip_error_bound(shape):
+    """Nearest round-trip error is at most half a code step per element
+    (step = block absmax / 127), for divisible and non-divisible shapes."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=shape).astype(np.float32))
+    codes, scales = quantize_int8_blockwise(x, 128)
+    assert codes.shape == x.shape and codes.dtype == jnp.int8
+    assert scales.shape == int8_scale_shape(shape, 128)
+    back = dequantize_int8_blockwise(codes, scales, 128)
+    err = np.abs(np.asarray(back) - np.asarray(x)).max()
+    assert err <= 0.5 * float(np.asarray(scales).max()) + 1e-7, err
+
+
+def test_u8_log_roundtrip_relative_error():
+    """The log map holds ~one-code *relative* accuracy across orders of
+    magnitude — the property the linear map lacks and the second moment
+    needs (a denominator must never round to hard zero)."""
+    rng = np.random.default_rng(1)
+    # 6 decades of dynamic range inside each block
+    v = jnp.asarray((10.0 ** rng.uniform(-6, 0, (1024,))).astype(np.float32))
+    codes, scales = quantize_u8_log_blockwise(v, 128)
+    assert codes.dtype == jnp.uint8
+    back = np.asarray(dequantize_u8_log_blockwise(codes, scales, 128))
+    # half-code multiplicative step: 2^(LOG_RANGE_BITS/255/2)
+    factor = 2.0 ** (LOG_RANGE_BITS / 255.0 / 2.0) * 1.001
+    ratio = back / np.asarray(v)
+    assert ratio.max() <= factor and ratio.min() >= 1.0 / factor, (
+        ratio.min(), ratio.max(), factor)
+    assert (back > 0).all()  # never a hard zero
+
+    # exact zeros decode to the map floor (absmax * 2^-24), not garbage
+    z = jnp.concatenate([jnp.zeros((64,), jnp.float32), jnp.ones((64,), jnp.float32)])
+    zc, zs = quantize_u8_log_blockwise(z, 128)
+    zb = np.asarray(dequantize_u8_log_blockwise(zc, zs, 128))
+    assert zb[:64].max() <= 2.0 ** -LOG_RANGE_BITS * 1.001
+
+
+def test_int8_sr_requant_is_unbiased():
+    """E[dequant(SR-quant(x))] = x over independent salts (linear map)."""
+    x = jnp.full((2048,), 0.31337, jnp.float32)
+    rng = np.random.default_rng(2)
+    ent = jnp.asarray(rng.normal(size=(2048,)).astype(np.float32))
+    acc = 0.0
+    n = 200
+    for s in range(n):
+        c, sc = quantize_int8_blockwise(
+            x, 128, salt=jnp.uint32((s * 2654435761) & 0xFFFFFFFF), entropy=ent)
+        acc += float(np.asarray(dequantize_int8_blockwise(c, sc, 128)).mean())
+    # one code step is absmax/127 ~ 0.0025; the SR mean must sit well
+    # inside it
+    assert abs(acc / n - 0.31337) < 3e-4, acc / n
+
+
+def test_u8_log_sr_requant_is_unbiased_in_log_space():
+    """The log map's SR dithers the *code*, so the geometric mean (E[log v])
+    is what it preserves."""
+    x = jnp.full((2048,), 0.0123, jnp.float32)
+    # anchor the block scale with one absmax element per block so the
+    # tested value sits mid-map
+    x = x.at[::128].set(1.0)
+    rng = np.random.default_rng(3)
+    ent = jnp.asarray(rng.normal(size=(2048,)).astype(np.float32))
+    acc = 0.0
+    n = 200
+    mask = np.ones(2048, bool)
+    mask[::128] = False
+    for s in range(n):
+        c, sc = quantize_u8_log_blockwise(
+            x, 128, salt=jnp.uint32((s * 40503) & 0xFFFFFFFF), entropy=ent)
+        back = np.asarray(dequantize_u8_log_blockwise(c, sc, 128))
+        acc += np.log2(back[mask]).mean()
+    # one code is ~0.094 in log2; the SR mean must sit well inside it
+    assert abs(acc / n - np.log2(0.0123)) < 0.02, (acc / n, np.log2(0.0123))
+
+
+def test_sr8_codes_bounded_and_absmax_stable():
+    """SR never pushes a code out of range, and the block-absmax element
+    (whose code is exactly ±qmax) never moves."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(512,)).astype(np.float32))
+    peak = int(np.abs(np.asarray(x)).argmax())
+    for s in range(16):
+        c, sc = quantize_int8_blockwise(
+            x, 512, salt=jnp.uint32(s + 1), entropy=x)
+        cn = np.asarray(c, np.int32)
+        assert cn.max() <= 127 and cn.min() >= -127
+        assert abs(cn[peak]) == 127
+
+
+# ---------------------------------------------------------------------------
+# the -sr8 optimizers
+# ---------------------------------------------------------------------------
+
+
+def test_lion_sr8_tracks_fp32_lion():
+    """Convergence parity on a regression: bf16 SR params + int8 momentum
+    reach the same loss neighborhood as fp32-master lion."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    y = x @ rng.normal(size=(16,)).astype(np.float32)
+
+    def loss_fn(p):
+        return jnp.mean((jnp.asarray(x) @ p["w"].astype(jnp.float32) - jnp.asarray(y)) ** 2)
+
+    def train(tx, w0):
+        params = {"w": w0}
+        state = tx.init(params)
+        for _ in range(400):
+            grads = {"w": jax.grad(loss_fn)(params)["w"].astype(jnp.float32)}
+            updates, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        return float(loss_fn(params))
+
+    base = train(optax.lion(3e-3, b1=0.9, b2=0.99, weight_decay=0.0),
+                 jnp.zeros((16,), jnp.float32))
+    sr8 = train(lion_int8_sr(3e-3, b1=0.9, b2=0.99), jnp.zeros((16,), jnp.bfloat16))
+    assert sr8 < max(4 * base, 5e-3), (sr8, base)
+
+
+def test_adamw_sr8_tracks_fp32_adamw():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(256, 16)).astype(np.float32)
+    y = x @ rng.normal(size=(16,)).astype(np.float32)
+
+    def loss_fn(p):
+        return jnp.mean((jnp.asarray(x) @ p["w"].astype(jnp.float32) - jnp.asarray(y)) ** 2)
+
+    def train(tx, w0):
+        params = {"w": w0}
+        state = tx.init(params)
+        for _ in range(400):
+            grads = {"w": jax.grad(loss_fn)(params)["w"].astype(jnp.float32)}
+            updates, state = tx.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        return float(loss_fn(params))
+
+    base = train(optax.adamw(3e-2, weight_decay=0.0), jnp.zeros((16,), jnp.float32))
+    sr8 = train(adamw_int8_sr(3e-2), jnp.zeros((16,), jnp.bfloat16))
+    assert sr8 < max(4 * base, 5e-3), (sr8, base)
+
+
+@pytest.mark.slow
+def test_sr8_nu_log_sr_tracks_where_nearest_freezes():
+    """The log-map SR second-moment EMA reaches its per-lane fixed point g²
+    even when per-step increments sit far below one code, while NEAREST
+    rounding on the same map stalls at ~3% of it.
+
+    The block scale must be *pinned* to expose the freeze: lane 0 carries
+    the block absmax and starts exactly at its own fixed point, so the
+    stored fp32 scale never moves.  (While the absmax lane is still
+    converging, its fp32-exact motion shifts every other lane's code phase
+    each step — an incidental dither that masks the nearest freeze; the
+    optimizer inherits that robustness for free, but the mechanism test
+    needs it off.)  The other lanes' relative EMA increment
+    (1-b2)(g²/v - 1) drops below half a code (~3.3%) at v ≈ g²/34 —
+    nearest stops there; SR keeps moving in expectation."""
+    n, steps, b2, block = 256, 4000, 0.999, 256
+    rng = np.random.default_rng(0)
+    g2 = rng.uniform(0.2, 0.3, n).astype(np.float32)
+    g2[0] = 1.0                  # lane 0 pins the block scale...
+    v0 = np.zeros(n, np.float32)
+    v0[0] = 1.0                  # ...and starts at its fixed point
+    ent = jnp.asarray(rng.normal(size=(n,)).astype(np.float32))
+
+    def run(salted):
+        v = jnp.asarray(v0)
+        for t in range(steps):
+            v32 = b2 * v + (1 - b2) * jnp.asarray(g2)
+            salt = jnp.uint32((t * 2654435761) & 0xFFFFFFFF) if salted else None
+            c, s = quantize_u8_log_blockwise(v32, block, salt=salt, entropy=ent)
+            v = dequantize_u8_log_blockwise(c, s, block)
+        return np.asarray(v)
+
+    target = g2[1:] * (1.0 - b2 ** steps)
+    near_ratio = (run(False)[1:] / target).mean()
+    sr_ratio = (run(True)[1:] / target).mean()
+    # measured: nearest stalls at ~0.031x the fixed point; SR lands at
+    # ~1.002x with ~0.05 log2 dispersion across lanes
+    assert near_ratio < 0.2, near_ratio
+    assert abs(sr_ratio - 1.0) < 0.1, sr_ratio
+
+
+@pytest.mark.parametrize("make_tx", [lion_int8_sr, adamw_int8_sr])
+def test_sr8_apply_updates_reconstructs_bitwise(make_tx):
+    """Same optax delta contract as the bf16-SR recipes: the fp32 delta
+    through apply_updates lands exactly on the stochastically rounded
+    weight (no second rounding)."""
+    key = jax.random.key(11)
+    p = {"w": jax.random.normal(key, (512,), jnp.float32).astype(jnp.bfloat16)}
+    g = {"w": jax.random.normal(jax.random.fold_in(key, 1), (512,), jnp.float32)}
+    tx = make_tx(3e-3)
+    state = tx.update(g, tx.init(p), p)[1]
+    updates, state = tx.update(g, state, p)
+    applied = optax.apply_updates(p, updates)
+    expect = np.asarray(p["w"], np.float32) + np.asarray(updates["w"], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(applied["w"], np.float32),
+        expect.astype(jnp.bfloat16).astype(np.float32),
+    )
+    assert applied["w"].dtype == jnp.bfloat16
+    assert state.mu["w"].dtype == jnp.int8
+    assert state.mu_scale["w"].dtype == jnp.float32
+
+
+@pytest.mark.parametrize("make_tx", [lion_int8_sr, adamw_int8_sr])
+def test_sr8_update_requires_params(make_tx):
+    tx = make_tx()
+    state = tx.init({"w": jnp.zeros((4,), jnp.bfloat16)})
+    with pytest.raises(ValueError, match="params"):
+        tx.update({"w": jnp.zeros((4,), jnp.bfloat16)}, state)
+
+
+def test_sr8_update_is_deterministic():
+    """The hashed SR keys derive from (count, leaf, value, grad) only —
+    identical inputs give bit-identical codes (the offload==resident and
+    bit-exact-resume contract)."""
+    rng = np.random.default_rng(5)
+    p = {"w": jnp.asarray(rng.normal(size=(256,)).astype(np.float32)).astype(jnp.bfloat16)}
+    g = {"w": jnp.asarray(rng.normal(size=(256,)).astype(np.float32))}
+    tx = adamw_int8_sr(1e-3)
+    u1, s1 = tx.update(g, tx.init(p), p)
+    u2, s2 = tx.update(g, tx.init(p), p)
+    np.testing.assert_array_equal(np.asarray(s1.mu["w"]), np.asarray(s2.mu["w"]))
+    np.testing.assert_array_equal(np.asarray(s1.nu["w"]), np.asarray(s2.nu["w"]))
+    np.testing.assert_array_equal(
+        np.asarray(u1["w"], np.float32), np.asarray(u2["w"], np.float32))
+
+
+# ---------------------------------------------------------------------------
+# registry + plugin knob + checkpoint round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_make_optimizer_registry():
+    from accelerate_tpu.optimizer import OPTIMIZER_RECIPES, make_optimizer, reference_recipe
+
+    assert reference_recipe("lion-sr8") == "lion"
+    assert reference_recipe("adamw-sr") == "adamw"
+    p = {"w": jnp.zeros((300,), jnp.bfloat16)}
+    for name in OPTIMIZER_RECIPES:
+        tx = make_optimizer(name)
+        tx.init(p)  # constructible + initializable
+    # block_size shapes the scale leaves of the -sr8 recipes
+    st = make_optimizer("lion-sr8", block_size=64).init(p)
+    assert st.mu_scale["w"].shape == (5,)  # ceil(300/64)
+    with pytest.raises(ValueError, match="block_size"):
+        make_optimizer("lion", block_size=64)
+    with pytest.raises(ValueError, match="unknown optimizer recipe"):
+        make_optimizer("sgd-sr8")
+
+
+def test_prepare_optimizer_by_name_reads_plugin_block_size():
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            min_weight_size=0, int8_state_block_size=32),
+    )
+    opt = acc.prepare_optimizer("adamw-sr8")
+    st = opt.init({"w": jnp.zeros((256,), jnp.bfloat16)})
+    assert st.mu_scale["w"].shape == (8,)  # 256/32 blocks: the knob landed
+    assert st.mu["w"].dtype == jnp.int8 and st.nu["w"].dtype == jnp.uint8
+
+
+def test_int8_state_block_size_env_default(monkeypatch):
+    from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+    assert FullyShardedDataParallelPlugin().int8_state_block_size == 128
+    monkeypatch.setenv("ACCELERATE_INT8_STATE_BLOCK", "256")
+    assert FullyShardedDataParallelPlugin().int8_state_block_size == 256
+    # explicit argument wins over env (the plugin env contract)
+    assert FullyShardedDataParallelPlugin(
+        int8_state_block_size=64).int8_state_block_size == 64
+    with pytest.raises(ValueError, match="int8_state_block_size"):
+        FullyShardedDataParallelPlugin(int8_state_block_size=0)
+
+
+@pytest.mark.parametrize("recipe", ["lion-sr8", "adamw-sr8"])
+def test_sr8_state_checkpoint_roundtrip_bit_exact(tmp_path, recipe):
+    """save_state/load_state round-trips the int8 codes and fp32 scales
+    BIT-exactly (codes are hash-keyed — a lossy round-trip would fork the
+    SR stream on resume), and training continues."""
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        project_dir=str(tmp_path), mixed_precision="bf16",
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=0, cpu_offload=True),
+    )
+    rng = np.random.default_rng(0)
+    params = {
+        "dense": {"kernel": jnp.asarray(rng.normal(size=(8, 64)).astype(np.float32)) * 0.1,
+                  "bias": jnp.zeros((64,))},
+        "out": {"kernel": jnp.asarray(rng.normal(size=(64, 1)).astype(np.float32)) * 0.1,
+                "bias": jnp.zeros((1,))},
+    }
+    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), params)
+    state = acc.create_train_state(params, acc.prepare_optimizer(recipe))
+
+    def loss(p, batch):
+        h = jnp.tanh(batch["x"] @ p["dense"]["kernel"] + p["dense"]["bias"])
+        pred = (h @ p["out"]["kernel"] + p["out"]["bias"])[..., 0]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    step = acc.prepare_train_step(loss, max_grad_norm=None)
+    batch = {"x": jnp.asarray(rng.normal(size=(16, 8)).astype(np.float32)),
+             "y": jnp.asarray(rng.normal(size=(16,)).astype(np.float32))}
+    for _ in range(3):
+        state, _ = step(state, batch)
+
+    path = acc.save_state(train_state=state)
+    zeroed = state.replace(
+        params=jax.tree_util.tree_map(jnp.zeros_like, state.params),
+        opt_state=jax.tree_util.tree_map(jnp.zeros_like, state.opt_state),
+    )
+    restored = acc.load_state(path, train_state=zeroed)
+
+    def assert_identical(a, b):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    jax.tree_util.tree_map(assert_identical, restored.opt_state, state.opt_state)
+    jax.tree_util.tree_map(assert_identical, restored.params, state.params)
+    # int8/uint8 codes really came back as integer dtypes
+    assert restored.opt_state.mu["dense"]["kernel"].dtype == jnp.int8
+    if recipe == "adamw-sr8":
+        assert restored.opt_state.nu["dense"]["kernel"].dtype == jnp.uint8
+
+    # resumed training takes the SAME trajectory as uninterrupted training
+    # (deterministic SR keys + bit-exact state)
+    cont, _ = step(state, batch)
+    res, _ = step(restored, batch)
+    jax.tree_util.tree_map(assert_identical, cont.params, res.params)

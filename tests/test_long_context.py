@@ -165,7 +165,7 @@ def test_ulysses_in_jitted_train_step(sp_mesh):
 
 
 def test_cross_rank_token_mean(sp_mesh):
-    from jax.experimental.shard_map import shard_map
+    from shard_map_compat import NO_CHECK, shard_map
 
     from accelerate_tpu.parallel.sequence_parallel import cross_rank_token_mean
 
@@ -176,7 +176,7 @@ def test_cross_rank_token_mean(sp_mesh):
         return cross_rank_token_mean(loss, mask, ("sp",))
 
     f = shard_map(body, mesh=sp_mesh, in_specs=(P(None, "sp"), P(None, "sp")),
-                  out_specs=P(), check_rep=False)
+                  out_specs=P(), **NO_CHECK)
     out = float(f(loss, mask))
     assert out == pytest.approx(float(jnp.mean(loss)))
 

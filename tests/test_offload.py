@@ -335,8 +335,11 @@ def test_offload_lion_sr_bf16_masters_trains():
     GradientState._reset_state()
     acc_ref = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8),
                           mixed_precision="bf16")
+    # weight_decay=0.0 explicitly: optax.lion's own default is 1e-3, the SR
+    # recipe's is 0.0 — the reference must run the same hyperparameters
     ref_state = acc_ref.create_train_state(
         _mlp_params(), acc_ref.prepare(optax.lion(3e-3, b1=0.9, b2=0.99,
+                                                  weight_decay=0.0,
                                                   mu_dtype=jnp.bfloat16)))
     ref_step = acc_ref.prepare_train_step(_mlp_loss, max_grad_norm=None)
     ref_losses = []
@@ -393,8 +396,10 @@ def test_offload_adamw_sr_bf16_masters_trains():
     GradientState._reset_state()
     acc_ref = Accelerator(parallelism_config=ParallelismConfig(dp_shard_size=8),
                           mixed_precision="bf16")
+    # weight_decay=0.0 explicitly: optax.adamw's own default is 1e-4, the SR
+    # recipe's is 0.0 — the reference must run the same hyperparameters
     ref_state = acc_ref.create_train_state(
-        _mlp_params(), acc_ref.prepare(optax.adamw(3e-3)))
+        _mlp_params(), acc_ref.prepare(optax.adamw(3e-3, weight_decay=0.0)))
     ref_step = acc_ref.prepare_train_step(_mlp_loss, max_grad_norm=None)
     ref_losses = []
     for batch in _batches(n=6):
@@ -406,3 +411,59 @@ def test_offload_adamw_sr_bf16_masters_trains():
     assert jax.tree_util.tree_leaves(params_chunk)[0].dtype == jnp.bfloat16
     assert np.isfinite(losses_chunk).all()
     np.testing.assert_allclose(losses_chunk, ref_losses, rtol=0.35)
+
+
+def _run_sr8(recipe, offload, chunk_gib=None):
+    """The -sr8 recipes (ops/int8_state.py: bf16 SR params + int8 blockwise
+    moment state) through the full offload machinery on the CPU mesh."""
+    from accelerate_tpu.utils.dataclasses import GradSyncKwargs
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    plugin = FullyShardedDataParallelPlugin(
+        min_weight_size=0, cpu_offload=offload, host_update_chunk_gib=chunk_gib
+    )
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dp_shard_size=8),
+        fsdp_plugin=plugin, mixed_precision="bf16",
+        kwargs_handlers=[GradSyncKwargs(grad_dtype="bf16")],
+    )
+    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), _mlp_params())
+    state = acc.create_train_state(params, acc.prepare_optimizer(recipe))
+    step = acc.prepare_train_step(_mlp_loss, max_grad_norm=None)
+    losses = []
+    for batch in _batches(n=6):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    return losses, jax.device_get(state.params), jax.device_get(state.opt_state)
+
+
+@pytest.mark.parametrize("recipe", ["lion-sr8", "adamw-sr8"])
+def test_offload_sr8_matches_resident_bitwise(recipe):
+    """Bitwise expectation, documented: the -sr8 update is per-leaf
+    deterministic (hashed SR keys from (count, leaf, value, grad) — no RNG
+    state), so the host-compute offload run must reproduce the resident run
+    EXACTLY: same losses, bit-identical bf16 params, bit-identical int8/uint8
+    codes and fp32 scales.  Chunked grouping re-keys the per-leaf salts
+    (group-relative leaf indices), so the chunked run is asserted to train,
+    not to match bitwise."""
+    losses_res, params_res, opt_res = _run_sr8(recipe, offload=False)
+    losses_off, params_off, opt_off = _run_sr8(recipe, offload=True)
+    assert jax.tree_util.tree_leaves(params_res)[0].dtype == jnp.bfloat16
+    np.testing.assert_allclose(losses_off, losses_res, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params_off, params_res
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), opt_off, opt_res
+    )
+    # the moment codes really are 8-bit storage
+    assert opt_off.mu["dense"]["kernel"].dtype == jnp.int8
+    if recipe == "adamw-sr8":
+        assert opt_off.nu["dense"]["kernel"].dtype == jnp.uint8
+
+    losses_chunk, params_chunk, _ = _run_sr8(recipe, offload=True, chunk_gib=1e-6)
+    assert jax.tree_util.tree_leaves(params_chunk)[0].dtype == jnp.bfloat16
+    assert np.isfinite(losses_chunk).all()
+    # chunked offload must still land in the resident run's loss neighborhood
+    np.testing.assert_allclose(losses_chunk, losses_res, rtol=0.35)

@@ -115,7 +115,7 @@ def test_listify():
 
 
 def test_psum_over_mesh(mesh8):
-    from jax.experimental.shard_map import shard_map
+    from shard_map_compat import shard_map
 
     x = jnp.arange(8.0)
 
@@ -128,20 +128,20 @@ def test_psum_over_mesh(mesh8):
 
 
 def test_all_gather_over_mesh(mesh8):
-    from jax.experimental.shard_map import shard_map
+    from shard_map_compat import NO_CHECK, shard_map
 
     x = jnp.arange(8.0)
 
     def body(x):
         return collectives.all_gather(x, "dp_shard", axis=0, tiled=True)
 
-    f = shard_map(body, mesh=mesh8, in_specs=P("dp_shard"), out_specs=P(None), check_rep=False)
+    f = shard_map(body, mesh=mesh8, in_specs=P("dp_shard"), out_specs=P(None), **NO_CHECK)
     out = f(x)
     np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
 
 
 def test_ring_permute(mesh8):
-    from jax.experimental.shard_map import shard_map
+    from shard_map_compat import shard_map
 
     x = jnp.arange(8.0)
 
@@ -154,7 +154,7 @@ def test_ring_permute(mesh8):
 
 
 def test_reduce_scatter(mesh8):
-    from jax.experimental.shard_map import shard_map
+    from shard_map_compat import shard_map
 
     x = jnp.ones((64, 8))
 
@@ -170,7 +170,7 @@ def test_reduce_scatter(mesh8):
 
 
 def test_all_to_all(mesh8):
-    from jax.experimental.shard_map import shard_map
+    from shard_map_compat import shard_map
 
     x = jnp.arange(64.0).reshape(8, 8)
 
