@@ -52,6 +52,7 @@ try:
         load_checkpoint_and_dispatch,
         load_checkpoint_in_model,
         offload_state_dict,
+        offload_store_params,
         offloaded_apply,
     )
 except ImportError:  # pragma: no cover
@@ -110,5 +111,9 @@ try:
         place_params_host,
         sample_logits,
     )
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .ops.streaming import LayerPrefetcher, StreamStats
 except ImportError:  # pragma: no cover
     pass

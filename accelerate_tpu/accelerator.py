@@ -119,27 +119,17 @@ def _tree_zeros_like(tree, dtype=jnp.float32):
 # The ZeRO-offload optimizer update runs as XLA host compute.  A monolithic
 # region materializes the whole tree's transients at once (fp32 grad upcasts +
 # moment temps — at 7B with adamw that working set crashes the TPU worker
-# host).  These helpers split params/grads/opt_state into leaf groups of
-# bounded fp32 bytes so each compute_on region touches one group; per-leaf
+# host).  The chunking/slicing/merging machinery lives in ops/streaming.py
+# (shared with the layer-streamed decode path); the names are re-bound here
+# because the train step below and its tests grew up around them.  Per-leaf
 # optimizers (adamw/lion/sgd/…) are bit-exact under the split.
 
-
-def _host_update_groups(params, chunk_bytes: int) -> list[list[int]]:
-    """Partition the params' leaf indices into contiguous groups whose fp32
-    footprint stays under ``chunk_bytes`` (one oversized leaf = own group)."""
-    groups: list[list[int]] = []
-    cur: list[int] = []
-    size = 0
-    for i, leaf in enumerate(jax.tree_util.tree_leaves(params)):
-        n = int(np.prod(leaf.shape)) * 4 if hasattr(leaf, "shape") else 4
-        if cur and size + n > chunk_bytes:
-            groups.append(cur)
-            cur, size = [], 0
-        cur.append(i)
-        size += n
-    if cur:
-        groups.append(cur)
-    return groups
+from .ops.streaming import (  # noqa: E402  (grouped with the helper block)
+    chunk_groups as _host_update_groups,
+    merge_congruent as _merge_congruent,
+    slice_congruent as _slice_congruent,
+    stage_put as _stage_put,
+)
 
 
 def _host_constant_hoist(fn, host_sharding, *example_args):
@@ -232,56 +222,6 @@ def _host_constant_hoist_unsafe(fn, host_sharding, *example_args):
         return jax.tree_util.tree_unflatten(out_tree, outs)
 
     return call
-
-
-def _is_congruent_to(treedef):
-    def check(node):
-        try:
-            return jax.tree_util.tree_structure(node) == treedef
-        except Exception:  # pragma: no cover - exotic nodes
-            return False
-
-    return check
-
-
-def _slice_congruent(tree, treedef, idxs: list[int]):
-    """Replace every params-congruent subtree of ``tree`` (per-leaf optimizer
-    moments, or the params tree itself) by the tuple of its selected leaves;
-    scalars and other leaves pass through.  The result is a valid optax state
-    for an update over the matching sliced params tuple."""
-    check = _is_congruent_to(treedef)
-    return jax.tree_util.tree_map(
-        lambda sub: (
-            tuple(jax.tree_util.tree_leaves(sub)[i] for i in idxs)
-            if check(sub)
-            else sub  # shared scalar (e.g. adam count) — passes whole
-        ),
-        tree,
-        is_leaf=check,
-    )
-
-
-def _merge_congruent(template, group_outs: list, treedef, groups: list[list[int]]):
-    """Inverse of :func:`_slice_congruent` across all groups: rebuild each
-    congruent subtree from the per-group output tuples; non-congruent leaves
-    (shared scalars like adam's count — every group advances it identically)
-    come from group 0."""
-
-    def merge(orig_sub, *outs):
-        if _is_congruent_to(treedef)(orig_sub):
-            leaves: list = [None] * treedef.num_leaves
-            for idxs, out in zip(groups, outs):
-                out_leaves = (
-                    list(out) if isinstance(out, tuple) else jax.tree_util.tree_leaves(out)
-                )
-                for j, i in enumerate(idxs):
-                    leaves[i] = out_leaves[j]
-            return jax.tree_util.tree_unflatten(treedef, leaves)
-        return outs[0]
-
-    return jax.tree_util.tree_map(
-        merge, template, *group_outs, is_leaf=_is_congruent_to(treedef)
-    )
 
 
 def global_norm(tree) -> jax.Array:
@@ -852,6 +792,17 @@ class Accelerator:
             and self.fsdp_plugin.host_update_chunk_gib
             else None
         )
+        # 3-stage software pipeline over the chunk sequence (ops/streaming.py):
+        # stage A (per-chunk D2H grad staging) and stage C (per-chunk output
+        # write-back) are issued un-gated by the update token chain, so chunk
+        # k+1's grads and chunk k-1's outputs are in transfer flight while
+        # chunk k's host region runs.  host_update_pipeline=False restores
+        # the fully serialized schedule (the A/B baseline).
+        pipeline_offload = bool(
+            chunk_bytes is not None
+            and self.fsdp_plugin is not None
+            and self.fsdp_plugin.host_update_pipeline
+        )
         if chunk_bytes is not None:
             # per-group updates cannot be detected as wrong for cross-leaf
             # transforms (clip_by_global_norm's state is empty), so say it
@@ -1013,11 +964,20 @@ class Accelerator:
                 params_master = state.params
                 psh = _stored_params_shardings()
                 grads_in, finite_in = grads, finite
+                ghost = None
+                # Stage A granularity: per-chunk D2H staging needs the
+                # pipeline AND no host-side global clip (the clip's norm is
+                # an all-grads barrier, so the whole tree must be host-side
+                # before any chunk can start — bulk staging is then optimal).
+                stage_a_per_chunk = pipeline_offload and not gnorm_on_host
                 if kinds_ok and psh is not None:
                     ghost = host_plan(psh)
                     # every operand of the host region must sit in host memory
-                    # space — jax 0.9 rejects mixed-space elementwise ops
-                    grads_in = jax.tree_util.tree_map(jax.device_put, grads, ghost)
+                    # space — jax 0.9 rejects mixed-space elementwise ops.
+                    # Under the chunk pipeline each chunk stages its own
+                    # grads (stage A below) instead of this bulk move.
+                    if not stage_a_per_chunk:
+                        grads_in = jax.tree_util.tree_map(jax.device_put, grads, ghost)
                     if not offload_params:
                         params_master = jax.tree_util.tree_map(jax.device_put, state.params, ghost)
                     if loss_scale is not None:
@@ -1055,8 +1015,21 @@ class Accelerator:
                             params_master, state.opt_state, params_master, finite_in,
                         ) is not run_update
                     )
+                    osh = getattr(self._state_sharding, "opt_state", None)
                     for idxs in groups:
-                        g_grads = _slice_congruent(grads_in, treedef, idxs)
+                        if stage_a_per_chunk:
+                            # Stage A (D2H): this chunk's grads are staged as
+                            # their own transfer, OUTSIDE the token chain —
+                            # chunk k+1's grads fly while chunk k's host
+                            # region runs.  Same values as the bulk move, so
+                            # the update stays bitwise-identical.
+                            g_grads = _slice_congruent(grads, treedef, idxs)
+                            if kinds_ok and ghost is not None:
+                                g_grads = _stage_put(
+                                    g_grads, _slice_congruent(ghost, treedef, idxs)
+                                )
+                        else:
+                            g_grads = _slice_congruent(grads_in, treedef, idxs)
                         g_params = _slice_congruent(params_master, treedef, idxs)
                         g_opt = _slice_congruent(state.opt_state, treedef, idxs)
                         upd = run_update
@@ -1096,6 +1069,25 @@ class Accelerator:
                                 if hasattr(leaf, "ravel") and getattr(leaf, "size", 0)
                             ]
                             token = sum(deps) if deps else None
+                        if pipeline_offload and psh is not None:
+                            # Stage C (write-back): this chunk's outputs
+                            # return to their storage spaces immediately and
+                            # OFF the token chain (the token was formed from
+                            # the pre-placement host values above), so chunk
+                            # k-1's write-back flies under chunk k's update.
+                            # Deliberately NOT gated on kinds_ok: on the CPU
+                            # test mesh the placements are memory-kind-free
+                            # no-ops value-wise, but they make the pipelined
+                            # trace genuinely different from the serial one —
+                            # which is what gives the pipelined-vs-serial
+                            # parity tests teeth off-chip.
+                            g_new_params = _stage_put(
+                                g_new_params, _slice_congruent(psh, treedef, idxs)
+                            )
+                            if osh is not None:
+                                g_new_opt = _stage_put(
+                                    g_new_opt, _slice_congruent(osh, treedef, idxs)
+                                )
                         group_outs.append((g_new_params, g_new_opt))
                     new_params = _merge_congruent(
                         params_master, [o[0] for o in group_outs], treedef, groups
@@ -1126,10 +1118,14 @@ class Accelerator:
                                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                                 grads_in = jax.tree_util.tree_map(lambda g: g * clip, grads_in)
                         new_params, new_opt = upd(grads_in, state.opt_state, params_master, finite_in)
-                if kinds_ok and psh is not None:
+                if kinds_ok and psh is not None and not (
+                    chunk_bytes is not None and pipeline_offload
+                ):
                     # pin the host-execute outputs back to their storage
                     # spaces — libtpu's host-compute alias assigner aborts on
-                    # unannotated outputs aliased with pinned-host inputs
+                    # unannotated outputs aliased with pinned-host inputs.
+                    # (The chunk pipeline already placed each chunk's outputs
+                    # in stage C above.)
                     osh = getattr(self._state_sharding, "opt_state", None)
                     if osh is not None:
                         new_opt = jax.tree_util.tree_map(jax.device_put, new_opt, osh)

@@ -338,6 +338,29 @@ def offload_state_dict(save_dir: str, state_dict: Mapping[str, Any]) -> OffloadS
     return store
 
 
+def offload_store_params(store: OffloadStore) -> dict:
+    """Rebuild the nested params pytree from an :class:`OffloadStore` as
+    **lazy memmap leaves** — the disk tier behind
+    :func:`~accelerate_tpu.generation.generate_streamed`.
+
+    Each leaf stays an ``np.memmap`` until its layer's turn to stream, so
+    building the tree costs no RAM; ``generate_streamed``'s
+    :class:`~accelerate_tpu.ops.streaming.LayerPrefetcher` then uploads
+    layer *k+1* straight from its ``.dat`` files into the device-side double
+    buffer while layer *k*'s matmuls run (page-cache-warm files overlap like
+    host RAM; cold files add the disk read to the hidden transfer).  Keys
+    are the '/'-joined tree paths :func:`offload_state_dict` /
+    :func:`load_checkpoint_in_model` wrote."""
+    tree: dict = {}
+    for key in store.keys():
+        parts = key.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = store.load(key)
+    return tree
+
+
 # ---------------------------------------------------------------------------
 # Checkpoint streaming into shards
 # ---------------------------------------------------------------------------

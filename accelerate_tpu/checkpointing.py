@@ -24,6 +24,7 @@ safetensors — the ``accelerate merge-weights`` CLI capability
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import pickle
@@ -70,6 +71,17 @@ def _is_key_array(a) -> bool:
         return isinstance(a, jax.Array) and jnp.issubdtype(a.dtype, jax.dtypes.prng_key)
     except Exception:  # pragma: no cover - exotic leaves
         return False
+
+
+@functools.lru_cache(maxsize=256)
+def _sharded_copy_fn(sharding):
+    """Memoized jit identity-copy pinned to ``sharding`` (incl. its memory
+    kind) — the async-save snapshot primitive.  One wrapper per distinct
+    sharding: re-building the jit per leaf per save would retrace the copy
+    every checkpoint, stalling the synchronous half of async saves."""
+    import jax.numpy as jnp
+
+    return jax.jit(jnp.copy, out_shardings=sharding)
 
 
 # ---------------------------------------------------------------------------
@@ -279,6 +291,29 @@ def save_accelerator_state(
             if a is not None
         }
         if async_save:
+            # snapshot before handing off to the background writer: the
+            # prepared train step DONATES its input state, so the next step
+            # may overwrite these very buffers in place while the async
+            # write is still reading them (on the CPU backend orbax's write
+            # aliases the arrays zero-copy, and checkpoint_N restores with
+            # checkpoint_N+1's values).  The copy must PRESERVE the source
+            # sharding including its memory kind — a bare jnp.array copy
+            # would land pinned-host offloaded masters/moments in device
+            # HBM (the very tree offload keeps out of it) and rejects
+            # non-fully-addressable multi-host arrays; a jit identity-copy
+            # pinned to the source sharding handles both.  This is the
+            # synchronous-snapshot half of async checkpointing's contract.
+            import jax.numpy as jnp
+
+            def _snapshot(v):
+                if not isinstance(v, jax.Array):
+                    return v
+                try:
+                    return _sharded_copy_fn(v.sharding)(v)
+                except (TypeError, ValueError):  # exotic/uncommitted sharding
+                    return jnp.array(v, copy=True)
+
+            array_tree = {k: _snapshot(v) for k, v in array_tree.items()}
             # one long-lived AsyncCheckpointer per accelerator (orbax's
             # intended reuse pattern — no thread-pool churn per save)
             ckptr = getattr(accelerator, "_async_checkpointer", None)

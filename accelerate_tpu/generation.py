@@ -470,21 +470,43 @@ def generate_streamed(
     *,
     prompt_lengths=None,
     rng=None,
+    prefetch: bool = True,
+    prefetch_depth: int = 1,
+    stream_stats=None,
+    capture_logits: Optional[list] = None,
 ):
     """Generate from a model whose weights do NOT fit in HBM.
 
     ``params`` lives in (pinned) host memory — see :func:`place_params_host`
-    — and every forward streams one layer's weights to the device at a
-    time: HBM holds one layer + the KV cache, so the model-size ceiling is
-    host RAM, not HBM (the reference's CPU/disk-offload inference mode,
-    OPT-30B on a 24GB card at seconds/token — same trade here).  int8
-    ``QuantizedTensor`` leaves stream at one byte per weight and hit the
-    Pallas in-tile-dequant matmul on device.
+    — or carries numpy/memmap leaves straight out of an
+    :class:`~accelerate_tpu.big_modeling.OffloadStore` (see
+    :func:`~accelerate_tpu.big_modeling.offload_store_params`), and every
+    forward streams one layer's
+    weights to the device at a time: HBM holds ``prefetch_depth + 1`` layers
+    + the KV cache, so the model-size ceiling is host RAM (or disk), not HBM
+    (the reference's CPU/disk-offload inference mode, OPT-30B on a 24GB card
+    at seconds/token — same trade here).  int8 ``QuantizedTensor`` leaves
+    stream at one byte per weight and hit the Pallas in-tile-dequant matmul
+    on device.
+
+    With ``prefetch=True`` (default) the uploads are **double-buffered**
+    (:class:`~accelerate_tpu.ops.streaming.LayerPrefetcher`): layer *k+1*'s
+    H2D copy is dispatched before the loop blocks on layer *k*, so the next
+    layer streams in under the current layer's matmuls, and layer 0's
+    weights for the next token ride under the LM head + sampling.
+    ``prefetch=False`` restores the serial fetch-inside-the-layer schedule
+    (the A/B baseline — both produce identical logits, pinned by
+    ``tests/test_generation.py``).  Pass a
+    :class:`~accelerate_tpu.ops.streaming.StreamStats` as ``stream_stats``
+    for overlap accounting (bytes, stall time, hits); ``capture_logits``
+    (a list) collects each forward's logits for parity checks.
 
     The decode loop is host-driven (one dispatch per layer per token) —
-    latency is dominated by the per-token PCIe sweep over the weights,
-    exactly like the reference's offload decode.
+    without prefetch, latency is dominated by the per-token PCIe sweep over
+    the weights, exactly like the reference's offload decode.
     """
+    import time as _time
+
     generation_config = generation_config or GenerationConfig()
     cfg = model.config
     input_ids = jnp.asarray(input_ids, jnp.int32)
@@ -495,18 +517,21 @@ def generate_streamed(
         prompt_lengths = jnp.asarray(prompt_lengths, jnp.int32)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    t_start = _time.perf_counter()
 
     p = params["params"] if "params" in params else params
+    from .ops.streaming import LayerPrefetcher
     from .parallel.sharding import host_offload_supported, single_device_sharding
 
     embed = p["embed_tokens"]["embedding"]
     head = embed if cfg.tie_word_embeddings else p["lm_head"]["kernel"]
     norm_scale = p["norm"]["scale"]
-    if host_offload_supported():
+    kinds_ok = host_offload_supported()
+    dev = single_device_sharding() if kinds_ok else None
+    if kinds_ok:
         # the embedding/norm/head tier stays HBM-resident (about one layer's
         # worth) — re-streaming the [V, H] table every token would waste
         # ~0.5 GiB of PCIe per step at 7B-class vocab sizes
-        dev = single_device_sharding()
         embed = jax.device_put(embed, dev)
         head = embed if cfg.tie_word_embeddings else jax.device_put(head, dev)
         norm_scale = jax.device_put(norm_scale, dev)
@@ -514,11 +539,39 @@ def generate_streamed(
     cache = init_cache(cfg, b, max_len)
     embed_fn, block_fn, head_fn = _streamed_fns(model)
 
+    fetcher = None
+    if prefetch or stream_stats is not None:
+        # stream_stats with prefetch=False still routes fetches through the
+        # (disabled) prefetcher: the blocking out-of-jit fetches it does are
+        # the measured serial-transfer baseline overlap_report() compares
+        # against.  Without stats, prefetch=False keeps the original
+        # fetch-inside-the-layer-jit schedule.
+        def _fetch_layer(i):
+            # H2D upload OUTSIDE the layer's jit: jax dispatch is async, so
+            # the copy proceeds while the in-flight layer's matmuls run —
+            # the serial path copied *inside* block_fn, taking turns with
+            # compute.  memmap leaves (OffloadStore disk tier) upload the
+            # same way; QuantizedTensor leaves stream their int8 codes.
+            def _put(x):
+                x = np.asarray(x) if isinstance(x, np.memmap) else x
+                return jax.device_put(x, dev) if dev is not None else jax.device_put(x)
+
+            return jax.tree_util.tree_map(_put, p[f"layers_{i}"])
+
+        fetcher = LayerPrefetcher(
+            _fetch_layer, cfg.num_hidden_layers, depth=prefetch_depth,
+            wrap=True, enabled=prefetch, stats=stream_stats,
+        )
+
     def forward(ids, positions, write_mask):
         x = embed_fn(embed, ids)
         for i in range(cfg.num_hidden_layers):
-            x, cache[i] = block_fn(p[f"layers_{i}"], x, positions, cache[i], write_mask)
-        return head_fn(norm_scale, head, x)
+            layer = fetcher.get(i) if fetcher is not None else p[f"layers_{i}"]
+            x, cache[i] = block_fn(layer, x, positions, cache[i], write_mask)
+        logits = head_fn(norm_scale, head, x)
+        if capture_logits is not None:
+            capture_logits.append(logits)
+        return logits
 
     positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
     logits = forward(positions=positions, ids=input_ids,
@@ -542,4 +595,8 @@ def generate_streamed(
                          write_mask=~done[:, None])
         last = logits[:, 0]
         cur_pos = cur_pos + 1
-    return jnp.stack(out, axis=1)
+    tokens = jnp.stack(out, axis=1)
+    if stream_stats is not None:
+        jax.block_until_ready(tokens)
+        stream_stats.wall_s += _time.perf_counter() - t_start
+    return tokens

@@ -176,6 +176,10 @@ def lion_bf16_sr(
     drops **16 → 10 B/param** (fp32 path: master r+w 8, momentum r+w 4,
     grad r 2, bf16 compute-copy w 2; SR path: param r+w 4, momentum r+w
     4, grad r 2 — the param IS the compute copy, so no cast write).
+
+    Validated envelope: 600m/1.35B resident and 600m/7B offload on chip
+    (859-888 tok/s/chip at 7B), held-out-quality-checked to 200 steps on
+    the sr_quality harness (docs/performance.md).
     """
 
     def init(params):
@@ -264,6 +268,13 @@ def adamw_bf16_sr(
     hyperparams (a literal would materialize leaf-sized in the host region),
     fp32 delta return (exact — ``optax.apply_updates`` reconstructs the
     rounded weight bit-for-bit).
+
+    Validated envelope: **1.35B resident (13.8k tok/s, 64.9% MFU) and 600m
+    offload on chip; 7B pending host RAM** — four 7B attempts crashed the
+    worker host on the 37.7 GiB pinned bf16-moment tree.  The int8-state
+    variant ``adamw-sr8`` (ops/int8_state.py) shrinks that tree to
+    ~25.2 GiB and is the expected unlock; its on-chip 7B validation is
+    itself pending a chip (docs/performance.md "validated envelopes").
     """
 
     def init(params):

@@ -302,6 +302,20 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
                                             # clip_by_global_norm inside tx — use the
                                             # train step's max_grad_norm instead).
                                             # None = one monolithic region.
+    host_update_pipeline: Optional[bool] = None
+                                            # 3-stage software pipeline over the chunked
+                                            # host update (ops/streaming.py): chunk k+1's
+                                            # grads stage D2H and chunk k-1's outputs
+                                            # write back while chunk k's host region runs
+                                            # (only the update regions ride the
+                                            # serialization token chain).  Bitwise-
+                                            # identical to the serial schedule — same
+                                            # chunk boundaries, same SR hash streams
+                                            # (tests/test_offload.py).  Default True; env
+                                            # ACCELERATE_HOST_UPDATE_PIPELINE=false
+                                            # restores the fully serialized A/B baseline.
+                                            # Only consulted when host_update_chunk_gib
+                                            # is set.
     int8_state_block_size: Optional[int] = None
                                             # per-block fp32-scale granularity for the
                                             # -sr8 int8 optimizer-state recipes
@@ -334,6 +348,10 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
             self.cpu_offload = parse_flag_from_env("FSDP_OFFLOAD_PARAMS")
         if self.offload_params is None:
             self.offload_params = self.cpu_offload
+        if self.host_update_pipeline is None:
+            self.host_update_pipeline = parse_flag_from_env(
+                "ACCELERATE_HOST_UPDATE_PIPELINE", default=True
+            )
         if self.int8_state_block_size is None:
             self.int8_state_block_size = int(env.get("ACCELERATE_INT8_STATE_BLOCK", 128))
         if self.int8_state_block_size < 1:

@@ -171,6 +171,20 @@ class TPUProfiler:
             raise ValueError("key_averages needs output_trace_dir (no trace was captured)")
         return op_class_breakdown(base, device_substr)
 
+    def streaming_overlap(self, device_substr: str = "TPU") -> dict:
+        """Measured transfer-vs-compute occupancy + achieved overlap from
+        the captured trace (``utils/xplane.streaming_overlap_report``) — the
+        profiler-side view of the ``ops/streaming`` pipelines' accounting.
+        Call after the trace window has closed, like :meth:`key_averages`."""
+        from .xplane import streaming_overlap_report
+
+        base = self._handler.output_trace_dir
+        if base is None:
+            raise ValueError(
+                "streaming_overlap needs output_trace_dir (no trace was captured)"
+            )
+        return streaming_overlap_report(base, device_substr)
+
     def flops_estimate(self, fn, *args, **kwargs) -> float:
         """FLOPs of one call of a jittable ``fn`` at these arguments, from
         XLA's compiled-executable cost analysis; accumulates into

@@ -22,6 +22,7 @@ import re
 from collections import defaultdict
 from dataclasses import dataclass, field
 from functools import lru_cache
+from typing import Optional
 
 
 @lru_cache(maxsize=16)
@@ -277,6 +278,41 @@ def op_class_breakdown(trace_dir: str, device_substr: str = "TPU") -> dict:
     out["_steps_ms"] = steps_ms(trace_dir, device_substr)
     out["_async_copy_ms"] = async_copy_ms(trace_dir, device_substr)
     return out
+
+
+def streaming_overlap_report(trace_dir: str, device_substr: str = "TPU",
+                             breakdown: Optional[dict] = None) -> dict:
+    """Transfer-vs-compute occupancy from a captured trace — the MEASURED
+    counterpart of ``ops/streaming``'s predicted overlap accounting.
+
+    ``overlap_frac`` is the share of DMA the latency-hiding scheduler kept
+    off the critical path (async copies vs all copy traffic);
+    ``transfer_occupancy``/``host_occupancy``/``compute_occupancy`` are
+    shares of the op-timeline total, so a host-bound offload step shows up
+    as host_occupancy ≈ 1 with its transfers hidden (overlap_frac → 1), and
+    a serialized streamed decode as transfer_occupancy ≈ 1 with
+    overlap_frac → 0.  Pass an already-computed ``op_class_breakdown``
+    result as ``breakdown`` to skip re-aggregating the (parse-cached)
+    planes."""
+    br = breakdown if breakdown is not None else op_class_breakdown(trace_dir, device_substr)
+    copy_inline = br.get("copy", {}).get("ms", 0.0)
+    host_ms = br.get("host_compute", {}).get("ms", 0.0)
+    total = br["_total_ms"]
+    async_ms = br["_async_copy_ms"]
+    denom = total or 1.0
+    all_copy = copy_inline + async_ms
+    return {
+        "total_ms": total,
+        "steps_ms": br["_steps_ms"],
+        "copy_ms_inline": round(copy_inline, 3),
+        "copy_ms_async": round(async_ms, 3),
+        "host_compute_ms": round(host_ms, 3),
+        "transfer_occupancy": round(copy_inline / denom, 4),
+        "host_occupancy": round(host_ms / denom, 4),
+        "compute_occupancy": round(max(0.0, total - copy_inline - host_ms) / denom, 4),
+        "overlap_frac": round(async_ms / all_copy, 4) if all_copy else 0.0,
+        "kind": "measured",
+    }
 
 
 def top_ops(trace_dir: str, n: int = 20, device_substr: str = "TPU") -> list[tuple[str, float]]:

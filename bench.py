@@ -450,6 +450,16 @@ def main():
     ap.add_argument("--chunk-gib", type=float, default=None,
                     help="host-update chunk size in GiB (bounds the host's transient "
                          "working set; default 1.0 under --offload/7b, 0 = monolithic)")
+    ap.add_argument("--pipeline", choices=["on", "off"], default="on",
+                    help="3-stage software pipeline over the chunked host update "
+                         "(ops/streaming.py: chunk k+1's grads stage D2H and chunk "
+                         "k-1's outputs write back while chunk k updates). 'off' "
+                         "restores the fully serialized schedule — the A/B "
+                         "baseline for the overlap accounting")
+    ap.add_argument("--skip-quiet-box", action="store_true",
+                    help="skip the loadavg + calibration quiet-box gate on the "
+                         "host-bound offload configs (the gate only warns, never "
+                         "refuses, but costs ~1s)")
     ap.add_argument("--plan", type=int, default=None, metavar="N",
                     help="print the abstract per-device memory plan for an N-chip mesh and exit")
     ap.add_argument("--plan-task", choices=["train", "infer"], default="train",
@@ -625,10 +635,29 @@ def main():
         # bound the host's transient working set (monolithic adamw at 7B
         # crashed the worker host); 0 restores the monolithic region
         chunk = 1.0 if args.chunk_gib is None else args.chunk_gib
+        # the pipeline exists only over the chunk sequence: --chunk-gib 0
+        # (monolithic region) means no pipeline ran, and the report must
+        # say so or cross-round BENCH_*.json comparisons mislabel the runs
+        pipelined = args.pipeline == "on" and bool(chunk)
         fsdp_plugin = FullyShardedDataParallelPlugin(
-            cpu_offload=True, host_update_chunk_gib=chunk or None
+            cpu_offload=True, host_update_chunk_gib=chunk or None,
+            host_update_pipeline=pipelined,
         )
         extra_report["host_update_chunk_gib"] = chunk or None
+        extra_report["host_update_pipeline"] = pipelined
+        if on_tpu and not args.skip_quiet_box:
+            # the offloaded step is host-DRAM-bound: a loaded worker host
+            # measures the load, not the code (VERDICT r5 weak #7).  Warn —
+            # the bench still runs, but the report carries the evidence.
+            from accelerate_tpu.utils.environment import quiet_box_gate
+
+            gate = quiet_box_gate()
+            extra_report["quiet_box"] = gate
+            if not gate["ok"]:
+                import sys as _sys
+
+                for w in gate["warnings"]:
+                    print(f"bench.py: QUIET-BOX WARNING: {w}", file=_sys.stderr)
     handlers = []
     # compute-width (bf16) grads by default: the fp32 grad tree never
     # materializes.  At 1b this is what lets the resident config keep
@@ -779,13 +808,22 @@ def main():
             state, metrics = step(state, b)
         float(metrics["loss"])
         jax.profiler.stop_trace()
-        from accelerate_tpu.utils.xplane import op_class_breakdown, top_ops
+        from accelerate_tpu.utils.xplane import (
+            op_class_breakdown, streaming_overlap_report, top_ops,
+        )
 
         dev_substr = "TPU" if on_tpu else "CPU"
         extra_report["op_breakdown"] = op_class_breakdown(args.trace, dev_substr)
         extra_report["top_ops"] = [
             (name, round(ms, 2)) for name, ms in top_ops(args.trace, 12, dev_substr)
         ]
+        # measured transfer-vs-compute occupancy (the predicted `streaming`
+        # block's counterpart; under --offload the achieved overlap_frac of
+        # the chunk pipeline is read off this table) — reuses the breakdown
+        # just computed instead of re-aggregating the trace
+        extra_report["streaming_measured"] = streaming_overlap_report(
+            args.trace, dev_substr, breakdown=extra_report["op_breakdown"]
+        )
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -801,6 +839,39 @@ def main():
     peak, peak_known = _peak_flops(jax.devices()[0])
     mfu = (step_flops * iters / dt) / (peak * n_dev)
 
+    # Overlap accounting — ALWAYS emitted (overlap_frac/h2d_bytes/d2h_bytes)
+    # so BENCH_*.json tracks the streaming fields across rounds; zeros when
+    # nothing streams.  For offload runs the numbers come from the
+    # predicted-overlap model in ops/streaming.py (exact bytes, rates from
+    # the measured host-probe/PCIe figures); --pipeline off reports the
+    # serialized baseline's zero overlap.
+    from accelerate_tpu.ops.streaming import offload_transfer_accounting
+
+    if args.offload:
+        grad_wire_b = 2 if (args.precision == "bf16" and args.grad_dtype != "fp32"
+                            and on_tpu) else 4
+        # the H2D leg is the cast-to-compute param fetch, and every bench
+        # precision (bf16/fp8) computes at bf16 width — unlike the grad
+        # wire, which --grad-dtype fp32 widens to master width
+        streaming = offload_transfer_accounting(
+            count_params(state.params),
+            optimizer=args.optimizer,
+            grad_bytes_per_param=grad_wire_b,
+            fetch_bytes_per_param=2,
+            offload_params=True,
+        )
+        if not pipelined:
+            streaming["overlap_frac"] = 0.0
+            streaming["kind"] = "serialized-baseline"
+        extra_report["streaming"] = streaming
+        overlap_fields = {
+            "overlap_frac": streaming["overlap_frac"],
+            "h2d_bytes": streaming["h2d_bytes"],
+            "d2h_bytes": streaming["d2h_bytes"],
+        }
+    else:
+        overlap_fields = {"overlap_frac": 0.0, "h2d_bytes": 0, "d2h_bytes": 0}
+
     print(json.dumps({
         "metric": "llama_bf16_train_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -810,6 +881,7 @@ def main():
             # grad_dtype defaults to the master width unless the bf16-grad
             # handler was installed (which sets the key above)
             "grad_dtype": extra_report.pop("grad_dtype", "fp32"),
+            **overlap_fields,
             **extra_report,
             "precision": args.precision,
             "optimizer": args.optimizer,

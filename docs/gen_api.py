@@ -31,6 +31,7 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "init_empty_weights", "abstract_init", "init_params_leafwise",
         "infer_auto_placement", "load_checkpoint_in_model",
         "load_checkpoint_and_dispatch", "dispatch_model", "OffloadStore",
+        "offload_store_params",
     ]),
     "pipeline": ("accelerate_tpu.parallel.pipeline_parallel", [
         "prepare_pipeline", "PipelinedModel",
@@ -40,7 +41,8 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "load_model_params", "merge_weights",
     ]),
     "generation": ("accelerate_tpu.generation", [
-        "generate", "beam_search", "GenerationConfig",
+        "generate", "beam_search", "generate_streamed", "place_params_host",
+        "GenerationConfig",
     ]),
     "tracking": ("accelerate_tpu.tracking", [
         "GeneralTracker", "JSONLTracker", "TensorBoardTracker", "WandBTracker",
@@ -57,6 +59,11 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "quantize_params", "quantized_apply",
     ]),
     "powersgd": ("accelerate_tpu.parallel.powersgd", None),
+    "streaming": ("accelerate_tpu.ops.streaming", [
+        "StreamStats", "LayerPrefetcher", "chunk_groups", "slice_congruent",
+        "merge_congruent", "stage_put", "tree_bytes", "predicted_overlap",
+        "offload_transfer_accounting",
+    ]),
     "stochastic_rounding": ("accelerate_tpu.ops.stochastic_rounding", [
         "lion_bf16_sr", "adamw_bf16_sr", "stochastic_round_to_bf16",
         "stochastic_round_to_bf16_hashed",

@@ -329,6 +329,33 @@ def test_profile_context(tmp_path):
     assert (tmp_path / "trace").exists()
 
 
+def test_profiler_streaming_overlap_report(tmp_path):
+    """The profiler-side overlap accounting (transfer-vs-compute occupancy
+    + achieved overlap_frac) decodes from a real captured trace and carries
+    the full field set; occupancies are valid shares."""
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    acc = Accelerator()
+    handler = ProfileKwargs(output_trace_dir=str(tmp_path / "trace"))
+    with acc.profile(handler) as p:
+        jax.block_until_ready(jax.jit(lambda x: (x @ x).sum())(jax.numpy.ones((64, 64))))
+    rep = p.streaming_overlap(device_substr="CPU")
+    for field in ("total_ms", "copy_ms_inline", "copy_ms_async",
+                  "host_compute_ms", "transfer_occupancy", "host_occupancy",
+                  "compute_occupancy", "overlap_frac"):
+        assert field in rep, field
+    assert rep["kind"] == "measured"
+    for share in ("transfer_occupancy", "host_occupancy", "compute_occupancy",
+                  "overlap_frac"):
+        assert 0.0 <= rep[share] <= 1.0
+    # no trace dir -> loud error, matching key_averages
+    from accelerate_tpu.utils.profiler import TPUProfiler
+
+    bare = TPUProfiler(ProfileKwargs())
+    with pytest.raises(ValueError):
+        bare.streaming_overlap()
+
+
 def _windowed_profiler(monkeypatch, handler):
     """TPUProfiler with trace start/stop spied into an event list."""
     from accelerate_tpu.utils import profiler as prof_mod

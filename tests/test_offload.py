@@ -49,11 +49,13 @@ def _batches(n=6, bs=16, seed=1):
 
 
 def _run(offload: bool, accum_plugin=None, mixed_precision="no", n_steps=6,
-         chunk_gib=None, tx=None, max_grad_norm=1.0, kwargs_handlers=None):
+         chunk_gib=None, tx=None, max_grad_norm=1.0, kwargs_handlers=None,
+         pipeline=True):
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
     plugin = FullyShardedDataParallelPlugin(
-        min_weight_size=0, cpu_offload=offload, host_update_chunk_gib=chunk_gib
+        min_weight_size=0, cpu_offload=offload, host_update_chunk_gib=chunk_gib,
+        host_update_pipeline=pipeline,
     )
     acc = Accelerator(
         parallelism_config=ParallelismConfig(dp_shard_size=8),
@@ -413,7 +415,7 @@ def test_offload_adamw_sr_bf16_masters_trains():
     np.testing.assert_allclose(losses_chunk, ref_losses, rtol=0.35)
 
 
-def _run_sr8(recipe, offload, chunk_gib=None):
+def _run_sr8(recipe, offload, chunk_gib=None, pipeline=True):
     """The -sr8 recipes (ops/int8_state.py: bf16 SR params + int8 blockwise
     moment state) through the full offload machinery on the CPU mesh."""
     from accelerate_tpu.utils.dataclasses import GradSyncKwargs
@@ -421,7 +423,8 @@ def _run_sr8(recipe, offload, chunk_gib=None):
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
     plugin = FullyShardedDataParallelPlugin(
-        min_weight_size=0, cpu_offload=offload, host_update_chunk_gib=chunk_gib
+        min_weight_size=0, cpu_offload=offload, host_update_chunk_gib=chunk_gib,
+        host_update_pipeline=pipeline,
     )
     acc = Accelerator(
         parallelism_config=ParallelismConfig(dp_shard_size=8),
@@ -436,6 +439,66 @@ def _run_sr8(recipe, offload, chunk_gib=None):
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
     return losses, jax.device_get(state.params), jax.device_get(state.opt_state)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined (double-buffered) chunked update — ops/streaming.py
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_offload_update_matches_serial_bitwise():
+    """The 3-stage chunk pipeline (stage A per-chunk D2H, stage C per-chunk
+    write-back, only the update regions token-serialized) is BITWISE
+    identical to the fully serialized schedule: same chunk boundaries, same
+    per-group math — the pipeline only reorders transfers.  adamw exercises
+    the congruent-moment + shared-count slicing.
+
+    Scope on this mesh: memory kinds degrade on CPU, so stage A slices the
+    same values either way, but stage C's per-chunk placements DO run here
+    (deliberately not gated on kinds_ok) — pipelined and serial trace
+    genuinely different programs and must still agree bit-for-bit.  The
+    pinned-host transfer legs are the on-chip concern
+    (bench.py --pipeline on|off A/B)."""
+    losses_ser, params_ser = _run(offload=True, chunk_gib=1e-6, pipeline=False)
+    losses_pipe, params_pipe = _run(offload=True, chunk_gib=1e-6, pipeline=True)
+    assert losses_pipe == losses_ser
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params_pipe, params_ser
+    )
+
+
+@pytest.mark.parametrize("recipe", ["lion-sr8", "adamw-sr8"])
+def test_pipelined_offload_sr8_matches_serial_bitwise(recipe):
+    """The SR-hash contract under the pipeline: -sr8 salts its SR streams
+    with group-relative leaf indices, so identical chunk boundaries must
+    give identical codes/scales/params no matter how the transfers are
+    scheduled — pipelined == serial bit-for-bit, including the int8/uint8
+    moment state."""
+    losses_ser, params_ser, opt_ser = _run_sr8(recipe, offload=True,
+                                               chunk_gib=1e-6, pipeline=False)
+    losses_pipe, params_pipe, opt_pipe = _run_sr8(recipe, offload=True,
+                                                  chunk_gib=1e-6, pipeline=True)
+    assert losses_pipe == losses_ser
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params_pipe, params_ser
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), opt_pipe, opt_ser
+    )
+
+
+def test_pipelined_offload_with_clipping_matches_serial():
+    """max_grad_norm forces the host-side global-norm barrier (stage A
+    degrades to bulk staging); the pipeline must still match the serial
+    schedule exactly."""
+    losses_ser, params_ser = _run(offload=True, chunk_gib=1e-6, pipeline=False,
+                                  max_grad_norm=1.0)
+    losses_pipe, params_pipe = _run(offload=True, chunk_gib=1e-6, pipeline=True,
+                                    max_grad_norm=1.0)
+    assert losses_pipe == losses_ser
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, b), params_pipe, params_ser
+    )
 
 
 @pytest.mark.parametrize("recipe", ["lion-sr8", "adamw-sr8"])
