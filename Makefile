@@ -13,7 +13,7 @@
 FAST_BUDGET_S := 180
 FAST_HARD_S := 240
 
-.PHONY: test test-all test-examples quality
+.PHONY: test test-all test-examples quality lint
 
 test:
 	@cache=/tmp/accelerate_tpu_test_jax_cache; \
@@ -36,3 +36,9 @@ test-examples:
 
 quality:
 	python -m pytest tests/test_example_drift.py tests/test_docs.py -q
+
+# graft-lint: AST rule sweep of the tree + jaxpr audit of the canonical
+# train step (docs/static_analysis.md).  Non-zero exit on any unsuppressed
+# error-severity finding — wire it ahead of `make test` in CI.
+lint:
+	JAX_PLATFORMS=cpu python -m accelerate_tpu lint

@@ -1362,6 +1362,7 @@ class Accelerator:
                         # were already placed by apply_update; device_put is a
                         # no-op there and with_sharding_constraint would strip
                         # the memory kind
+                        # graft-lint: disable=GL103 -- re-pins host-resident state members to their offload memory kind; a no-op for buffers apply_update already placed, never a data transfer
                         return jax.device_put(x, s)
                     return jax.lax.with_sharding_constraint(x, s)
 
@@ -1372,8 +1373,16 @@ class Accelerator:
             return new_state, metrics
 
         jitted = jax.jit(pinned_step_fn, donate_argnums=(0,) if donate_state else ())
+        # resolved once at prepare time: the flag must not cost the hot
+        # training-step wrapper an environ lookup per call when unset
+        lint_at_first_call = parse_flag_from_env("ACCELERATE_LINT")
 
         def wrapped(state, batch):
+            if lint_at_first_call and wrapped._lint_report is None:
+                # audit at first compile: trace-only (nothing executes, the
+                # donated buffers are untouched), findings go through
+                # logging.py + any active trackers
+                wrapped._lint_report = self.audit_step(wrapped, state, batch)
             if not getattr(self, "_in_accumulate", False):
                 self.step_count += 1
                 self.gradient_state._set_sync_gradients(
@@ -1382,7 +1391,42 @@ class Accelerator:
             return jitted(state, batch)
 
         wrapped._jitted = jitted
+        wrapped._lint_report = None
+        self._prepared_train_step = wrapped
         return wrapped
+
+    def audit_step(self, step=None, *example_args, log: bool = True, **audit_kwargs):
+        """Run the graft-lint jaxpr auditor over a prepared train step
+        without executing it on device (``analysis/jaxpr_audit.py``).
+
+        ``step`` defaults to the last :meth:`prepare_train_step` result;
+        ``example_args`` are the ``(state, batch)`` the step would be called
+        with — concrete arrays or ``jax.ShapeDtypeStruct`` stand-ins (the
+        audit is a pure abstract trace, so donated buffers stay intact).
+        Findings are reported through :mod:`.logging` and, when trackers are
+        active, as ``graft_lint/*`` counters; the :class:`analysis.Report`
+        is returned either way.  Opt-in at runtime with ``ACCELERATE_LINT=1``
+        — every prepared step then audits itself at first call.
+        """
+        from .analysis import Severity, audit_jitted
+
+        if step is None:
+            step = getattr(self, "_prepared_train_step", None)
+        if step is None:
+            raise ValueError("no prepared train step to audit — call prepare_train_step first")
+        report = audit_jitted(step, *example_args, **audit_kwargs)
+        if log:
+            for f in report.unsuppressed():
+                emit = logger.error if f.severity >= Severity.ERROR else logger.warning
+                emit("graft-lint %s at %s: %s", f.rule, f.location, f.message)
+            counts = report.counts()
+            logger.info(
+                "graft-lint step audit: %d error(s), %d warning(s), %d suppressed",
+                counts["error"], counts["warning"], counts["suppressed"],
+            )
+            if self.trackers:
+                self.log({f"graft_lint/{k}": v for k, v in counts.items()})
+        return report
 
     def prepare_eval_step(self, eval_fn: Callable) -> Callable:
         """jit an eval function ``(params, batch) -> outputs`` with compute

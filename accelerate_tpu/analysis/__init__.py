@@ -1,0 +1,51 @@
+"""graft-lint: static analysis for donation, transfer, and sharding hazards.
+
+Two engines over one report model (all CPU-safe, nothing executes on
+device):
+
+- :mod:`.jaxpr_audit` — traces a step/decode function abstractly
+  (``jax.jit(fn).trace``) and walks the ClosedJaxpr for hazards only the
+  traced program shows: wasted donations (GL101), const-capture HBM
+  blowups (GL102), in-trace memory-kind transfers (GL103), PRNG key reuse
+  (GL104), unsharded large outputs (GL105).
+- :mod:`.ast_rules` — repo-wide source linter for hazards only the caller's
+  source shows: donated-name reuse after a ``donate_argnums`` call site
+  (GL201, the PR 2 async-checkpoint race shape), host syncs in jitted code
+  (GL202), ``jax.experimental.shard_map`` outside the compat shims (GL203),
+  wall-clock/stdlib randomness under trace (GL204).
+
+Surfaces: ``python -m accelerate_tpu lint`` (``commands/lint.py``),
+``Accelerator.audit_step()`` / ``ACCELERATE_LINT=1``, ``make lint``, and
+``bench.py --plan N --audit``.  Rule catalog and suppression syntax:
+``docs/static_analysis.md``.
+"""
+
+from .ast_rules import (
+    DEFAULT_EXCLUDE_DIRS,
+    DEFAULT_EXCLUDES,
+    iter_python_files,
+    lint_paths,
+    lint_source,
+)
+from .jaxpr_audit import audit_fn, audit_jitted, audit_traced
+from .report import Finding, Report, Severity, apply_suppressions, parse_marker
+from .rules import RULES, Rule, rule
+
+__all__ = [
+    "DEFAULT_EXCLUDE_DIRS",
+    "DEFAULT_EXCLUDES",
+    "Finding",
+    "Report",
+    "RULES",
+    "Rule",
+    "Severity",
+    "apply_suppressions",
+    "audit_fn",
+    "audit_jitted",
+    "audit_traced",
+    "iter_python_files",
+    "lint_paths",
+    "lint_source",
+    "parse_marker",
+    "rule",
+]

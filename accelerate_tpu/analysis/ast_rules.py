@@ -1,0 +1,452 @@
+"""AST rule engine: the fast repo-wide half of graft-lint.
+
+Where the jaxpr auditor sees the traced program, this engine sees the
+source — the two halves cover each other's blind spots.  Caller-side
+donated-buffer reuse (GL201, the PR 2 async-checkpoint race shape) happens
+*after* the jitted call returns, so no jaxpr contains it; ``time.time()``
+inside a jitted function (GL204) leaves no trace at all — the trace bakes
+the first call's value silently.
+
+**Jit contexts.**  GL202/GL204 only fire inside code that runs under trace.
+A function is a jit context when it (a) is decorated with ``jax.jit`` /
+``jax.pmap`` (bare, called, or via ``partial``), (b) is passed by name to a
+``jax.jit(...)`` call anywhere in the module, (c) is lexically nested
+inside a jit context, or (d) is called by bare name from inside one (the
+call graph is closed transitively — ``pinned_step_fn -> step_fn ->
+compute_grads`` in the accelerator all count).
+
+**Donated-reuse (GL201).**  The engine records every ``name = jax.jit(fn,
+donate_argnums=...)`` binding in the module, then at each call of such a
+name treats the bare-``Name`` arguments in donated positions as dead: a
+later *load* of that name in the same scope is a finding, unless a
+rebinding (``state, m = jitted(state, batch)``) or ``del`` intervenes.
+Known miss (documented in docs/static_analysis.md): reuse across loop
+iterations with no textual load after the call line.
+
+Suppression: the shared inline marker (see :mod:`.report`) on the flagged
+line or the line above.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, Optional, Sequence
+
+from .report import Finding, Report, apply_suppressions, parse_marker
+from .rules import RULES
+
+# path substrings every repo-wide run skips: intentionally-buggy lint fixtures
+DEFAULT_EXCLUDES = ("tests/analysis_fixtures",)
+
+# directory names pruned from directory sweeps (matched as whole path
+# components, so `venv/` is skipped but `myvenv_utils.py` is not):
+# vendored/generated trees whose findings are never actionable here
+DEFAULT_EXCLUDE_DIRS = frozenset({
+    "__pycache__", ".git", ".venv", "venv", ".eggs", ".tox", "build",
+    "dist", "node_modules", "site-packages",
+})
+
+_HOST_SYNC_METHODS = frozenset({"item", "tolist"})
+_HOST_SYNC_NP_FUNCS = frozenset({"asarray", "array"})
+_IMPURE_TIME_FUNCS = frozenset({"time", "perf_counter", "monotonic", "time_ns", "process_time"})
+
+
+def _finding(rule_id: str, message: str, path: str, line: int) -> Finding:
+    r = RULES[rule_id]
+    return Finding(
+        rule=rule_id, severity=r.severity, message=message, fix_hint=r.fix_hint,
+        path=path, line=line, engine="ast",
+    )
+
+
+def _dotted(node) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ModuleIndex:
+    """One pass of bookkeeping the rules share: import aliases, function
+    defs with nesting, jit-context closure, donated-jit bindings."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        # local name -> canonical dotted name ("np" -> "numpy")
+        self.aliases: dict[str, str] = {}
+        self.functions: list[ast.FunctionDef] = []
+        self._parent: dict[int, Optional[ast.AST]] = {}
+        # function name -> donated positional indices, for jax.jit bindings
+        self.donated_callables: dict[str, tuple[int, ...]] = {}
+        self._index()
+        self.jit_contexts = self._close_jit_contexts()
+
+    # -- construction ------------------------------------------------------
+
+    def _index(self):
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parent[id(child)] = node
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append(node)
+            elif isinstance(node, ast.Assign) or isinstance(node, ast.AnnAssign):
+                self._record_donated_binding(node)
+
+    def canonical(self, node) -> Optional[str]:
+        """Dotted name with the leading import alias resolved."""
+        dotted = _dotted(node)
+        if dotted is None:
+            return None
+        head, _, rest = dotted.partition(".")
+        head = self.aliases.get(head, head)
+        return f"{head}.{rest}" if rest else head
+
+    def _is_jit_call(self, node) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and self.canonical(node.func) in ("jax.jit", "jax.pmap")
+        )
+
+    def _record_donated_binding(self, assign):
+        targets = assign.targets if isinstance(assign, ast.Assign) else [assign.target]
+        value = assign.value
+        if not (self._is_jit_call(value) and len(targets) == 1
+                and isinstance(targets[0], ast.Name)):
+            return
+        donated = _donate_positions(value)
+        if donated:
+            self.donated_callables[targets[0].id] = donated
+
+    # -- jit-context closure ----------------------------------------------
+
+    def enclosing_function(self, node) -> Optional[ast.AST]:
+        cur = self._parent.get(id(node))
+        while cur is not None and not isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cur = self._parent.get(id(cur))
+        return cur
+
+    def _decorated_as_jit(self, fn) -> bool:
+        for dec in fn.decorator_list:
+            target = dec
+            if isinstance(dec, ast.Call):
+                # @jax.jit(...) or @partial(jax.jit, ...)
+                if self.canonical(dec.func) in ("jax.jit", "jax.pmap"):
+                    return True
+                if (self.canonical(dec.func) in ("functools.partial", "partial")
+                        and dec.args
+                        and self.canonical(dec.args[0]) in ("jax.jit", "jax.pmap")):
+                    return True
+                continue
+            if self.canonical(target) in ("jax.jit", "jax.pmap"):
+                return True
+        return False
+
+    def _close_jit_contexts(self) -> set:
+        by_name: dict[str, list] = {}
+        for fn in self.functions:
+            by_name.setdefault(fn.name, []).append(fn)
+        seeds: set = set()
+        for fn in self.functions:
+            if self._decorated_as_jit(fn):
+                seeds.add(id(fn))
+        # functions passed by name into jax.jit(...)
+        for node in ast.walk(self.tree):
+            if self._is_jit_call(node) and node.args:
+                name = _dotted(node.args[0])
+                for fn in by_name.get(name or "", []):
+                    seeds.add(id(fn))
+        # transitive closure over lexical nesting + bare-name calls
+        contexts = set(seeds)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if id(fn) in contexts:
+                    continue
+                parent = self.enclosing_function(fn)
+                if parent is not None and id(parent) in contexts:
+                    contexts.add(id(fn))
+                    changed = True
+            for fn in self.functions:
+                if id(fn) not in contexts:
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                        for callee in by_name.get(node.func.id, []):
+                            if id(callee) not in contexts:
+                                contexts.add(id(callee))
+                                changed = True
+        return contexts
+
+    def in_jit_context(self, node) -> bool:
+        fn = self.enclosing_function(node)
+        return fn is not None and id(fn) in self.jit_contexts
+
+
+def _donate_positions(jit_call: ast.Call) -> tuple[int, ...]:
+    """Literal donate_argnums of a jax.jit(...) call; a non-literal value
+    conservatively reads as ``(0,)`` (the overwhelmingly common case —
+    the accelerator's ``donate_argnums=(0,) if donate_state else ()``)."""
+    for kw in jit_call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            if not v.elts:
+                return ()  # explicit empty literal: donates nothing
+            out = tuple(
+                e.value for e in v.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            )
+            return out or (0,)
+        return (0,)
+    return ()
+
+
+# ---------------------------------------------------------------------------
+# the rules
+# ---------------------------------------------------------------------------
+
+
+def _rule_donated_reuse(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL201: a donated name loaded after the donating call in its scope."""
+    findings = []
+    scopes: list = [index.tree] + list(index.functions)
+    for scope in scopes:
+        own = (
+            lambda n: index.enclosing_function(n) is scope
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else index.enclosing_function(n) is None
+        )
+        calls = []  # (call node, donated arg names)
+        for node in ast.walk(scope):
+            if not (isinstance(node, ast.Call) and own(node)):
+                continue
+            donated: tuple[int, ...] = ()
+            if isinstance(node.func, ast.Name) and node.func.id in index.donated_callables:
+                donated = index.donated_callables[node.func.id]
+            elif isinstance(node.func, ast.Call) and index._is_jit_call(node.func):
+                donated = _donate_positions(node.func)  # jax.jit(f, ...)(x)
+            names = [
+                node.args[i].id
+                for i in donated
+                if i < len(node.args) and isinstance(node.args[i], ast.Name)
+            ]
+            if names:
+                calls.append((node, names))
+        if not calls:
+            continue
+        name_events: dict[str, list] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Name) and own(node):
+                name_events.setdefault(node.id, []).append(node)
+        for call, names in calls:
+            call_end = getattr(call, "end_lineno", call.lineno) or call.lineno
+            for name in names:
+                for ev in sorted(name_events.get(name, []),
+                                 key=lambda n: (n.lineno, n.col_offset)):
+                    if ev.lineno < call.lineno:
+                        continue
+                    aug = isinstance(index._parent.get(id(ev)), ast.AugAssign)
+                    if isinstance(ev.ctx, (ast.Store, ast.Del)) and not aug:
+                        # rebound/deleted at or after the call (the canonical
+                        # `state, m = jitted(state, b)`): the donated buffer
+                        # is dead under this name.  An AugAssign target is
+                        # NOT safe — `state += 1` reads the donated buffer
+                        # before writing it.
+                        break
+                    if not aug and ev.lineno <= call_end:
+                        continue  # the call's own argument load
+                    findings.append(
+                        _finding(
+                            "GL201",
+                            f"`{name}` was donated at line {call.lineno} "
+                            "(donate_argnums) but is read again here — its "
+                            "buffer may already be overwritten in place",
+                            path, ev.lineno,
+                        )
+                    )
+                    break
+    return findings
+
+
+def _rule_host_sync(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL202: host-synchronizing calls inside jit contexts."""
+    findings = []
+    for node in ast.walk(index.tree):
+        if not (isinstance(node, ast.Call) and index.in_jit_context(node)):
+            continue
+        msg = None
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _HOST_SYNC_METHODS:
+            msg = f".{node.func.attr}() forces a device->host sync"
+        else:
+            canon = index.canonical(node.func)
+            if canon in {f"numpy.{f}" for f in _HOST_SYNC_NP_FUNCS}:
+                msg = f"{canon}() materializes a traced value on host"
+            elif canon in ("float", "int", "bool") and node.args:
+                arg = node.args[0]
+                fn = index.enclosing_function(node)
+                params = set()
+                if fn is not None:
+                    a = fn.args
+                    params = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    msg = f"{canon}() on traced argument `{arg.id}` concretizes it"
+        if msg:
+            findings.append(_finding("GL202", f"{msg} inside jitted code", path, node.lineno))
+    return findings
+
+
+def _rule_shard_map_compat(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL203: jax.experimental.shard_map outside the ImportError fallback."""
+
+    def in_import_error_handler(node) -> bool:
+        cur = index._parent.get(id(node))
+        while cur is not None:
+            if isinstance(cur, ast.ExceptHandler):
+                names = []
+                t = cur.type
+                for e in t.elts if isinstance(t, ast.Tuple) else ([t] if t else []):
+                    names.append(_dotted(e))
+                if any(n in ("ImportError", "ModuleNotFoundError") for n in names):
+                    return True
+            cur = index._parent.get(id(cur))
+        return False
+
+    findings = []
+    for node in ast.walk(index.tree):
+        hit = None
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.startswith("jax.experimental.shard_map"):
+            hit = f"from {node.module} import ..."
+        elif isinstance(node, ast.Import) and any(
+                a.name.startswith("jax.experimental.shard_map") for a in node.names):
+            hit = "import jax.experimental.shard_map"
+        elif isinstance(node, ast.Attribute) and \
+                _dotted(node) == "jax.experimental.shard_map":
+            hit = "jax.experimental.shard_map"
+        if hit and not in_import_error_handler(node):
+            findings.append(
+                _finding(
+                    "GL203",
+                    f"{hit} outside an `except ImportError` compat fallback",
+                    path, node.lineno,
+                )
+            )
+    return findings
+
+
+def _rule_impure_in_jit(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL204: wall-clock / stdlib-random calls inside jit contexts."""
+    findings = []
+    for node in ast.walk(index.tree):
+        if not (isinstance(node, ast.Call) and index.in_jit_context(node)):
+            continue
+        canon = index.canonical(node.func)
+        if canon is None:
+            continue
+        hit = None
+        if canon in {f"time.{f}" for f in _IMPURE_TIME_FUNCS}:
+            hit = f"{canon}() is baked in at trace time"
+        elif canon.startswith("random.") or canon.startswith("numpy.random."):
+            hit = f"{canon}() draws host randomness once, at trace time"
+        if hit:
+            findings.append(_finding("GL204", f"{hit} inside jitted code", path, node.lineno))
+    return findings
+
+
+_ALL_RULES = (
+    _rule_donated_reuse,
+    _rule_host_sync,
+    _rule_shard_map_compat,
+    _rule_impure_in_jit,
+)
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    """All AST findings for one module's source (suppressions not yet
+    applied — :func:`lint_paths` resolves them against the real file)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            _finding("GL002", f"unparseable module: {e.msg}", path, e.lineno or 1)
+        ]
+    index = _ModuleIndex(tree)
+    findings = []
+    for rule_fn in _ALL_RULES:
+        findings.extend(rule_fn(index, path))
+    # GL001 contract: EVERY rationale-less marker is reported, including
+    # stale ones that no longer match any finding (apply_suppressions
+    # dedupes against these when a bare marker does suppress something)
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        parsed = parse_marker(text)
+        if parsed is not None and parsed[1] is None:
+            findings.append(
+                _finding(
+                    "GL001",
+                    "suppression marker without a rationale "
+                    "(add `-- <why this hazard is intentional>`)",
+                    path, lineno,
+                )
+            )
+    return findings
+
+
+def iter_python_files(paths: Sequence, excludes: Sequence[str] = DEFAULT_EXCLUDES):
+    """``*.py`` files under ``paths``.  ``excludes`` (path substrings) and
+    :data:`DEFAULT_EXCLUDE_DIRS` (vendored/generated directory names) apply
+    only to directory sweeps — a file named explicitly is always yielded,
+    even if missing (so :func:`lint_paths` can report the bad target loudly
+    instead of letting a typo'd CI path pass as a clean run)."""
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if DEFAULT_EXCLUDE_DIRS.intersection(f.parts):
+                    continue
+                if any(ex in f.as_posix() for ex in excludes):
+                    continue
+                yield f
+        else:
+            yield p
+
+
+def lint_paths(
+    paths: Sequence,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+) -> Report:
+    """Lint every ``*.py`` under ``paths`` (files or directories), resolve
+    inline suppressions, and return the combined :class:`Report`."""
+    findings: list[Finding] = []
+    for f in iter_python_files(paths, excludes):
+        try:
+            source = f.read_text()
+        except (OSError, UnicodeDecodeError) as e:
+            # never silently pass a target we could not read — a typo'd CI
+            # path must fail the run, not report clean
+            findings.append(_finding("GL002", f"unreadable target: {e}", str(f), 1))
+            continue
+        findings.extend(lint_source(source, str(f)))
+    return Report(apply_suppressions(findings))

@@ -1,0 +1,409 @@
+"""Jaxpr auditor: trace a step/decode function abstractly and flag the
+hazard classes that only show up in the traced program.
+
+No device execution anywhere: the engine runs on ``jax.jit(fn).trace(...)``
+(the AOT path — accepts :class:`jax.ShapeDtypeStruct` stand-ins), so a 7B
+train step audits on a CPU-only CI box.  The hazards, and why each is
+invisible to a source-level linter:
+
+- **GL101 wasted donation** — ``donate_argnums`` promises XLA an input
+  buffer to reuse for an output of the same byte size.  Whether any output
+  qualifies is a property of the traced avals, not the source.  A donation
+  nothing can alias frees zero HBM while still invalidating the caller's
+  buffer — the worst of both worlds.
+- **GL102 const capture** — a closed-over array becomes a jaxpr constant:
+  baked per executable, duplicated across retraces, exempt from donation
+  and the sharding plan.  Only the trace knows what actually closed over.
+- **GL103 transfer in trace** — a ``device_put`` to a different memory kind
+  inside traced code is a host<->device copy serialized into the step,
+  bypassing the ``ops/streaming.py`` overlap discipline.
+- **GL104 key reuse** — one PRNG key consumed by two random primitives
+  yields identical streams; the auditor tracks key identity through
+  ``pjit``/``scan``/``cond`` sub-jaxprs, which no regex can.
+- **GL105 unsharded large output** — an output above the size threshold
+  whose producer is not a sharding constraint may be resolved fully
+  replicated by GSPMD.
+
+Suppression is source-anchored (see :mod:`.report`): each finding resolves
+its file/line from the flagged equation's ``source_info``, so the same
+inline ``# graft-lint: disable=GLxxx -- reason`` marker works for both
+engines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax
+import numpy as np
+
+from .report import Finding, Report, apply_suppressions
+from .rules import RULES
+
+try:  # jaxpr equations carry their user-code provenance here
+    from jax._src import source_info_util as _src_info
+except Exception:  # pragma: no cover - private-API drift
+    _src_info = None
+
+
+# random primitives that CONSUME a key (produce bits or a derived stream).
+# Structural ops on key arrays (slice/squeeze/broadcast of a split result)
+# produce distinct subkeys and are not consumptions.
+_KEY_CONSUMERS = frozenset({"random_split", "random_bits", "random_fold_in"})
+
+# producers that satisfy GL105: the output's layout was pinned on purpose
+_SHARDING_PRODUCERS = frozenset({"sharding_constraint", "device_put"})
+
+
+# ---------------------------------------------------------------------------
+# small jaxpr helpers
+# ---------------------------------------------------------------------------
+
+
+def _is_key_aval(aval) -> bool:
+    try:
+        return jax.numpy.issubdtype(aval.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def _aval_bytes(aval) -> int:
+    """Byte size of an abstract value; extended dtypes (PRNG keys) fall back
+    to their impl's key size (threefry: 2x uint32)."""
+    shape = getattr(aval, "shape", ())
+    n = int(np.prod(shape)) if shape else 1
+    dtype = getattr(aval, "dtype", None)
+    try:
+        return n * np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = getattr(dtype, "itemsize", None)
+        return n * int(itemsize if itemsize else 8)
+
+
+def _eqn_location(eqn):
+    """``(path, line)`` of the user frame that emitted ``eqn``, if any."""
+    if _src_info is None or eqn is None:
+        return None, None
+    try:
+        frame = _src_info.user_frame(eqn.source_info)
+    except Exception:  # pragma: no cover - private-API drift
+        return None, None
+    if frame is None:
+        return None, None
+    return frame.file_name, frame.start_line
+
+
+def _sub_jaxprs(eqn) -> list:
+    """Every (closed) sub-jaxpr carried in an equation's params, normalized
+    to ``ClosedJaxpr``-likes with ``.jaxpr`` access."""
+    subs = []
+    for val in eqn.params.values():
+        for item in val if isinstance(val, (list, tuple)) else (val,):
+            if hasattr(item, "jaxpr") and hasattr(item, "consts"):
+                subs.append(item)  # ClosedJaxpr
+            elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                subs.append(jax.core.ClosedJaxpr(item, ()))
+    return subs
+
+
+def _walk_eqns(jaxpr) -> Iterable:
+    """Depth-first over every equation, including sub-jaxprs."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub.jaxpr)
+
+
+def _finding(rule_id: str, message: str, *, path=None, line=None) -> Finding:
+    r = RULES[rule_id]
+    return Finding(
+        rule=rule_id, severity=r.severity, message=message, fix_hint=r.fix_hint,
+        path=path, line=line, engine="jaxpr",
+    )
+
+
+# ---------------------------------------------------------------------------
+# the individual audits
+# ---------------------------------------------------------------------------
+
+
+def _audit_donation(jaxpr, donated: list[bool], path_hint) -> list[Finding]:
+    """GL101: greedy byte-size matching of donated inputs against outputs —
+    the same viability criterion XLA's buffer-donation aliasing applies
+    (an input buffer can only be reused by an output of equal size)."""
+    findings = []
+    out_vars = [v for v in jaxpr.outvars if not isinstance(v, jax.core.Literal)]
+    # a donated input returned unchanged IS its own output buffer
+    passthrough = {id(v) for v in jaxpr.invars} & {id(v) for v in out_vars}
+    out_sizes: dict[int, int] = {}
+    for v in out_vars:
+        if id(v) in passthrough:
+            continue
+        size = _aval_bytes(v.aval)
+        out_sizes[size] = out_sizes.get(size, 0) + 1
+    for i, (var, is_donated) in enumerate(zip(jaxpr.invars, donated)):
+        if not is_donated or id(var) in passthrough:
+            continue
+        size = _aval_bytes(var.aval)
+        if out_sizes.get(size, 0) > 0:
+            out_sizes[size] -= 1
+            continue
+        aval = var.aval
+        findings.append(
+            _finding(
+                "GL101",
+                f"donated argument {i} ({getattr(aval, 'dtype', '?')}"
+                f"{list(getattr(aval, 'shape', ()))}, {size} B) aliases no "
+                "output: no un-aliased output of the same byte size remains",
+                path=path_hint[0] if path_hint else None,
+                line=path_hint[1] if path_hint else None,
+            )
+        )
+    return findings
+
+
+def _audit_consts(closed, threshold: int, path_hint) -> list[Finding]:
+    """GL102: closed-over constants above the size threshold."""
+    findings = []
+    const_first_use = {}
+    for eqn in closed.jaxpr.eqns:
+        for v in eqn.invars:
+            if not isinstance(v, jax.core.Literal) and id(v) not in const_first_use:
+                const_first_use[id(v)] = eqn
+    for var, const in zip(closed.jaxpr.constvars, closed.consts):
+        nbytes = getattr(const, "nbytes", None)
+        if nbytes is None:
+            nbytes = _aval_bytes(const) if hasattr(const, "shape") else 0
+        if nbytes < threshold:
+            continue
+        path, line = _eqn_location(const_first_use.get(id(var)))
+        if path is None and path_hint:
+            path, line = path_hint
+        findings.append(
+            _finding(
+                "GL102",
+                f"closed-over constant {getattr(const, 'dtype', '?')}"
+                f"{list(getattr(const, 'shape', ()))} ({nbytes / 2**20:.1f} MiB) "
+                "is baked into the jaxpr",
+                path=path, line=line,
+            )
+        )
+    return findings
+
+
+def _dst_memory_kinds(eqn) -> list:
+    kinds = []
+    for dst in eqn.params.get("devices", ()) or ():
+        kind = getattr(dst, "memory_kind", None)
+        if kind is not None:
+            kinds.append(kind)
+    return kinds
+
+
+def _default_memory_kind() -> Optional[str]:
+    try:
+        return jax.devices()[0].default_memory().kind
+    except Exception:  # pragma: no cover - no backend
+        return None
+
+
+def _audit_transfers(jaxpr, default_kind: Optional[str]) -> list[Finding]:
+    """GL103: in-trace device_put that crosses memory kinds."""
+    if default_kind is None:
+        return []
+    findings = []
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name != "device_put":
+            continue
+        crossing = sorted({k for k in _dst_memory_kinds(eqn) if k != default_kind})
+        if not crossing:
+            continue
+        path, line = _eqn_location(eqn)
+        findings.append(
+            _finding(
+                "GL103",
+                f"device_put to memory kind {'/'.join(crossing)} inside "
+                f"traced code (program default: {default_kind}) — an "
+                "implicit transfer serialized into the step",
+                path=path, line=line,
+            )
+        )
+    return findings
+
+
+def _audit_key_reuse(closed) -> list[Finding]:
+    """GL104: a key var consumed by >1 random primitive.  Key identity is
+    threaded through sub-jaxprs by positional invar mapping (pjit/scan align
+    exactly; ``cond`` skips its branch index); scopes whose arity doesn't
+    align conservatively start fresh roots (documented miss, never a false
+    positive)."""
+    consumptions: dict[int, list] = {}
+    next_root = [0]
+
+    def root_of(var, env: dict) -> int:
+        if var not in env:
+            env[var] = next_root[0]
+            next_root[0] += 1
+        return env[var]
+
+    def walk(jaxpr, env: dict, loc_eqn=None):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name in _KEY_CONSUMERS:
+                for v in eqn.invars:
+                    if isinstance(v, jax.core.Literal) or not _is_key_aval(v.aval):
+                        continue
+                    # report at the outermost enclosing call site: inner
+                    # jaxprs are deduplicated across identical calls, so an
+                    # inner eqn's source_info may point at the wrong one
+                    consumptions.setdefault(root_of(v, env), []).append(loc_eqn or eqn)
+            for sub in _sub_jaxprs(eqn):
+                inner = sub.jaxpr
+                operands = list(eqn.invars)
+                if eqn.primitive.name == "cond" and len(operands) == len(inner.invars) + 1:
+                    operands = operands[1:]
+                sub_env: dict = {}
+                if len(operands) == len(inner.invars):
+                    for outer, v in zip(operands, inner.invars):
+                        if not isinstance(outer, jax.core.Literal):
+                            sub_env[v] = root_of(outer, env)
+                walk(inner, sub_env, loc_eqn or eqn)
+
+    walk(closed.jaxpr, {})
+    findings = []
+    for eqns in consumptions.values():
+        if len(eqns) < 2:
+            continue
+        first_path, first_line = _eqn_location(eqns[0])
+        path, line = _eqn_location(eqns[1])
+        where = f" (first consumed at {first_path}:{first_line})" if first_path else ""
+        findings.append(
+            _finding(
+                "GL104",
+                f"PRNG key consumed by {len(eqns)} random primitives "
+                f"({', '.join(e.primitive.name for e in eqns)}){where}: "
+                "identical streams",
+                path=path, line=line,
+            )
+        )
+    return findings
+
+
+def _audit_output_sharding(jaxpr, threshold: int, path_hint) -> list[Finding]:
+    """GL105: large outputs whose producing equation is not a sharding pin."""
+    producer = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            producer[id(v)] = eqn
+    invar_ids = {id(v) for v in jaxpr.invars} | {id(v) for v in jaxpr.constvars}
+    findings = []
+    seen: set = set()
+    for v in jaxpr.outvars:
+        if isinstance(v, jax.core.Literal) or id(v) in invar_ids or id(v) in seen:
+            continue  # literals / pass-throughs keep their committed layout
+        seen.add(id(v))
+        size = _aval_bytes(v.aval)
+        if size < threshold:
+            continue
+        eqn = producer.get(id(v))
+        if eqn is not None and eqn.primitive.name in _SHARDING_PRODUCERS:
+            continue
+        path, line = _eqn_location(eqn)
+        if path is None and path_hint:
+            path, line = path_hint
+        findings.append(
+            _finding(
+                "GL105",
+                f"output {getattr(v.aval, 'dtype', '?')}"
+                f"{list(getattr(v.aval, 'shape', ()))} ({size / 2**20:.1f} MiB) "
+                "has no sharding constraint on its producer",
+                path=path, line=line,
+            )
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+
+def audit_traced(
+    traced,
+    *,
+    donated: Optional[list[bool]] = None,
+    const_bytes_threshold: int = 1 << 20,
+    output_bytes_threshold: int = 1 << 20,
+    default_memory_kind: Optional[str] = None,
+    path_hint: Optional[tuple] = None,
+) -> Report:
+    """Audit a ``jax.jit(fn).trace(*args)`` result (or a bare
+    ``ClosedJaxpr`` plus an explicit per-flat-input ``donated`` mask).
+
+    Pure jaxpr walking — nothing executes.  ``default_memory_kind``
+    overrides the backend's default for the GL103 comparison (useful for
+    auditing TPU-shaped programs from a CPU box); thresholds are in bytes.
+    """
+    if hasattr(traced, "jaxpr") and hasattr(traced, "args_info"):
+        closed = traced.jaxpr
+        if donated is None:
+            leaves = jax.tree_util.tree_leaves(
+                traced.args_info, is_leaf=lambda x: hasattr(x, "donated")
+            )
+            donated = [bool(getattr(l, "donated", False)) for l in leaves]
+    else:
+        closed = traced
+    if donated is None:
+        donated = [False] * len(closed.jaxpr.invars)
+    if len(donated) != len(closed.jaxpr.invars):
+        raise ValueError(
+            f"donated mask has {len(donated)} entries for "
+            f"{len(closed.jaxpr.invars)} flat inputs"
+        )
+    if default_memory_kind is None:
+        default_memory_kind = _default_memory_kind()
+
+    findings = []
+    findings += _audit_donation(closed.jaxpr, donated, path_hint)
+    findings += _audit_consts(closed, const_bytes_threshold, path_hint)
+    findings += _audit_transfers(closed.jaxpr, default_memory_kind)
+    findings += _audit_key_reuse(closed)
+    findings += _audit_output_sharding(closed.jaxpr, output_bytes_threshold, path_hint)
+    return Report(apply_suppressions(findings))
+
+
+def _path_hint_of(fn) -> Optional[tuple]:
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        inner = getattr(fn, "__wrapped__", None)
+        code = getattr(inner, "__code__", None)
+    if code is None:
+        return None
+    return code.co_filename, code.co_firstlineno
+
+
+def audit_fn(fn, *example_args, donate_argnums=(), **audit_kwargs) -> Report:
+    """Jit ``fn`` with ``donate_argnums``, trace it abstractly against
+    ``example_args`` (concrete arrays or ``jax.ShapeDtypeStruct``), and
+    audit the result."""
+    traced = jax.jit(fn, donate_argnums=donate_argnums).trace(*example_args)
+    audit_kwargs.setdefault("path_hint", _path_hint_of(fn))
+    return audit_traced(traced, **audit_kwargs)
+
+
+def audit_jitted(jitted, *example_args, **audit_kwargs) -> Report:
+    """Audit an already-jitted callable — a raw ``jax.jit`` wrapper or a
+    prepared train step (``Accelerator.prepare_train_step`` results expose
+    their inner jit as ``._jitted``)."""
+    inner = getattr(jitted, "_jitted", jitted)
+    if not hasattr(inner, "trace"):
+        raise TypeError(
+            f"{jitted!r} is not a jitted callable (no .trace); pass the "
+            "jax.jit wrapper or a prepared step exposing ._jitted"
+        )
+    audit_kwargs.setdefault("path_hint", _path_hint_of(inner))
+    return audit_traced(inner.trace(*example_args), **audit_kwargs)
+
+
+def summarize(report: Report) -> dict[str, Any]:
+    """Compact digest for bench/tracker embedding."""
+    return report.summary()

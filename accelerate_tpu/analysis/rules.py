@@ -1,0 +1,134 @@
+"""The graft-lint rule catalog — one registry both engines and the docs
+draw from.
+
+Numbering: GL0xx meta (the linter linting its own markers), GL1xx jaxpr
+rules (hazards visible only in the traced program), GL2xx AST rules
+(hazards visible only in the source — caller-side reuse, impure calls the
+trace would bake silently).  ``docs/static_analysis.md`` renders this table;
+``tests/test_analysis.py`` pins that every finding either engine can emit
+carries an id registered here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .report import Severity
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: Severity
+    engine: str  # "jaxpr" | "ast" | "meta"
+    summary: str
+    fix_hint: str
+
+
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in [
+        Rule(
+            "GL001", "bare-suppression", Severity.WARNING, "meta",
+            "a `graft-lint: disable=` marker without a rationale",
+            "append `-- <why this hazard is intentional>` to the marker",
+        ),
+        Rule(
+            "GL002", "engine-error", Severity.ERROR, "meta",
+            "graft-lint could not analyze a target: an explicitly named "
+            "path that does not exist / cannot be read, or a module that "
+            "does not parse — reported loudly so a typo'd CI target can "
+            "never pass as a clean run",
+            "fix the path or the syntax error; a file that should not be "
+            "linted belongs in the excludes, not in the sweep",
+        ),
+        # ------------------------------------------------------------------
+        # jaxpr engine — hazards read off the traced program
+        # ------------------------------------------------------------------
+        Rule(
+            "GL101", "wasted-donation", Severity.WARNING, "jaxpr",
+            "a donated input buffer that no output can alias (no output of "
+            "the same byte size remains after greedy matching): the donation "
+            "frees nothing, and the caller still loses the buffer",
+            "drop the argument from donate_argnums, or return an update of "
+            "the same shape/dtype so XLA can reuse the buffer",
+        ),
+        Rule(
+            "GL102", "const-capture", Severity.WARNING, "jaxpr",
+            "a large closed-over constant baked into the jaxpr: it is "
+            "re-uploaded per compiled executable, duplicated across "
+            "retraces, and invisible to donation/sharding",
+            "pass the array as an explicit argument (donate or shard it), "
+            "or hoist it with the host-constant idiom",
+        ),
+        Rule(
+            "GL103", "transfer-in-trace", Severity.WARNING, "jaxpr",
+            "a device_put inside traced code whose destination memory kind "
+            "differs from the program's default: an implicit host<->device "
+            "transfer serialized into the step, invisible to the "
+            "ops/streaming.py overlap accounting",
+            "move the transfer outside the jit, or route it through the "
+            "streaming pipeline stages so it overlaps compute",
+        ),
+        Rule(
+            "GL104", "key-reuse", Severity.ERROR, "jaxpr",
+            "a PRNG key consumed by more than one random primitive: the "
+            "streams are identical, which silently correlates what should "
+            "be independent randomness (and breaks the SR hash-stream "
+            "determinism contract)",
+            "jax.random.split (or fold_in) once per consumer and retire "
+            "the parent key",
+        ),
+        Rule(
+            "GL105", "unsharded-output", Severity.WARNING, "jaxpr",
+            "a large output with no sharding constraint on its producer: "
+            "GSPMD may resolve it fully replicated, costing a full copy of "
+            "the array per device",
+            "pin it with jax.lax.with_sharding_constraint (or out_shardings "
+            "on the jit) like the accelerator's pinned_step_fn does",
+        ),
+        # ------------------------------------------------------------------
+        # AST engine — hazards read off the source
+        # ------------------------------------------------------------------
+        Rule(
+            "GL201", "donated-reuse", Severity.ERROR, "ast",
+            "a name passed in a donated position of a donate_argnums call "
+            "site is read again afterwards: the buffer may already be "
+            "overwritten in place by the compiled program (the PR 2 "
+            "async-checkpoint race shape)",
+            "rebind the name to the call's result, or snapshot the value "
+            "(sharding-preserving jit identity copy) before the call",
+        ),
+        Rule(
+            "GL202", "host-sync-in-step", Severity.ERROR, "ast",
+            "a host-synchronizing call (.item()/.tolist()/float()/int()/"
+            "np.asarray/np.array) on a traced value inside jitted code: "
+            "either a trace-time ConcretizationTypeError or, via callbacks, "
+            "a hidden device->host sync that serializes the step",
+            "keep the value abstract (jnp ops) and read metrics outside "
+            "the jit",
+        ),
+        Rule(
+            "GL203", "shard-map-compat", Severity.WARNING, "ast",
+            "jax.experimental.shard_map referenced outside an "
+            "`except ImportError` compat fallback: the experimental path "
+            "is removed in newer jax and must only appear as the shim's "
+            "fallback branch",
+            "use `try: from jax import shard_map` with the experimental "
+            "import only in the except ImportError handler",
+        ),
+        Rule(
+            "GL204", "impure-in-jit", Severity.ERROR, "ast",
+            "a call to time.time()/perf_counter()/random.*/np.random.* "
+            "inside jitted code: the value is baked in at trace time, so "
+            "every execution silently reuses the first call's result",
+            "thread timestamps/randomness in as arguments (jax.random for "
+            "in-trace randomness)",
+        ),
+    ]
+}
+
+
+def rule(rule_id: str) -> Rule:
+    return RULES[rule_id]

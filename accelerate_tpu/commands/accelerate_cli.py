@@ -18,6 +18,7 @@ from .env import env_command_parser
 from .estimate import estimate_command_parser
 from .from_accelerate import from_accelerate_command_parser
 from .launch import launch_command_parser
+from .lint import lint_command_parser
 from .merge import merge_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
@@ -41,6 +42,7 @@ def build_parser() -> argparse.ArgumentParser:
     tpu_command_parser(subparsers)
     from_accelerate_command_parser(subparsers)
     cloud_command_parser(subparsers)
+    lint_command_parser(subparsers)
     return parser
 
 

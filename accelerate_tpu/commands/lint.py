@@ -1,0 +1,101 @@
+"""``accelerate-tpu lint`` — run graft-lint (both static-analysis engines).
+
+The AST rule engine sweeps the given paths (default: the current tree,
+minus the intentionally-buggy ``tests/analysis_fixtures``); the jaxpr
+auditor traces a canonical tiny train step through the real
+``Accelerator.prepare_train_step`` machinery — same donation, pinning, and
+optimizer plumbing as production, CPU-safe, nothing executes on device —
+so the hot-path invariants are checked on every ``make lint``.
+
+Exit code 1 when any unsuppressed finding at or above ``--fail-on``
+severity (default: error) remains.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def lint_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Static analysis for donation, transfer, and sharding hazards "
+        "(jaxpr auditor + AST rule engine; see docs/static_analysis.md)."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("lint", description=description, help=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu lint", description=description)
+    parser.add_argument(
+        "paths", nargs="*", default=["."],
+        help="files/directories to sweep with the AST engine (default: .)",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable report")
+    parser.add_argument(
+        "--fail-on", choices=["error", "warning", "info"], default="error",
+        help="lowest severity that fails the run (default: error)",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed findings (with their rationales) in the output",
+    )
+    parser.add_argument(
+        "--no-step-audit", action="store_true",
+        help="skip the jaxpr audit of the canonical train step (AST sweep only)",
+    )
+    parser.add_argument(
+        "--optimizer", default="lion",
+        help="optimizer recipe for the canonical step audit (default: lion)",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=lint_command)
+    return parser
+
+
+def audit_canonical_step(optimizer: str = "lion"):
+    """Jaxpr-audit a tiny train step built through the real accelerator
+    machinery (create_train_state + prepare_train_step, donation on).
+
+    This is the in-CI twin of the ``accelerator.py`` hot spot: the traced
+    program contains the genuine donation set, RNG threading, sharding
+    pins, and (for the -sr recipes) the SR hash streams.  Pure trace — no
+    device execution, runs on CPU.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..accelerator import Accelerator
+
+    acc = Accelerator()
+    params = {"w": jnp.zeros((16, 16), jnp.float32), "b": jnp.zeros((16,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        pred = batch @ p["w"] + p["b"]
+        return jnp.mean(pred**2)
+
+    state = acc.create_train_state(params, optimizer)
+    step = acc.prepare_train_step(loss_fn)
+    batch = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    return acc.audit_step(step, state, batch, log=False)
+
+
+def lint_command(args) -> None:
+    from ..analysis import Report, Severity, lint_paths
+
+    report: Report = lint_paths(args.paths)
+    if not args.no_step_audit:
+        report.extend(audit_canonical_step(args.optimizer).findings)
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render(show_suppressed=args.show_suppressed))
+    raise SystemExit(report.exit_code(Severity.parse(args.fail_on)))
+
+
+def main():
+    lint_command(lint_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

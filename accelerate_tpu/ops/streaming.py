@@ -260,6 +260,7 @@ def stage_put(tree, shardings):
     :func:`offload_transfer_accounting` (exact leaf arithmetic), the
     host-driven decode path's from :class:`LayerPrefetcher`'s stats."""
     return jax.tree_util.tree_map(
+        # graft-lint: disable=GL103 -- these transfers ARE the streaming pipeline's overlapped stages: issued un-gated by the update token chain so XLA slides them under neighboring chunks' host compute
         lambda x, s: jax.device_put(x, s) if s is not None else x, tree, shardings
     )
 

@@ -464,22 +464,34 @@ def main():
                     help="print the abstract per-device memory plan for an N-chip mesh and exit")
     ap.add_argument("--plan-task", choices=["train", "infer"], default="train",
                     help="--plan flavor: 7B training (default) or sharded 70B inference")
+    ap.add_argument("--audit", action="store_true",
+                    help="with --plan: also graft-lint the selected step — trace a "
+                         "tiny train step through the real prepare_train_step "
+                         "machinery with the selected optimizer and embed the "
+                         "jaxpr-audit summary (analysis/jaxpr_audit.py; pure "
+                         "trace, CPU-safe, no device execution)")
     args = ap.parse_args()
 
     if args.plan:
         if args.plan_task == "infer":
-            print(json.dumps({
+            rep = {
                 "metric": "llama2_70b_sharded_inference_plan", "value": args.plan,
                 "unit": "devices",
                 "extra": plan_infer_report(args.plan, args.seq_len or 2048, args.batch or 8),
-            }))
+            }
         else:
-            print(json.dumps({
+            rep = {
                 "metric": "llama2_7b_memory_plan", "value": args.plan, "unit": "devices",
                 "extra": plan_report(args.plan, args.seq_len or 2048, args.batch or 1,
                                      offload=args.offload,
                                      optimizer=args.optimizer or "lion-sr"),
-            }))
+            }
+        if args.audit:
+            from accelerate_tpu.commands.lint import audit_canonical_step
+
+            audit = audit_canonical_step(args.optimizer or "lion-sr")
+            rep["extra"]["audit"] = audit.summary()
+        print(json.dumps(rep))
         return
 
     # persistent compile cache: repeat bench runs (and driver rounds) skip

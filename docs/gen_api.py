@@ -20,6 +20,11 @@ OUT = Path(__file__).parent / "api"
 # or module order)
 PAGES: dict[str, tuple[str, list[str] | None]] = {
     "accelerator": ("accelerate_tpu.accelerator", ["Accelerator", "TrainState", "global_norm"]),
+    "analysis": ("accelerate_tpu.analysis", [
+        "Severity", "Finding", "Report", "Rule", "audit_fn", "audit_jitted",
+        "audit_traced", "lint_source", "lint_paths", "iter_python_files",
+        "apply_suppressions", "parse_marker",
+    ]),
     "state": ("accelerate_tpu.state", ["PartialState", "AcceleratorState", "GradientState"]),
     "parallelism_config": ("accelerate_tpu.parallelism_config", ["ParallelismConfig"]),
     "data_loader": ("accelerate_tpu.data_loader", [

@@ -1,0 +1,61 @@
+"""Corrected twins of ``planted_jaxpr.py`` — same shapes, same audit
+parameters, zero findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BIG_TABLE = np.ones((600, 600), np.float32)
+
+
+def wasted_donation_step(state, batch):
+    """GL101 fixed: the update has the donated argument's shape/dtype, so
+    XLA aliases the donated buffer to it — donation actually frees HBM."""
+    new_state = state * 0.9 + batch
+    return new_state, (state * batch).sum()
+
+
+def key_reuse_step(key, x):
+    """GL104 fixed: one split child per consumer, parent retired."""
+    k_noise, k_mask = jax.random.split(key)
+    noise = jax.random.normal(k_noise, x.shape)
+    mask = jax.random.uniform(k_mask, x.shape) > 0.1
+    return jnp.where(mask, x + noise, x)
+
+
+def key_reuse_after_split_step(key, x):
+    """GL104 fixed: only the split children are consumed."""
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(k1, x.shape) + jax.random.normal(k2, x.shape)
+
+
+def const_capture_step(x, table):
+    """GL102 fixed: the table rides in as an argument — shardable,
+    donatable, absent from the jaxpr consts."""
+    return x @ table
+
+
+def transfer_in_trace_step(x):
+    """GL103 fixed: no placement change inside the trace; the caller owns
+    transfers (or routes them through the streaming pipeline stages)."""
+    return x * 2.0
+
+
+def unsharded_output_step(x):
+    """GL105 fixed: the producer is a sharding constraint, like the
+    accelerator's ``pinned_step_fn``."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+    return jax.lax.with_sharding_constraint(x + 1.0, NamedSharding(mesh, PartitionSpec()))
+
+
+def example_args():
+    return {
+        "wasted_donation_step": (jnp.ones((64, 64)), jnp.ones((64, 64))),
+        "key_reuse_step": (jax.random.key(0), jnp.ones((8,))),
+        "key_reuse_after_split_step": (jax.random.key(0), jnp.ones((8,))),
+        "const_capture_step": (jnp.ones((600,)), jnp.asarray(_BIG_TABLE)),
+        "transfer_in_trace_step": (jnp.ones((8,)),),
+        "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
+    }

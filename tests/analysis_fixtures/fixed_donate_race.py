@@ -1,0 +1,33 @@
+"""Corrected twin of ``planted_donate_race.py`` — the PR 2 fix shape.
+
+The snapshot (a sharding-preserving jit identity copy, exactly what
+``checkpointing._sharded_copy_fn`` does) is taken BEFORE the donating call,
+so the background writer reads buffers the step never owned.  The donated
+name is dead after the call site: graft-lint must stay quiet here.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+def _write_to_disk(tree, path="/tmp/ckpt"):
+    _ = (tree, path)
+
+
+def _train_step(state, batch):
+    return {"params": state["params"] * 0.9 + batch.mean()}
+
+
+jitted_step = jax.jit(_train_step, donate_argnums=(0,))
+
+_identity_copy = jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t))
+
+
+def train_then_snapshot(state, batch):
+    snapshot = _identity_copy(state)  # synchronous-snapshot half of the contract
+    new_state = jitted_step(state, batch)
+    writer = threading.Thread(target=_write_to_disk, args=(snapshot,))
+    writer.start()
+    return new_state

@@ -1,0 +1,39 @@
+"""PLANTED BUG — the PR 2 async-checkpoint use-after-donate race, minimal.
+
+``save_state(async_save=True)`` used to hand the live train state to the
+background orbax writer *after* the prepared step had donated its buffers:
+on the CPU backend the write aliases the arrays zero-copy, so checkpoint N
+could restore with checkpoint N+1's values.  This module reproduces the
+exact caller shape the AST engine must flag (GL201): a name passed in the
+donated position of a ``donate_argnums`` call site, then read again by the
+background-writer handoff.
+
+Never imported by the suite — linted as source only.  The corrected twin
+lives in ``fixed_donate_race.py``.
+"""
+
+import threading
+
+import jax
+
+
+def _write_to_disk(tree, path="/tmp/ckpt"):
+    """Stand-in for the orbax background writer: reads ``tree``'s buffers
+    asynchronously, long after this function returned."""
+    _ = (tree, path)
+
+
+def _train_step(state, batch):
+    return {"params": state["params"] * 0.9 + batch.mean()}
+
+
+jitted_step = jax.jit(_train_step, donate_argnums=(0,))
+
+
+def train_then_snapshot(state, batch):
+    new_state = jitted_step(state, batch)
+    # BUG: `state`'s buffers were donated to the step above — the writer
+    # thread reads them while XLA may already be overwriting them in place.
+    writer = threading.Thread(target=_write_to_disk, args=(state,))
+    writer.start()
+    return new_state

@@ -1,0 +1,74 @@
+"""PLANTED BUGS for the jaxpr auditor — one function per GL1xx rule.
+
+These ARE imported and traced (abstractly — ``jax.jit(...).trace``, no
+device execution) by ``tests/test_analysis.py``; each function carries the
+hazard in its traced program, invisible to a source-level linter.
+Corrected twins: ``clean_jaxpr.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ~1.4 MiB closed-over constant (above the 1 MiB default threshold)
+_BIG_TABLE = np.ones((600, 600), np.float32)
+
+
+def wasted_donation_step(state, batch):
+    """GL101: ``state`` is donated (the test jits with donate_argnums=(0,))
+    but the function returns only a scalar — no output can alias the
+    donated (64, 64) buffer, so the donation frees nothing."""
+    return (state * batch).sum()
+
+
+def key_reuse_step(key, x):
+    """GL104: the same key feeds two random primitives — the 'noise' and
+    'dropout' streams are identical."""
+    noise = jax.random.normal(key, x.shape)
+    mask = jax.random.uniform(key, x.shape) > 0.1
+    return jnp.where(mask, x + noise, x)
+
+
+def key_reuse_after_split_step(key, x):
+    """GL104 (the classic): the parent key is split AND consumed directly —
+    the direct stream correlates with the children."""
+    k1, _k2 = jax.random.split(key)
+    direct = jax.random.normal(key, x.shape)  # parent already retired by split
+    child = jax.random.normal(k1, x.shape)
+    return direct + child
+
+
+def const_capture_step(x):
+    """GL102: ``_BIG_TABLE`` closes over into the jaxpr as a constant —
+    re-uploaded per executable, invisible to donation and sharding."""
+    return x @ _BIG_TABLE
+
+
+def transfer_in_trace_step(x):
+    """GL103 (audited with ``default_memory_kind='device'``): an explicit
+    device_put inside traced code — on TPU this is a host<->device copy
+    serialized into the step."""
+    y = x * 2.0
+    dst = jax.sharding.SingleDeviceSharding(
+        jax.devices()[0], memory_kind=jax.devices()[0].default_memory().kind
+    )
+    return jax.device_put(y, dst)
+
+
+def unsharded_output_step(x):
+    """GL105: a 4 MiB output whose producer is a plain add — GSPMD may
+    resolve it fully replicated."""
+    return x + 1.0  # x: (1024, 1024) f32
+
+
+def example_args():
+    """Concrete example inputs for each planted function (tiny; tracing
+    only reads shapes/dtypes)."""
+    return {
+        "wasted_donation_step": (jnp.ones((64, 64)), jnp.ones((64, 64))),
+        "key_reuse_step": (jax.random.key(0), jnp.ones((8,))),
+        "key_reuse_after_split_step": (jax.random.key(0), jnp.ones((8,))),
+        "const_capture_step": (jnp.ones((600,)),),
+        "transfer_in_trace_step": (jnp.ones((8,)),),
+        "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
+    }

@@ -80,6 +80,28 @@ def test_bench_streaming_pipeline_smoke():
 
 
 @pytest.mark.slow
+def test_bench_plan_audit_hook():
+    """``--plan N --audit`` embeds the graft-lint jaxpr-audit summary for
+    the selected step: a tiny train step traced through the real
+    prepare_train_step machinery with the selected optimizer (pure
+    abstract trace — CPU-safe, nothing executes on device)."""
+    rep = _run(["bench.py", "--plan", "8", "--batch", "8", "--audit"])
+    audit = rep["extra"]["audit"]
+    assert audit["ok"] is True
+    assert audit["error"] == 0 and audit["warning"] == 0
+    assert "rules" in audit and "suppressed" in audit
+
+    # audit rides along on the inference plan flavor too
+    rep_inf = _run(["bench.py", "--plan", "8", "--batch", "8",
+                    "--plan-task", "infer", "--audit"])
+    assert rep_inf["extra"]["audit"]["ok"] is True
+
+    # without --audit the plan stays audit-free (no accidental cost)
+    rep_plain = _run(["bench.py", "--plan", "8", "--batch", "8"])
+    assert "audit" not in rep_plain["extra"]
+
+
+@pytest.mark.slow
 def test_host_compute_probe_quiet_box_gate():
     """The probe enforces the quiet-box precondition and carries the gate
     report (loadavg + calibration vs the 1.71 GiB/s baseline) in its JSON;
