@@ -298,6 +298,17 @@ class Accelerator:
             self.state.mesh
 
         self.fsdp_plugin = fsdp_plugin
+        # install the ring collective-matmul mode as the ambient trace-time
+        # default (ops/collective_matmul.py); models traced through this
+        # accelerator's steps pick it up at compile.  Construction is
+        # authoritative either way: a plugin-less Accelerator clears any
+        # previous override back to the env default rather than inheriting
+        # a stale mode from an earlier instance.
+        from .ops.collective_matmul import set_collective_matmul
+
+        set_collective_matmul(
+            fsdp_plugin.collective_matmul if fsdp_plugin is not None else None
+        )
         self.tp_config = tp_config
         self.cp_config = cp_config
         self.sp_config = sp_config

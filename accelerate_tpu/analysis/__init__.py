@@ -27,7 +27,7 @@ from .ast_rules import (
     lint_paths,
     lint_source,
 )
-from .jaxpr_audit import audit_fn, audit_jitted, audit_traced
+from .jaxpr_audit import audit_fn, audit_jitted, audit_traced, iter_eqns
 from .report import Finding, Report, Severity, apply_suppressions, parse_marker
 from .rules import RULES, Rule, rule
 
@@ -43,6 +43,7 @@ __all__ = [
     "audit_fn",
     "audit_jitted",
     "audit_traced",
+    "iter_eqns",
     "iter_python_files",
     "lint_paths",
     "lint_source",

@@ -23,6 +23,10 @@ invisible to a source-level linter:
 - **GL105 unsharded large output** — an output above the size threshold
   whose producer is not a sharding constraint may be resolved fully
   replicated by GSPMD.
+- **GL106 collective-matmul hint** (info) — an ``all_gather`` consumed by
+  exactly one ``dot_general`` is the monolithic gather-then-matmul pipe
+  that ``ops/collective_matmul.py`` decomposes into a latency-hiding ring;
+  only the traced program shows the consumer fan-out.
 
 Suppression is source-anchored (see :mod:`.report`): each finding resolves
 its file/line from the flagged equation's ``source_info``, so the same
@@ -112,6 +116,16 @@ def _walk_eqns(jaxpr) -> Iterable:
         yield eqn
         for sub in _sub_jaxprs(eqn):
             yield from _walk_eqns(sub.jaxpr)
+
+
+def iter_eqns(closed_or_jaxpr) -> Iterable:
+    """Public depth-first equation iterator over a ``ClosedJaxpr`` (or bare
+    jaxpr), descending into every sub-jaxpr — the one place the sub-jaxpr
+    packaging convention lives (callers checking for a primitive, e.g. the
+    dryrun's ppermute-engagement probe, should use this rather than
+    re-rolling the recursion)."""
+    jaxpr = getattr(closed_or_jaxpr, "jaxpr", closed_or_jaxpr)
+    return _walk_eqns(jaxpr)
 
 
 def _finding(rule_id: str, message: str, *, path=None, line=None) -> Finding:
@@ -288,6 +302,53 @@ def _audit_key_reuse(closed) -> list[Finding]:
     return findings
 
 
+def _audit_collective_matmul(closed) -> list[Finding]:
+    """GL106 (hint): an ``all_gather`` whose result is consumed by exactly
+    one ``dot_general`` — the monolithic gather-then-matmul pipe the ring
+    collective-matmul decomposes.  Scope-local: jaxpr vars never cross
+    sub-jaxpr boundaries except through invars, so consumers are counted
+    within each (sub-)jaxpr; a gathered value that escapes the scope or
+    feeds anything else (norms, residuals, multiple dots) is not a pure
+    pipe and stays quiet."""
+    findings = []
+
+    def scan(jaxpr):
+        consumers: dict[int, list] = {}
+        gathers = []
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                if not isinstance(v, jax.core.Literal):
+                    consumers.setdefault(id(v), []).append(eqn)
+            if eqn.primitive.name == "all_gather":
+                gathers.append(eqn)
+            for sub in _sub_jaxprs(eqn):
+                scan(sub.jaxpr)
+        escaped = {id(v) for v in jaxpr.outvars if not isinstance(v, jax.core.Literal)}
+        for g in gathers:
+            out = g.outvars[0]
+            cons = consumers.get(id(out), [])
+            if id(out) in escaped or len(cons) != 1:
+                continue
+            if cons[0].primitive.name != "dot_general":
+                continue
+            path, line = _eqn_location(g)
+            aval = out.aval
+            findings.append(
+                _finding(
+                    "GL106",
+                    f"all_gather result {getattr(aval, 'dtype', '?')}"
+                    f"{list(getattr(aval, 'shape', ()))} feeds exactly one "
+                    "dot_general: a collective-matmul candidate (the gather "
+                    "could ride a ppermute ring hidden under the partial "
+                    "matmuls — ops/collective_matmul.py)",
+                    path=path, line=line,
+                )
+            )
+
+    scan(closed.jaxpr)
+    return findings
+
+
 def _audit_output_sharding(jaxpr, threshold: int, path_hint) -> list[Finding]:
     """GL105: large outputs whose producing equation is not a sharding pin."""
     producer = {}
@@ -367,6 +428,7 @@ def audit_traced(
     findings += _audit_consts(closed, const_bytes_threshold, path_hint)
     findings += _audit_transfers(closed.jaxpr, default_memory_kind)
     findings += _audit_key_reuse(closed)
+    findings += _audit_collective_matmul(closed)
     findings += _audit_output_sharding(closed.jaxpr, output_bytes_threshold, path_hint)
     return Report(apply_suppressions(findings))
 

@@ -81,6 +81,18 @@ RULES: dict[str, Rule] = {
             "the parent key",
         ),
         Rule(
+            "GL106", "collective-matmul-hint", Severity.INFO, "jaxpr",
+            "an all_gather whose result feeds exactly one dot_general: the "
+            "gather serializes ICI against the matmul it exists to feed — "
+            "the canonical shape the ring collective-matmul "
+            "(ops/collective_matmul.py) decomposes into ppermute ticks "
+            "hidden under partial matmuls (a hint, not a defect: "
+            "suppressible, and never fails a run)",
+            "route the pair through ops/collective_matmul.py "
+            "(ring_all_gather_matmul / dense_collective_matmul), or enable "
+            "FullyShardedDataParallelPlugin.collective_matmul",
+        ),
+        Rule(
             "GL105", "unsharded-output", Severity.WARNING, "jaxpr",
             "a large output with no sharding constraint on its producer: "
             "GSPMD may resolve it fully replicated, costing a full copy of "

@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.collective_matmul import dense_collective_matmul
 from ..ops.precision import fp8_current_scaled_dot, fp8_enabled
 from ..ops.quantized_matmul import quantized_matmul
 from ..utils.quantization import is_quantized
@@ -34,6 +35,14 @@ class QuantizableDense(nn.Module):
     The quantized kernel is fetched with ``get_variable`` (``self.param``
     would flatten the QuantizedTensor pytree and fail its leaf-wise shape
     check); init mode always creates a full-precision kernel.
+
+    ``tp_mode`` declares the layer's Megatron role ("column": output dim
+    tp-sharded, "row": input dim tp-sharded) so that, when the collective-
+    matmul knob is on (``ops/collective_matmul.py``), the matmul runs as a
+    latency-hiding ring over ``tp_axis`` instead of leaving the monolithic
+    all-gather / reduce-scatter to GSPMD.  The ring falls back to the plain
+    ``jnp.dot`` path whenever it cannot engage (trivial axis, non-dividing
+    shapes, decode-length inputs) — global values are identical either way.
     """
 
     features: int
@@ -42,6 +51,8 @@ class QuantizableDense(nn.Module):
     param_dtype: Any = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     bias_init: Callable = nn.initializers.zeros_init()
+    tp_mode: Optional[str] = None  # None | "column" | "row"
+    tp_axis: str = "tp"
 
     @nn.compact
     def __call__(self, x):
@@ -62,7 +73,14 @@ class QuantizableDense(nn.Module):
                     x.astype(dtype), kernel.astype(dtype), preferred_element_type=dtype
                 )
             else:
-                y = jnp.dot(x.astype(dtype), kernel.astype(dtype))
+                y = None
+                if self.tp_mode is not None:
+                    y = dense_collective_matmul(
+                        x.astype(dtype), kernel.astype(dtype), self.tp_mode,
+                        axis_name=self.tp_axis,
+                    )
+                if y is None:
+                    y = jnp.dot(x.astype(dtype), kernel.astype(dtype))
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
             y = y + bias.astype(dtype)

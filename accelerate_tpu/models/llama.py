@@ -274,11 +274,26 @@ class LlamaAttention(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None):
         cfg = self.config
-        dense = partial(QuantizableDense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
-        q = dense(cfg.num_attention_heads * cfg.head_dim, name="q_proj")(x)
-        k = dense(cfg.num_key_value_heads * cfg.head_dim, name="k_proj")(x)
-        v = dense(cfg.num_key_value_heads * cfg.head_dim, name="v_proj")(x)
         b, t = x.shape[:2]
+        # Ulysses boundary as collective matmul: q/k/v fuse with all_to_all
+        # #1 (ring all-gather->matmul over sp slices heads while gathering
+        # the sequence) and o_proj with all_to_all #2 (ring matmul->reduce-
+        # scatter back to sequence-sharded) — attention then runs with
+        # heads pre-sharded.  Off (the default) or non-ulysses: the denses
+        # ring over tp in their Megatron column/row roles.
+        from ..ops.collective_matmul import ulysses_sp_boundary
+
+        sp_boundary = (
+            cfg.attn_implementation == "ulysses" and cache is None
+            and ulysses_sp_boundary(cfg.num_attention_heads, cfg.num_key_value_heads, t)
+        )
+        ring_axis = "sp" if sp_boundary else "tp"
+        dense = partial(QuantizableDense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
+        col = partial(dense, tp_mode="column", tp_axis=ring_axis)
+        row = partial(dense, tp_mode="row", tp_axis=ring_axis)
+        q = col(cfg.num_attention_heads * cfg.head_dim, name="q_proj")(x)
+        k = col(cfg.num_key_value_heads * cfg.head_dim, name="k_proj")(x)
+        v = col(cfg.num_key_value_heads * cfg.head_dim, name="v_proj")(x)
         q = q.reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
@@ -302,16 +317,21 @@ class LlamaAttention(nn.Module):
             out = cached_attention(q, k_cache, v_cache, pos_cache, positions)
             new_cache = {"k": k_cache, "v": v_cache, "pos": pos_cache, "index": idx + t}
             out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
-            return dense(cfg.hidden_size, name="o_proj")(out), new_cache
+            return row(cfg.hidden_size, name="o_proj")(out), new_cache
 
         attn = get_attention_impl(cfg.attn_implementation)
         attn_kwargs = {}
         if cfg.attn_implementation == "flash" and cfg.flash_block_q is not None:
             attn_kwargs = {"block_q": cfg.flash_block_q,
                            "block_k": cfg.flash_block_k or cfg.flash_block_q}
+        if sp_boundary:
+            # q/k/v left the column rings head-sharded over sp at full
+            # sequence; attention skips its entry/exit all_to_alls and the
+            # o_proj row ring below scatters the sequence back
+            attn_kwargs["heads_sharded"] = True
         out = attn(q, k, v, causal=True, segment_ids=segment_ids, **attn_kwargs)
         out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
-        return dense(cfg.hidden_size, name="o_proj")(out)
+        return row(cfg.hidden_size, name="o_proj")(out)
 
 
 class LlamaMLP(nn.Module):
@@ -321,9 +341,12 @@ class LlamaMLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = partial(QuantizableDense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
-        gate = dense(cfg.intermediate_size, name="gate_proj")(x)
-        up = dense(cfg.intermediate_size, name="up_proj")(x)
-        return dense(cfg.hidden_size, name="down_proj")(nn.silu(gate) * up)
+        # Megatron roles for the collective-matmul ring over tp: gate/up
+        # column-parallel (gather the sequence into the matmul), down
+        # row-parallel (reduce-scatter the output back to sequence shards)
+        gate = dense(cfg.intermediate_size, name="gate_proj", tp_mode="column")(x)
+        up = dense(cfg.intermediate_size, name="up_proj", tp_mode="column")(x)
+        return dense(cfg.hidden_size, name="down_proj", tp_mode="row")(nn.silu(gate) * up)
 
 
 class LlamaBlock(nn.Module):
@@ -417,6 +440,17 @@ class LMHead(nn.Module):
         w = self.param(
             "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.vocab_size), jnp.float32
         )
+        if x.ndim == 3:
+            # column-parallel over tp (lm_head rule shards the vocab dim):
+            # the ring gathers the sequence left tp-scattered by the last
+            # block's row-parallel down_proj inside the head matmul
+            from ..ops.collective_matmul import dense_collective_matmul
+
+            y = dense_collective_matmul(
+                x, w.astype(self.dtype), "column", preferred_element_type=jnp.float32
+            )
+            if y is not None:
+                return y
         return jax.lax.dot_general(
             x, w.astype(self.dtype), (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,

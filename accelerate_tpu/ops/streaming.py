@@ -96,6 +96,13 @@ class StreamStats:
     requested.  Achieved overlap needs a serial-transfer baseline:
     ``overlap_report(serial_transfer_s)`` — with prefetch off, the same
     pipeline measures that baseline (``fetch_wait_s`` ≈ total transfer).
+
+    ``ici_bytes``/``tp_overlap_frac`` carry the ICI plane's accounting when
+    a ring collective-matmul is active (``ops/collective_matmul.py``):
+    bytes permuted around the TP/SP ring per step and the predicted hidden
+    fraction (``tp_comm_accounting``; measured twin:
+    ``utils/xplane.ici_overlap_report``).  They join the report only when
+    set — host↔device-only pipelines keep their original key set.
     """
 
     h2d_bytes: int = 0
@@ -104,6 +111,8 @@ class StreamStats:
     prefetch_hits: int = 0
     fetch_wait_s: float = 0.0
     wall_s: float = 0.0
+    ici_bytes: int = 0
+    tp_overlap_frac: Optional[float] = None
 
     def overlap_report(self, serial_transfer_s: Optional[float] = None) -> dict:
         rep = {
@@ -120,6 +129,10 @@ class StreamStats:
             rep["overlap_frac"] = round(
                 max(0.0, 1.0 - self.fetch_wait_s / serial_transfer_s), 4
             )
+        if self.ici_bytes:
+            rep["ici_bytes"] = int(self.ici_bytes)
+        if self.tp_overlap_frac is not None:
+            rep["tp_overlap_frac"] = round(self.tp_overlap_frac, 4)
         return rep
 
 

@@ -105,7 +105,24 @@ def axis_size(axis_name: str):
 
 
 def broadcast_from(x, axis_name: str, src: int = 0):
-    """Broadcast the ``src`` shard to all members of the axis."""
-    n = _axis_size(axis_name)
-    full = lax.all_gather(x, axis_name, axis=0, tiled=False)
-    return full[src]
+    """Broadcast the ``src`` shard to all members of the axis.
+
+    One-hot mask + psum: every rank contributes zeros except ``src``, so the
+    sum IS the source shard — O(n) wire/memory per rank.  (The previous
+    implementation all-gathered the full [devices, ...] stack just to index
+    one row: O(n * devices) memory on every rank.)  ``where`` rather than
+    multiply-by-mask so non-finite values on non-source ranks cannot poison
+    the sum; bools ride as int32 through the reduction.
+    """
+    n = _axis_size(axis_name)  # static int (axis extents are trace-time)
+    if isinstance(n, int) and not 0 <= src < n:
+        # the old gather-then-index form raised at trace time on a bad src;
+        # an unmatched one-hot would instead psum to silent zeros
+        raise ValueError(f"broadcast_from src={src} out of range for axis "
+                         f"{axis_name!r} of size {n}")
+    idx = lax.axis_index(axis_name)
+    as_bool = x.dtype == jnp.bool_
+    payload = x.astype(jnp.int32) if as_bool else x
+    masked = jnp.where(idx == src, payload, jnp.zeros_like(payload))
+    out = lax.psum(masked, axis_name)
+    return out.astype(jnp.bool_) if as_bool else out

@@ -31,7 +31,8 @@ from .collectives import axis_size, partial_manual_kwargs
 
 
 def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causal: bool = True,
-                              inner_attn: Optional[Callable] = None):
+                              inner_attn: Optional[Callable] = None,
+                              heads_sharded: bool = False):
     """shard_map body.  q/k/v local: [B, T/sp, H, D] → out [B, T/sp, H, D].
 
     all_to_all #1: seq-sharded → head-sharded ([B, T, H/sp, D]);
@@ -43,9 +44,16 @@ def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causa
     kv heads [r·Hkv/sp, …)).  ``seg`` [B, T/sp] local segment ids are
     all-gathered to the full sequence each rank attends over (packed
     sequences; int16-sized traffic, negligible next to KV).
+
+    ``heads_sharded``: the collective-matmul boundary contract
+    (``ops/collective_matmul.ulysses_sp_boundary``) — q/k/v arrive already
+    full-sequence head-sharded ([B, T, H/sp, D], the ring all-gather→matmul
+    q/k/v projections absorbed all_to_all #1) and the output leaves
+    head-sharded (the o_proj ring matmul→reduce-scatter absorbs all_to_all
+    #2); ``seg`` then arrives full-sequence too.  Both monolithic
+    all_to_alls disappear from this body.
     """
     sp = axis_size(axis_name)
-    b, t_local, h, d = q.shape
 
     def seq2head(x):
         # split heads across ranks, concat sequence: [B, T/sp, H, D] -> [B, T, H/sp, D]
@@ -54,10 +62,14 @@ def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causa
     def head2seq(x):
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    q_h, k_h, v_h = seq2head(q), seq2head(k), seq2head(v)
-    seg_full = None
-    if seg is not None:
-        seg_full = lax.all_gather(seg, axis_name, axis=1, tiled=True)  # [B, T]
+    if heads_sharded:
+        q_h, k_h, v_h = q, k, v
+        seg_full = seg
+    else:
+        q_h, k_h, v_h = seq2head(q), seq2head(k), seq2head(v)
+        seg_full = None
+        if seg is not None:
+            seg_full = lax.all_gather(seg, axis_name, axis=1, tiled=True)  # [B, T]
     if inner_attn is None:
         from ..models.llama import native_attention
 
@@ -66,7 +78,7 @@ def ulysses_attention_sharded(q, k, v, seg=None, *, axis_name: str = "sp", causa
     # segment_ids parameter stay compatible
     kwargs = {"segment_ids": seg_full} if seg_full is not None else {}
     out_h = inner_attn(q_h, k_h, v_h, causal=causal, **kwargs)
-    return head2seq(out_h)
+    return out_h if heads_sharded else head2seq(out_h)
 
 
 @functools.lru_cache(maxsize=None)
@@ -89,18 +101,27 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
     # partial-manual validator rejects multi-axis meshes spuriously, so the
     # shard_map runs under a cached jit (inlined under an outer jit).
     @functools.lru_cache(maxsize=None)
-    def _build(causal: bool, with_seg: bool):
-        spec = P(None, axis_name, None, None)
+    def _build(causal: bool, with_seg: bool, heads_sharded: bool = False):
+        # heads_sharded (the collective-matmul sp boundary): q/k/v enter
+        # full-sequence with the HEAD dim manual over sp, and leave the same
+        # way — the surrounding ring matmuls own the sequence resharding
+        spec = (P(None, None, axis_name, None) if heads_sharded
+                else P(None, axis_name, None, None))
+        seg_spec = P(None, None) if heads_sharded else P(None, axis_name)
         body = functools.partial(ulysses_attention_sharded, axis_name=axis_name, causal=causal,
-                                 inner_attn=inner_attn)
-        in_specs = (spec, spec, spec) + ((P(None, axis_name),) if with_seg else ())
+                                 inner_attn=inner_attn, heads_sharded=heads_sharded)
+        in_specs = (spec, spec, spec) + ((seg_spec,) if with_seg else ())
         return jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=spec,
                                  **partial_manual_kwargs({axis_name})))
 
-    def attn(q, k, v, *, causal: bool = True, segment_ids=None):
+    def attn(q, k, v, *, causal: bool = True, segment_ids=None, heads_sharded: bool = False):
         h_q, h_kv = q.shape[2], k.shape[2]
         sp = mesh.shape[axis_name]
         if h_kv != h_q and h_kv % sp != 0:
+            if heads_sharded:
+                raise ValueError(
+                    f"heads_sharded ulysses needs kv heads {h_kv} divisible by sp={sp}"
+                )
             # kv heads don't split across sp — broadcast to q width (the
             # aligned case keeps kv at Hkv width through the all_to_alls)
             rep = h_q // h_kv
@@ -109,18 +130,21 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp", inner_attn: Option
         if h_q % sp != 0:
             raise ValueError(f"num_heads {h_q} must be divisible by sp={sp}")
         if segment_ids is None:
-            return _build(causal, False)(q, k, v)
-        return _build(causal, True)(q, k, v, jnp.asarray(segment_ids, jnp.int32))
+            return _build(causal, False, heads_sharded)(q, k, v)
+        return _build(causal, True, heads_sharded)(q, k, v, jnp.asarray(segment_ids, jnp.int32))
 
     return attn
 
 
-def ulysses_attention(q, k, v, *, causal: bool = True, segment_ids=None):
+def ulysses_attention(q, k, v, *, causal: bool = True, segment_ids=None,
+                      heads_sharded: bool = False):
     """Config-name entry resolving the ambient mesh."""
     from ..state import AcceleratorState
 
     state = AcceleratorState()
-    return make_ulysses_attention(state.mesh)(q, k, v, causal=causal, segment_ids=segment_ids)
+    return make_ulysses_attention(state.mesh)(
+        q, k, v, causal=causal, segment_ids=segment_ids, heads_sharded=heads_sharded
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -367,8 +367,14 @@ class AcceleratorState:
         if parallelism_config is not None:
             # surface mesh-shape errors at construction (same check the lazy
             # mesh build runs) so they hit the rollback above instead of
-            # poisoning the singleton from inside the first .mesh access
-            parallelism_config._validate(self.num_devices)
+            # poisoning the singleton from inside the first .mesh access.
+            # An explicit device subset (ParallelismConfig.devices) validates
+            # against ITS size — sub-meshes are legal (dryrun legs, tests).
+            parallelism_config._validate(
+                len(parallelism_config.devices)
+                if parallelism_config.devices is not None
+                else self.num_devices
+            )
 
     # Delegate the PartialState surface ------------------------------------
 
@@ -387,6 +393,12 @@ class AcceleratorState:
         AcceleratorState._shared_state.clear()
         if reset_partial_state:
             PartialState._reset_state()
+        # ambient trace-time knobs owned by an Accelerator die with its
+        # state: a stale ring-matmul override must not leak into the next
+        # (possibly plugin-less) construction
+        from .ops.collective_matmul import set_collective_matmul
+
+        set_collective_matmul(None)
 
     @property
     def mesh(self) -> jax.sharding.Mesh:

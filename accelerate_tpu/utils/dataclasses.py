@@ -330,6 +330,18 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
                                             # which reads this knob.  Default 128 (one
                                             # TPU lane width); env
                                             # ACCELERATE_INT8_STATE_BLOCK.
+    collective_matmul: Optional[str] = None
+                                            # ring collective-matmul for the TP/SP hot
+                                            # path (ops/collective_matmul.py): "off"
+                                            # leaves the monolithic GSPMD all-gather/
+                                            # reduce-scatter, "on"/"ring" decomposes
+                                            # them into ppermute ring schedules that
+                                            # hide ICI hops under the partial matmuls,
+                                            # "bidir" halves ring depth with opposing
+                                            # half-rings.  Trace-time: the Accelerator
+                                            # installs it as the ambient mode at
+                                            # construction.  Default "off"; env
+                                            # ACCELERATE_COLLECTIVE_MATMUL.
     activation_checkpointing: Optional[bool] = None  # jax.checkpoint on remat-policy blocks
     remat_policy: str = "nothing_saveable"  # name of a jax.checkpoint policy
     use_orig_params: bool = True            # API parity; always true under GSPMD
@@ -358,6 +370,12 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
             raise ValueError(
                 f"int8_state_block_size must be >= 1, got {self.int8_state_block_size}"
             )
+        if self.collective_matmul is None:
+            self.collective_matmul = env.get("ACCELERATE_COLLECTIVE_MATMUL", "off")
+        # normalize through the engine's canonical table (raises on junk)
+        from ..ops.collective_matmul import normalize_mode
+
+        self.collective_matmul = normalize_mode(self.collective_matmul)
         if self.activation_checkpointing is None:
             self.activation_checkpointing = parse_flag_from_env("FSDP_ACTIVATION_CHECKPOINTING")
 

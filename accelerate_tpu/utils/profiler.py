@@ -185,6 +185,21 @@ class TPUProfiler:
             )
         return streaming_overlap_report(base, device_substr)
 
+    def ici_overlap(self, device_substr: str = "TPU") -> dict:
+        """Measured ICI collective-vs-compute occupancy from the captured
+        trace (``utils/xplane.ici_overlap_report``) — the profiler-side view
+        of the ring collective-matmul's ``tp_overlap_frac`` (predicted twin:
+        ``ops/collective_matmul.tp_comm_accounting``).  Call after the trace
+        window has closed, like :meth:`key_averages`."""
+        from .xplane import ici_overlap_report
+
+        base = self._handler.output_trace_dir
+        if base is None:
+            raise ValueError(
+                "ici_overlap needs output_trace_dir (no trace was captured)"
+            )
+        return ici_overlap_report(base, device_substr)
+
     def flops_estimate(self, fn, *args, **kwargs) -> float:
         """FLOPs of one call of a jittable ``fn`` at these arguments, from
         XLA's compiled-executable cost analysis; accumulates into

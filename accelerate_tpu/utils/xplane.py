@@ -315,6 +315,54 @@ def streaming_overlap_report(trace_dir: str, device_substr: str = "TPU",
     }
 
 
+# HLO name fragments that mark ICI collective traffic (the op classes the
+# ring collective-matmul either emits — collective-permute — or replaces)
+_COLLECTIVE_MARKS = ("all-reduce", "all-gather", "reduce-scatter",
+                     "all-to-all", "collective-permute")
+
+
+def _is_collective(name: str) -> bool:
+    base = _lhs_base(name).lower()
+    return any(m in base for m in _COLLECTIVE_MARKS)
+
+
+def async_collective_ms(trace_dir: str, device_substr: str = "TPU") -> float:
+    """Collective time on the ``Async XLA Ops`` line — ICI traffic the
+    latency-hiding scheduler kept off the critical path (on TPU the ring's
+    ``collective-permute-start``/``done`` pairs land here when hidden)."""
+    t = _line_times(trace_dir, device_substr, "Async XLA Ops")
+    return round(float(sum(ms for n, ms in t.items() if _is_collective(n))), 3)
+
+
+def ici_overlap_report(trace_dir: str, device_substr: str = "TPU",
+                       breakdown: Optional[dict] = None) -> dict:
+    """ICI comm-vs-compute occupancy from a captured trace — the MEASURED
+    counterpart of ``ops/collective_matmul.tp_comm_accounting``.
+
+    ``tp_overlap_frac`` is the share of collective time the scheduler hid
+    under compute (async vs all collective traffic); ``collective_occupancy``
+    is the inline (critical-path) collective share of the op timeline.  A
+    well-overlapped ring shows collective_occupancy → 0 with
+    tp_overlap_frac → 1; the monolithic path shows its gathers inline.
+    Pass an already-computed ``op_class_breakdown`` as ``breakdown`` to skip
+    re-aggregating the (parse-cached) planes."""
+    br = breakdown if breakdown is not None else op_class_breakdown(trace_dir, device_substr)
+    inline = br.get("collective", {}).get("ms", 0.0)
+    total = br["_total_ms"]
+    async_ms = async_collective_ms(trace_dir, device_substr)
+    denom = total or 1.0
+    all_coll = inline + async_ms
+    return {
+        "total_ms": total,
+        "collective_ms_inline": round(inline, 3),
+        "collective_ms_async": round(async_ms, 3),
+        "collective_occupancy": round(inline / denom, 4),
+        "compute_occupancy": round(max(0.0, total - inline) / denom, 4),
+        "tp_overlap_frac": round(async_ms / all_coll, 4) if all_coll else 0.0,
+        "kind": "measured",
+    }
+
+
 def top_ops(trace_dir: str, n: int = 20, device_substr: str = "TPU") -> list[tuple[str, float]]:
     per_op = device_op_times(trace_dir, device_substr)
     ranked = sorted(per_op.items(), key=lambda kv: -kv[1])[:n]

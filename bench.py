@@ -456,6 +456,15 @@ def main():
                          "k-1's outputs write back while chunk k updates). 'off' "
                          "restores the fully serialized schedule — the A/B "
                          "baseline for the overlap accounting")
+    ap.add_argument("--collective-matmul", choices=["on", "off", "bidir"], default="off",
+                    help="ring collective-matmul for the TP/SP hot path "
+                         "(ops/collective_matmul.py): decompose the monolithic "
+                         "all-gather/reduce-scatter around tensor-parallel "
+                         "matmuls into ppermute ring schedules whose hops hide "
+                         "under the partial matmuls; 'bidir' halves ring depth "
+                         "with opposing half-rings.  State is echoed in extra "
+                         "and tp_overlap_frac is ALWAYS reported (0.0 when the "
+                         "TP axis is trivial — e.g. this bench's dp-only mesh)")
     ap.add_argument("--skip-quiet-box", action="store_true",
                     help="skip the loadavg + calibration quiet-box gate on the "
                          "host-bound offload configs (the gate only warns, never "
@@ -688,6 +697,13 @@ def main():
         fsdp_plugin=fsdp_plugin,
         kwargs_handlers=handlers,
     )
+    # ring collective-matmul mode: installed AFTER the accelerator so the
+    # bench flag wins over the plugin/env default; trace-time — the train
+    # step below compiles under it
+    from accelerate_tpu.ops.collective_matmul import set_collective_matmul
+
+    cm_mode = {"on": "ring", "off": "off", "bidir": "bidir"}[args.collective_matmul]
+    set_collective_matmul(cm_mode)
 
     ids = jnp.ones((batch, seq), jnp.int32)
     if args.model == "7b":
@@ -836,6 +852,13 @@ def main():
         extra_report["streaming_measured"] = streaming_overlap_report(
             args.trace, dev_substr, breakdown=extra_report["op_breakdown"]
         )
+        # measured ICI collective-vs-compute occupancy (the ring collective-
+        # matmul's measured tp_overlap_frac; predicted twin under `tp_comm`)
+        from accelerate_tpu.utils.xplane import ici_overlap_report
+
+        extra_report["ici_measured"] = ici_overlap_report(
+            args.trace, dev_substr, breakdown=extra_report["op_breakdown"]
+        )
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -883,6 +906,25 @@ def main():
         }
     else:
         overlap_fields = {"overlap_frac": 0.0, "h2d_bytes": 0, "d2h_bytes": 0}
+
+    # ICI plane: tp_overlap_frac rides next to overlap_frac in EVERY report
+    # (0.0 when the TP axis is trivial or the ring is off) so BENCH_*.json
+    # tracks the collective-matmul fields across rounds.  Predicted numbers
+    # from the ring model (ops/collective_matmul.tp_comm_accounting) at the
+    # run's matmul shapes; --trace adds the measured twin (`ici_measured`).
+    tp_size = int(acc.mesh.shape.get("tp", 1))
+    tp_overlap = 0.0
+    if cm_mode != "off" and tp_size > 1:
+        from accelerate_tpu.ops.collective_matmul import tp_comm_accounting
+
+        tp_comm = tp_comm_accounting(
+            batch * seq, cfg.hidden_size, cfg.intermediate_size, tp_size,
+            bidirectional=(cm_mode == "bidir"), peak_flops=peak,
+        )
+        tp_overlap = tp_comm["tp_overlap_frac"]
+        extra_report["tp_comm"] = tp_comm
+    overlap_fields["tp_overlap_frac"] = tp_overlap
+    extra_report["collective_matmul"] = cm_mode
 
     print(json.dumps({
         "metric": "llama_bf16_train_tokens_per_sec_per_chip",

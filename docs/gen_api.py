@@ -73,6 +73,14 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "lion_bf16_sr", "adamw_bf16_sr", "stochastic_round_to_bf16",
         "stochastic_round_to_bf16_hashed",
     ]),
+    "collective_matmul": ("accelerate_tpu.ops.collective_matmul", [
+        "ring_all_gather_matmul", "ring_matmul_reduce_scatter",
+        "all_gather_matmul_monolithic", "matmul_reduce_scatter_monolithic",
+        "make_collective_dense", "dense_collective_matmul",
+        "ulysses_sp_boundary", "ring_supported", "set_collective_matmul",
+        "collective_matmul", "collective_matmul_mode", "normalize_mode",
+        "tp_comm_accounting",
+    ]),
     "profiler": ("accelerate_tpu.utils.profiler", ["TPUProfiler"]),
     "dataclasses": ("accelerate_tpu.utils.dataclasses", [
         "GradSyncKwargs", "ProfileKwargs", "GradientAccumulationPlugin",

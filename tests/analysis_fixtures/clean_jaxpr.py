@@ -50,6 +50,35 @@ def unsharded_output_step(x):
     return jax.lax.with_sharding_constraint(x + 1.0, NamedSharding(mesh, PartitionSpec()))
 
 
+def collective_matmul_hint_step(x, w):
+    """GL106 fixed: the gather-then-matmul pipe rides the ring schedule —
+    ppermute ticks hidden under partial matmuls, no all_gather in the
+    trace (ops/collective_matmul.py)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from accelerate_tpu.ops.collective_matmul import ring_all_gather_matmul
+
+    try:
+        from jax import shard_map as _shard_map
+
+        _no_check = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        _no_check = {"check_rep": False}
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("x",))
+
+    def body(xl, wl):
+        return ring_all_gather_matmul(xl, wl, "x")[0]
+
+    return _shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "x", None), P(None, None)),
+        out_specs=P(None, None), **_no_check,
+    )(x[None], w)
+
+
 def example_args():
     return {
         "wasted_donation_step": (jnp.ones((64, 64)), jnp.ones((64, 64))),
@@ -58,4 +87,5 @@ def example_args():
         "const_capture_step": (jnp.ones((600,)), jnp.asarray(_BIG_TABLE)),
         "transfer_in_trace_step": (jnp.ones((8,)),),
         "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
+        "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
     }

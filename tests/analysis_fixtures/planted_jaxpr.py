@@ -61,6 +61,31 @@ def unsharded_output_step(x):
     return x + 1.0  # x: (1024, 1024) f32
 
 
+def collective_matmul_hint_step(x, w):
+    """GL106 (hint): the gathered activations feed exactly ONE dot_general —
+    the monolithic all-gather→matmul pipe a ring collective-matmul would
+    hide inside the partial matmuls.  Only the trace sees the fan-out."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+
+        _no_check = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        _no_check = {"check_rep": False}
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("x",))
+
+    def body(xl, wl):
+        full = jax.lax.all_gather(xl, "x", axis=0, tiled=True)
+        return jax.lax.dot_general(full, wl, (((1,), (0,)), ((), ())))
+
+    return _shard_map(body, mesh=mesh, in_specs=(P("x", None), P(None, None)),
+                      out_specs=P(None, None), **_no_check)(x, w)
+
+
 def example_args():
     """Concrete example inputs for each planted function (tiny; tracing
     only reads shapes/dtypes)."""
@@ -71,4 +96,5 @@ def example_args():
         "const_capture_step": (jnp.ones((600,)),),
         "transfer_in_trace_step": (jnp.ones((8,)),),
         "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
+        "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
     }

@@ -45,11 +45,13 @@ def _reset_singletons():
     """Singleton hygiene between tests (reference AccelerateTestCase.tearDown
     resets AcceleratorState, testing.py:650-661)."""
     yield
+    from accelerate_tpu.ops.collective_matmul import set_collective_matmul
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
+    set_collective_matmul(None)  # clear any ambient ring-matmul override
 
 
 @pytest.fixture

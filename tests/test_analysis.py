@@ -116,7 +116,7 @@ def test_report_counts_exit_code_and_json():
 def test_every_emitted_rule_is_in_the_catalog():
     # both engines draw severities/hints from rules.RULES; ids must resolve
     for rule_id in ("GL001", "GL002", "GL101", "GL102", "GL103", "GL104",
-                    "GL105", "GL201", "GL202", "GL203", "GL204"):
+                    "GL105", "GL106", "GL201", "GL202", "GL203", "GL204"):
         assert rule_id in RULES
         assert RULES[rule_id].summary and RULES[rule_id].fix_hint
 
@@ -132,6 +132,7 @@ _JAXPR_CASES = [
     ("const_capture_step", "GL102", {}),
     ("transfer_in_trace_step", "GL103", {"default_memory_kind": "device"}),
     ("unsharded_output_step", "GL105", {}),
+    ("collective_matmul_hint_step", "GL106", {}),
 ]
 
 
@@ -182,6 +183,43 @@ def test_jaxpr_suppression_resolves_through_source_info(tmp_path):
     rep = audit_fn(mod.reuse, jax.random.key(0), jnp.ones((4,)))
     assert not rep.unsuppressed(), rep.render()
     assert any(x.rule == "GL104" and x.suppressed for x in rep.findings)
+
+
+def test_gl106_hint_severity_and_suppressible(tmp_path):
+    # GL106 is a *hint*: info severity (never fails a run) and the same
+    # source-anchored marker silences it at the all_gather's line
+    mod = _load_fixture("planted_jaxpr")
+    fname = "collective_matmul_hint_step"
+    rep = audit_fn(getattr(mod, fname), *mod.example_args()[fname])
+    hints = [f for f in rep.findings if f.rule == "GL106"]
+    assert hints and all(f.severity == Severity.INFO for f in hints)
+    assert rep.exit_code() == 0  # info never flips the exit code
+
+    f = tmp_path / "ring_candidate.py"
+    f.write_text(
+        "import jax, numpy as np\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "try:\n"
+        "    from jax import shard_map as sm\n"
+        "    NC = {'check_vma': False}\n"
+        "except ImportError:\n"
+        "    from jax.experimental.shard_map import shard_map as sm\n"
+        "    NC = {'check_rep': False}\n"
+        "def pipe(x, w):\n"
+        "    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ('x',))\n"
+        "    def body(xl, wl):\n"
+        "        # graft-lint: disable=GL106 -- fixture: the monolithic pipe is the point here\n"
+        "        full = jax.lax.all_gather(xl, 'x', axis=0, tiled=True)\n"
+        "        return jax.lax.dot_general(full, wl, (((1,), (0,)), ((), ())))\n"
+        "    return sm(body, mesh=mesh, in_specs=(P('x', None), P(None, None)),\n"
+        "              out_specs=P(None, None), **NC)(x, w)\n"
+    )
+    spec = importlib.util.spec_from_file_location("ring_candidate", f)
+    mod2 = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod2)
+    rep2 = audit_fn(mod2.pipe, jnp.ones((8, 16)), jnp.ones((16, 4)))
+    assert any(x.rule == "GL106" and x.suppressed for x in rep2.findings), rep2.render()
+    assert not rep2.unsuppressed(), rep2.render()
 
 
 def test_audit_jitted_rejects_non_jitted():

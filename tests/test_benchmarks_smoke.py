@@ -80,6 +80,34 @@ def test_bench_streaming_pipeline_smoke():
 
 
 @pytest.mark.slow
+def test_bench_collective_matmul_flag():
+    """CPU-tiny smoke of ``--collective-matmul on|off``: the report ALWAYS
+    carries ``tp_overlap_frac`` next to ``overlap_frac`` (0.0 on this
+    bench's dp-only mesh — the TP axis is trivial) and echoes the ring
+    state in ``extra`` so BENCH_*.json can track A/B runs across rounds."""
+    rep_on = _run(["bench.py", "--iters", "2", "--batch", "8",
+                   "--collective-matmul", "on"])
+    extra = rep_on["extra"]
+    assert extra["collective_matmul"] == "ring"
+    assert extra["tp_overlap_frac"] == 0.0  # dp-only mesh: trivial tp axis
+    assert "overlap_frac" in extra  # rides alongside the streaming fields
+
+    rep_off = _run(["bench.py", "--iters", "2", "--batch", "8",
+                    "--collective-matmul", "off"])
+    assert rep_off["extra"]["collective_matmul"] == "off"
+    assert rep_off["extra"]["tp_overlap_frac"] == 0.0
+
+    # the field is present even when the flag is never passed
+    rep_default = _run(["bench.py", "--iters", "2", "--batch", "8"])
+    assert rep_default["extra"]["tp_overlap_frac"] == 0.0
+    assert rep_default["extra"]["collective_matmul"] == "off"
+
+    # loss parity: the ring cannot change this mesh's numbers (trivial tp
+    # axis -> both runs take the identical XLA path)
+    assert rep_on["extra"]["loss"] == rep_off["extra"]["loss"]
+
+
+@pytest.mark.slow
 def test_bench_plan_audit_hook():
     """``--plan N --audit`` embeds the graft-lint jaxpr-audit summary for
     the selected step: a tiny train step traced through the real

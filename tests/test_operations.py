@@ -183,6 +183,106 @@ def test_all_to_all(mesh8):
     assert out.shape == (8, 8)
 
 
+def test_ring_permute_larger_and_negative_shift(mesh8):
+    from shard_map_compat import shard_map
+
+    x = jnp.arange(8.0)
+
+    def body_shift(shift):
+        def body(x):
+            return collectives.ring_permute(x, "dp_shard", shift=shift)
+
+        return shard_map(body, mesh=mesh8, in_specs=P("dp_shard"), out_specs=P("dp_shard"))
+
+    # shift=3: shard i lands on rank (i+3) % 8
+    np.testing.assert_allclose(np.asarray(body_shift(3)(x)), np.roll(np.arange(8.0), 3))
+    # negative shift rotates the other way around the ring
+    np.testing.assert_allclose(np.asarray(body_shift(-1)(x)), np.roll(np.arange(8.0), -1))
+    # a full revolution is the identity
+    np.testing.assert_allclose(np.asarray(body_shift(8)(x)), np.arange(8.0))
+
+
+def test_all_to_all_values(mesh8):
+    from shard_map_compat import shard_map
+
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def body(x):
+        return collectives.all_to_all(x, "dp_shard", split_axis=1, concat_axis=0)
+
+    f = shard_map(body, mesh=mesh8, in_specs=P("dp_shard", None), out_specs=P(None, "dp_shard"))
+    # the all_to_all transposes the tiling: rank j ends with every rank's
+    # j-th column block — i.e. the global matrix re-tiled column-major,
+    # which for the [8, 8] arange is exactly the transpose-of-blocks
+    out = np.asarray(f(x))
+    want = np.asarray(x).reshape(8, 8)  # block size 1x1: all_to_all == value-level identity here
+    np.testing.assert_allclose(out, want)
+
+
+def test_broadcast_from_nonzero_src(mesh8):
+    from shard_map_compat import NO_CHECK, shard_map
+
+    x = jnp.arange(8.0) * 10.0
+
+    def body(src):
+        def inner(x):
+            return collectives.broadcast_from(x, "dp_shard", src=src)
+
+        return shard_map(inner, mesh=mesh8, in_specs=P("dp_shard"),
+                         out_specs=P("dp_shard"), **NO_CHECK)
+
+    for src in (0, 3, 7):
+        out = np.asarray(body(src)(x))
+        np.testing.assert_allclose(out, np.full(8, src * 10.0))
+
+
+def test_broadcast_from_rejects_out_of_range_src(mesh8):
+    # the old gather-then-index form raised at trace time on a bad src; the
+    # one-hot+psum rewrite must not degrade that into silent zeros
+    from shard_map_compat import NO_CHECK, shard_map
+
+    f = shard_map(
+        lambda x: collectives.broadcast_from(x, "dp_shard", src=8),
+        mesh=mesh8, in_specs=P("dp_shard"), out_specs=P("dp_shard"), **NO_CHECK,
+    )
+    with pytest.raises(ValueError, match="out of range"):
+        f(jnp.arange(8.0))
+
+
+def test_broadcast_from_pins_old_gather_select_behavior(mesh8):
+    """The O(n) one-hot+psum broadcast must be drop-in for the previous
+    all-gather-then-index implementation, including 2-D payloads and bools."""
+    from shard_map_compat import NO_CHECK, shard_map
+    from jax import lax
+
+    def old_broadcast(x, axis_name, src):
+        full = lax.all_gather(x, axis_name, axis=0, tiled=False)
+        return full[src]
+
+    x2d = jnp.arange(32.0).reshape(8, 4) - 7.0
+
+    for src in (0, 5):
+        new = shard_map(
+            lambda x: collectives.broadcast_from(x, "dp_shard", src=src),
+            mesh=mesh8, in_specs=P("dp_shard", None), out_specs=P("dp_shard", None),
+            **NO_CHECK,
+        )(x2d)
+        old = shard_map(
+            lambda x: old_broadcast(x, "dp_shard", src),
+            mesh=mesh8, in_specs=P("dp_shard", None), out_specs=P("dp_shard", None),
+            **NO_CHECK,
+        )(x2d)
+        np.testing.assert_array_equal(np.asarray(new), np.asarray(old))
+
+    flags = jnp.asarray([True, False] * 4)
+    got = shard_map(
+        lambda x: collectives.broadcast_from(x, "dp_shard", src=2),
+        mesh=mesh8, in_specs=P("dp_shard"), out_specs=P("dp_shard"), **NO_CHECK,
+    )(flags)
+    assert got.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(got), np.full(8, True))
+
+
 def test_host_local_to_global(mesh8):
     batch = {"x": np.arange(16.0).reshape(8, 2)}
     out = ops.host_local_to_global(batch, mesh8, P("dp_shard", None))
