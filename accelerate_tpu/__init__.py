@@ -26,6 +26,7 @@ from .utils.dataclasses import (
     MixedPrecisionType,
     ProfileKwargs,
     ProjectConfiguration,
+    ResiliencePlugin,
     SequenceParallelConfig,
     ShardingStrategy,
     TensorParallelConfig,

@@ -31,6 +31,7 @@ from __future__ import annotations
 import contextlib
 import inspect
 import math
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Optional, Sequence, Union
@@ -56,6 +57,9 @@ from .parallel.sharding import (
     shard_params,
 )
 from .parallelism_config import ParallelismConfig
+from .resilience import faults as _faults
+from .resilience import guard as _guard
+from .resilience.goodput import GoodputTracker
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
 from .utils.dataclasses import (
@@ -70,6 +74,7 @@ from .utils.dataclasses import (
     MixedPrecisionType,
     ProfileKwargs,
     ProjectConfiguration,
+    ResiliencePlugin,
     SequenceParallelConfig,
     TensorParallelConfig,
 )
@@ -106,6 +111,10 @@ if _HAS_FLAX:
         # gradient-compression carry (PowerSGD warm-start Qs + per-rank
         # error buffers); None unless GradSyncKwargs.compression is set
         comm_state: Any = None
+        # NaN-guard skip counters ({nan_skips, consecutive_nan_skips} int32
+        # scalars, resilience/guard.py) — carried in the state so they
+        # survive checkpoint/resume; None unless ResiliencePlugin.nan_guard
+        guard_state: Any = None
         apply_fn: Callable = flax.struct.field(pytree_node=False, default=None)
         tx: Any = flax.struct.field(pytree_node=False, default=None)
         # .replace(**kwargs) is provided by flax.struct.dataclass
@@ -247,6 +256,7 @@ class Accelerator:
         cp_config: Optional[ContextParallelConfig] = None,
         sp_config: Optional[SequenceParallelConfig] = None,
         gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
+        resilience_plugin: Optional[ResiliencePlugin] = None,
         rng_types: Optional[list] = None,
         log_with: Optional[Union[str, list]] = None,
         project_dir: Optional[str] = None,
@@ -340,6 +350,21 @@ class Accelerator:
 
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
+
+        # resilience layer (docs/resilience.md): knobs default from the
+        # ACCELERATE_RESILIENCE env family; the goodput tracker always exists
+        # (bench.py reads it unconditionally — zeros when the run is clean)
+        self.resilience_plugin = resilience_plugin or ResiliencePlugin()
+        self.goodput = GoodputTracker()
+        self._preemption = None
+        if self.resilience_plugin.handle_preemption:
+            self.install_preemption_handler()
+        if _faults.active_fault_plan() is None:
+            # subprocess fault-matrix runs ship their plan as JSON in
+            # ACCELERATE_FAULT_PLAN (deterministic; no-op when unset)
+            env_plan = _faults.FaultPlan.from_env()
+            if env_plan is not None:
+                _faults.install_fault_plan(env_plan)
 
     # ------------------------------------------------------------------
     # Introspection / process control (delegation, reference :234-278)
@@ -587,6 +612,8 @@ class Accelerator:
             batch_spec=self._default_batch_spec(),
             parallelism_config=self.parallelism_config,
             prefetch_size=dlc.prefetch_size,
+            transfer_retry_policy=self._transfer_retry_policy(),
+            on_transfer_retry=self.goodput.record_retry,
         )
         self._dataloaders.append(prepared)
         return prepared
@@ -639,6 +666,14 @@ class Accelerator:
             return x
 
         return jax.tree_util.tree_map(_leaf, params)
+
+    def _transfer_retry_policy(self):
+        """The ResiliencePlugin's bounded-retry budget as a RetryPolicy (the
+        dataloaders' H2D staging shares it with checkpoint I/O)."""
+        from .resilience.retry import RetryPolicy
+
+        rp = self.resilience_plugin
+        return RetryPolicy(retries=rp.io_retries, backoff_s=rp.io_backoff_s)
 
     def _offload_flags(self) -> tuple[bool, bool]:
         """(offload optimizer state, offload master params) — the ZeRO-offload
@@ -740,6 +775,9 @@ class Accelerator:
             grad_accum=grad_accum,
             accum_step=jnp.int32(0) if accum_needed else None,
             comm_state=comm_state,
+            guard_state=(
+                _guard.init_guard_state() if self.resilience_plugin.nan_guard else None
+            ),
             apply_fn=apply_fn,
             tx=tx,
         )
@@ -792,6 +830,23 @@ class Accelerator:
         policy = self.policy
         comm_dtype = {"bf16": jnp.bfloat16, "fp16": jnp.float16, None: None}[self.grad_sync_kwargs.comm_dtype]
         offload_opt, offload_params = self._offload_flags()
+        # NaN/Inf step guard (resilience/guard.py): a where-select skip-step
+        # gated on isfinite(loss) & isfinite(global grad-norm) — the same
+        # skipped-step mechanism the fp16 loss-scale overflow path uses, so
+        # it composes with every offload/chunk branch below.  Counters ride
+        # TrainState.guard_state; the Python wrapper enforces the
+        # consecutive-skip abort.
+        nan_guard = bool(self.resilience_plugin.nan_guard)
+        guard_abort_after = (
+            self.resilience_plugin.max_consecutive_nan_skips if nan_guard else 0
+        )
+        if nan_guard and mode == "across_steps" and accum_steps > 1:
+            logger.warning(
+                "nan_guard with gradient accumulation mode='across_steps' "
+                "only protects the boundary update: a non-finite microbatch "
+                "still pollutes the carried accumulator before the guard "
+                "sees it. Use mode='in_step' (the default) for full coverage."
+            )
         # memory-kind placement works on TPU; on the CPU test mesh the
         # storage stays in device memory but the host-compute update region
         # is still exercised, so numerics are pinned by the CPU suite.
@@ -936,6 +991,13 @@ class Accelerator:
             else:
                 finite = jnp.bool_(True)
                 new_scale = None
+            # the skip-step select engages for fp16 overflow handling OR the
+            # NaN guard; under the guard the finiteness predicate also folds
+            # in the loss (and, below, the global grad-norm — one NaN/Inf
+            # anywhere in the grad tree makes the norm non-finite)
+            use_skip = (loss_scale is not None) or nan_guard
+            if nan_guard:
+                finite = jnp.logical_and(finite, jnp.isfinite(loss))
 
             # Under real host offload with clipping, the norm + clip move
             # into the host region: a device-side clip keeps every gradient
@@ -947,6 +1009,8 @@ class Accelerator:
             gnorm_on_host = offload_opt and kinds_ok and max_grad_norm is not None
             if not gnorm_on_host:
                 gnorm = global_norm(grads)
+                if nan_guard:
+                    finite = jnp.logical_and(finite, jnp.isfinite(gnorm))
                 if max_grad_norm is not None:
                     clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                     # clip in each grad's own width: a fp32 scalar would
@@ -957,15 +1021,11 @@ class Accelerator:
             def run_update(grads, opt_state, params, finite):
                 updates, new_opt = state.tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
-                if loss_scale is not None:
-                    # overflow: hold params/opt_state (reference skipped-step)
-                    new_params = jax.tree_util.tree_map(
-                        lambda n, o: jnp.where(finite, n, o), new_params, params
-                    )
-                    new_opt = jax.tree_util.tree_map(
-                        lambda n, o: jnp.where(finite, n, o) if hasattr(n, "shape") and n.shape == getattr(o, "shape", None) else n,
-                        new_opt, opt_state,
-                    )
+                if use_skip:
+                    # fp16 overflow / NaN-guard skip: hold params/opt_state
+                    # bitwise (reference skipped-step; resilience/guard.py)
+                    new_params = _guard.select_tree(finite, new_params, params)
+                    new_opt = _guard.select_tree(finite, new_opt, opt_state)
                 return new_params, new_opt
 
             if offload_opt:
@@ -991,7 +1051,8 @@ class Accelerator:
                         grads_in = jax.tree_util.tree_map(jax.device_put, grads, ghost)
                     if not offload_params:
                         params_master = jax.tree_util.tree_map(jax.device_put, state.params, ghost)
-                    if loss_scale is not None:
+                    if use_skip:
+                        # graft-lint: disable=GL103 -- the skip predicate must live in host space: every operand of the host-compute update region shares one memory space
                         finite_in = jax.device_put(
                             finite, NamedSharding(self.mesh, PartitionSpec(), memory_kind="pinned_host")
                         )
@@ -1009,6 +1070,10 @@ class Accelerator:
                         with compute_on("device_host"):
                             gnorm = global_norm(grads_in)
                             clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                            if nan_guard:
+                                finite_in = jnp.logical_and(
+                                    finite_in, jnp.isfinite(gnorm)
+                                )
                     group_outs = []
                     token = None
                     # Probe the FULL tree once: per-group const presence can
@@ -1125,6 +1190,10 @@ class Accelerator:
                             )
                         if gnorm_on_host:
                             gnorm = global_norm(grads_in)
+                            if nan_guard:
+                                finite_in = jnp.logical_and(
+                                    finite_in, jnp.isfinite(gnorm)
+                                )
                             if max_grad_norm is not None:
                                 clip = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                                 grads_in = jax.tree_util.tree_map(lambda g: g * clip, grads_in)
@@ -1150,11 +1219,26 @@ class Accelerator:
             if loss_scale is not None:
                 metrics["grads_finite"] = finite
                 metrics["loss_scale"] = new_scale.scale
+            new_guard_state = state.guard_state
+            if nan_guard:
+                if gnorm_on_host:
+                    # fold the norm's finiteness into the device-side metric
+                    # predicate too (the host-side finite_in already carried
+                    # it into the update) — gnorm is back in device space here
+                    finite = jnp.logical_and(finite, jnp.isfinite(gnorm))
+                if state.guard_state is not None:
+                    new_guard_state = _guard.update_guard_counters(
+                        state.guard_state, finite
+                    )
+                    metrics = _guard.guard_metrics(metrics, finite, new_guard_state)
+                else:
+                    metrics["nan_skipped"] = jnp.logical_not(finite)
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,
                 opt_state=new_opt,
                 loss_scale=new_scale,
+                guard_state=new_guard_state,
             )
             return new_state, metrics
 
@@ -1394,12 +1478,46 @@ class Accelerator:
                 # donated buffers are untouched), findings go through
                 # logging.py + any active trackers
                 wrapped._lint_report = self.audit_step(wrapped, state, batch)
+            # fault-injection hook (resilience/faults.py): a no-op None check
+            # unless a deterministic plan is installed
+            for ev in _faults.fault_point("step"):
+                if ev.kind == "preempt":
+                    # a REAL signal through the installed handler — the same
+                    # delivery path a cloud preemption notice takes
+                    import signal as _signal
+
+                    handler = self.install_preemption_handler()
+                    os.kill(os.getpid(), handler.signals[0] if handler.signals
+                            else _signal.SIGTERM)
+                elif ev.kind == "nan_grad":
+                    batch = _faults.poison_batch(batch)
             if not getattr(self, "_in_accumulate", False):
                 self.step_count += 1
+                # goodput counts in step_count units (the accumulate()
+                # context owns both when it wraps the call) so replay/skip
+                # accounting subtracts like units from like
+                self.goodput.record_step()
                 self.gradient_state._set_sync_gradients(
                     mode != "across_steps" or (self.step_count % accum_steps == 0)
                 )
-            return jitted(state, batch)
+            new_state, metrics = jitted(state, batch)
+            if nan_guard and isinstance(metrics, dict) \
+                    and "consecutive_nan_skips" in metrics:
+                # one scalar host fetch per armed step: it keeps the goodput
+                # counters (and bench's always-emitted nan_skips) truthful
+                # even with the abort disabled, and training loops fetch the
+                # loss scalar anyway so this rarely adds a real sync.  The
+                # zero-sync option is disabling the guard, not the abort.
+                consecutive = int(metrics["consecutive_nan_skips"])
+                if bool(metrics["nan_skipped"]):
+                    self.goodput.record_nan_skip()
+                _guard.check_abort(consecutive, guard_abort_after)
+            if self._preemption is not None and self._preemption.requested:
+                # stop AT the step boundary: the post-step state is exactly
+                # consistent with the dataloader position and step counters,
+                # so the resumed run replays nothing and skips nothing
+                self._preemption_exit(new_state)
+            return new_state, metrics
 
         wrapped._jitted = jitted
         wrapped._lint_report = None
@@ -1483,6 +1601,7 @@ class Accelerator:
         ``with accelerator.accumulate(): step(...)``), the context owns the
         increment and the step skips its own bookkeeping."""
         self.step_count += 1
+        self.goodput.record_step()
         end = self.gradient_state.end_of_dataloader and self.gradient_state.plugin.sync_with_dataloader
         sync = (
             self.gradient_state.plugin.mode == "in_step"
@@ -1744,6 +1863,77 @@ class Accelerator:
         from .checkpointing import wait_for_pending_checkpoint
 
         wait_for_pending_checkpoint(self)
+
+    # -- preemption / auto-resume (resilience/, docs/resilience.md) --------
+
+    def install_preemption_handler(self, signals=None):
+        """Arm graceful-stop handling: the listed signals (default the
+        plugin's, i.e. ``SIGTERM``) set a flag, and the prepared train step
+        exits at the next step boundary through :meth:`_preemption_exit`
+        (emergency checkpoint + ``SystemExit(75)``).  Idempotent."""
+        if self._preemption is None:
+            from .resilience.preemption import PreemptionHandler
+
+            self._preemption = PreemptionHandler(
+                signals or self.resilience_plugin.preemption_signals
+            ).install()
+        return self._preemption
+
+    @property
+    def preemption_requested(self) -> bool:
+        return self._preemption is not None and self._preemption.requested
+
+    def _preemption_exit(self, train_state=None):
+        """The graceful-stop tail: drain the in-flight async save, write an
+        emergency checkpoint of the boundary state through the verified
+        atomic path, and exit with the distinct resume exit code so the
+        supervisor re-queues rather than fails the job."""
+        rp = self.resilience_plugin
+        logger.warning(
+            "preemption requested: stopping at step boundary (step_count=%d)",
+            self.step_count,
+        )
+        try:
+            self.wait_for_checkpoint()
+            if rp.emergency_checkpoint and train_state is not None:
+                try:
+                    ckpt = self.save_state(train_state=train_state)
+                    logger.warning("emergency checkpoint written to %s", ckpt)
+                except ValueError as e:
+                    # no project_dir/output_dir configured: nothing to save
+                    # into — exit promptly inside the grace window anyway
+                    logger.warning("no emergency checkpoint written: %s", e)
+        except Exception as e:
+            # the exit code must stay 75 even when the drain or the emergency
+            # save fails (I/O budget exhausted, poisoned async write): a
+            # crash code here would make the supervisor fail a job that has
+            # older valid checkpoints to resume from
+            logger.error(
+                "emergency checkpoint failed (%s: %s); exiting with the "
+                "resume code anyway — resume will fall back to the newest "
+                "valid periodic checkpoint", type(e).__name__, e,
+            )
+        finally:
+            self.goodput.record_preemption()
+        raise SystemExit(rp.resume_exit_code)
+
+    def maybe_resume(self, train_state=None, **load_kwargs):
+        """Auto-resume: restore the newest *valid* checkpoint under the
+        project dir, or return ``None`` when none exists (fresh start).
+        Restores RNG streams, dataloader positions, step counters — and the
+        TrainState when a ``train_state`` template is given (returned
+        restored).  Counts the restart in :attr:`goodput`."""
+        from .checkpointing import list_checkpoints
+
+        if not list_checkpoints(self.project_dir or "."):
+            return None
+        restored = self.load_state(None, train_state=train_state, **load_kwargs)
+        self.goodput.record_restart()
+        logger.warning(
+            "resumed from checkpoint at step_count=%d (restart #%d)",
+            self.step_count, self.goodput.restarts,
+        )
+        return restored
 
     def save_model(self, train_state_or_params, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
         from .checkpointing import save_model

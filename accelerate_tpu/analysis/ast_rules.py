@@ -371,11 +371,142 @@ def _rule_impure_in_jit(index: _ModuleIndex, path: str) -> list[Finding]:
     return findings
 
 
+# GL205(a): write-call shapes whose path operand we inspect for live
+# checkpoint-directory literals
+_WRITE_METHODS = frozenset({"write_text", "write_bytes"})
+_WRITE_FUNCS = frozenset({"pickle.dump", "json.dump", "numpy.save", "numpy.savez"})
+_ATOMIC_PUBLISH_CALLS = frozenset({
+    "os.replace", "os.rename", "shutil.move",
+})
+_CKPT_PATH_SCOPE = ("resilience", "checkpoint")  # GL205(b) file-path scope
+
+
+def _string_constants(node) -> Iterable[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+def _rule_checkpoint_atomicity(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL205: non-atomic checkpoint writes + swallowed exceptions on the
+    save/restore spine.
+
+    (a) A write call — ``open(p, "w"/"wb"/"a"...)``, ``*.write_text``/
+    ``write_bytes``, ``pickle.dump``/``json.dump``/``np.save*`` — whose
+    *path expression* names a live checkpoint directory (a string literal
+    containing ``checkpoint_`` without ``.tmp``, directly or through a
+    one-hop local assignment) is flagged unless the enclosing function also
+    performs an atomic publish (``os.replace``/``os.rename``/
+    ``shutil.move``).  The write-into-tmp-then-replace idiom
+    (``checkpointing._finalize_checkpoint``) passes both ways.
+
+    (b) ``except``/``except Exception``/``except BaseException`` whose body
+    is exactly ``pass``, in modules whose path mentions resilience or
+    checkpoint: on this spine a swallowed failure *is* data loss.
+    """
+    findings: list[Finding] = []
+
+    # -- (a) non-atomic writes into live checkpoint paths -------------------
+    def has_live_ckpt_literal(expr, scope) -> bool:
+        def live(s: str) -> bool:
+            return "checkpoint_" in s and ".tmp" not in s
+
+        if any(live(s) for s in _string_constants(expr)):
+            return True
+        # one-hop resolution: `d = f".../checkpoint_{i}"; open(d / "x", "wb")`
+        names = {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+        if not names:
+            return False
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id in names \
+                    and any(live(s) for s in _string_constants(node.value)):
+                return True
+        return False
+
+    def publishes_atomically(scope) -> bool:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call):
+                canon = index.canonical(node.func)
+                if canon in _ATOMIC_PUBLISH_CALLS:
+                    return True
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("replace", "rename")
+                    and not isinstance(node.func.value, ast.Constant)
+                    and len(node.args) == 1
+                    and not node.keywords
+                ):
+                    # Path.replace(target) / Path.rename(target): exactly one
+                    # positional argument — which also keeps the 2-argument
+                    # str.replace(old, new) path-mangling idiom from reading
+                    # as an atomic publish
+                    return True
+        return False
+
+    for node in ast.walk(index.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        path_expr = None
+        canon = index.canonical(node.func)
+        if canon == "open" and node.args:
+            mode = ""
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+                mode = str(node.args[1].value)
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = str(kw.value.value)
+            if any(m in mode for m in ("w", "a", "x", "+")):
+                path_expr = node.args[0]
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in _WRITE_METHODS:
+            path_expr = node.func.value
+        elif canon in _WRITE_FUNCS and len(node.args) >= 2:
+            path_expr = node.args[1] if canon in ("pickle.dump", "json.dump") else node.args[0]
+        if path_expr is None:
+            continue
+        scope = index.enclosing_function(node) or index.tree
+        if has_live_ckpt_literal(path_expr, scope) and not publishes_atomically(scope):
+            findings.append(
+                _finding(
+                    "GL205",
+                    "write into a live `checkpoint_*` path with no atomic "
+                    "publish (os.replace) in scope — a crash mid-write "
+                    "leaves a directory that looks like a checkpoint",
+                    path, node.lineno,
+                )
+            )
+
+    # -- (b) swallowed exceptions on the resilience/checkpoint spine --------
+    posix = path.replace("\\", "/").lower()
+    if any(tok in posix for tok in _CKPT_PATH_SCOPE):
+        for node in ast.walk(index.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or _dotted(node.type) in (
+                "Exception", "BaseException",
+            )
+            body_is_pass = len(node.body) == 1 and isinstance(node.body[0], ast.Pass)
+            if broad and body_is_pass:
+                findings.append(
+                    _finding(
+                        "GL205",
+                        "bare `except"
+                        + (f" {_dotted(node.type)}" if node.type is not None else "")
+                        + ": pass` on the checkpoint/resilience spine — a "
+                        "swallowed save/restore failure reads as success",
+                        path, node.lineno,
+                    )
+                )
+    return findings
+
+
 _ALL_RULES = (
     _rule_donated_reuse,
     _rule_host_sync,
     _rule_shard_map_compat,
     _rule_impure_in_jit,
+    _rule_checkpoint_atomicity,
 )
 
 

@@ -138,6 +138,20 @@ RULES: dict[str, Rule] = {
             "thread timestamps/randomness in as arguments (jax.random for "
             "in-trace randomness)",
         ),
+        Rule(
+            "GL205", "non-atomic-checkpoint", Severity.ERROR, "ast",
+            "a checkpoint-durability hazard: (a) a write into a live "
+            "`checkpoint_*` path with no tmp-stage + os.replace in scope — "
+            "a crash mid-write leaves a directory that LOOKS like a "
+            "checkpoint and resumes garbage; or (b) a bare "
+            "`except Exception: pass` in resilience/checkpoint code — a "
+            "swallowed save/restore failure is indistinguishable from "
+            "success until the restore that needed it",
+            "stage every file under `<dir>.tmp` and publish with one "
+            "os.replace (checkpointing._finalize_checkpoint is the model); "
+            "never silently swallow exceptions on the save/restore spine — "
+            "log, re-raise, or route through resilience.retry.with_retries",
+        ),
     ]
 }
 

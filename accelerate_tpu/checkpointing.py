@@ -15,6 +15,19 @@ the reference captures alongside the weights:
 - automatic ``checkpoints/checkpoint_<i>`` naming with ``total_limit``
   retention GC (reference accelerator.py:3587-3613).
 
+Resilience layer (CheckFreq discipline, see docs/resilience.md): every
+checkpoint is **verified and atomic** — all files stage under
+``checkpoint_<i>.tmp``, a manifest of per-file sizes + crc32 checksums is
+written last, and a single ``os.replace`` publishes the directory, so a
+crash mid-save can never leave a directory that *looks* like a checkpoint.
+``load_accelerator_state`` verifies the manifest on load and, on the
+auto-resume path, falls back to the newest checkpoint that verifies;
+retention GC refuses to delete the only checkpoint a fallback scan could
+still select.  Checkpoint I/O runs under bounded retry/backoff
+(``resilience/retry.py``), and the deterministic fault harness
+(``resilience/faults.py``) injects transient failures and post-publish
+corruption through the same code paths the production flow uses.
+
 ``save_model`` gathers (possibly sharded) params and writes safetensors with
 a shard index (reference save_model accelerator.py:3406), and
 ``merge_weights`` converts a sharded Orbax checkpoint into consolidated
@@ -31,18 +44,24 @@ import pickle
 import random
 import re
 import shutil
+import zlib
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from .logging import get_logger
+from .resilience.faults import fault_point, maybe_fail_transfer
+from .resilience.retry import DEFAULT_POLICY, RetryPolicy, with_retries
 from .utils.imports import is_torch_available
 
 # re-exported here for compatibility; the registry is utils/constants.py
 from .utils.constants import (  # noqa: F401
     CHECKPOINT_DIR_PATTERN,
     CHECKPOINT_DIR_PREFIX,
+    CHECKPOINT_MANIFEST_NAME,
+    CHECKPOINT_TMP_SUFFIX,
     CUSTOM_STATES_NAME,
     METADATA_NAME,
     MODEL_NAME,
@@ -54,6 +73,41 @@ from .utils.constants import (  # noqa: F401
     SCHEDULER_STATES_NAME,
     TRAIN_STATE_DIR,
 )
+
+logger = get_logger(__name__)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly-requested checkpoint failed verification (or no valid
+    checkpoint survived the auto-resume fallback scan)."""
+
+
+def _resilience_knobs(accelerator) -> tuple[bool, RetryPolicy]:
+    """(verify/manifest enabled, I/O retry policy) from the accelerator's
+    ResiliencePlugin; library-default resilience when absent (offline tools
+    pass ``accelerator=None``)."""
+    rp = getattr(accelerator, "resilience_plugin", None)
+    if rp is None:
+        return True, DEFAULT_POLICY
+    policy = RetryPolicy(retries=rp.io_retries, backoff_s=rp.io_backoff_s)
+    return bool(rp.verify_checkpoints), policy
+
+
+def _io_retry(accelerator, fn, site: str, policy: Optional[RetryPolicy] = None):
+    """Checkpoint-I/O retry wrapper: the injected-fault hook fires inside
+    each attempt, and retries feed the accelerator's goodput counters."""
+    goodput = getattr(accelerator, "goodput", None)
+
+    def attempt():
+        maybe_fail_transfer("checkpoint_io")
+        return fn()
+
+    return with_retries(
+        attempt,
+        policy=policy if policy is not None else _resilience_knobs(accelerator)[1],
+        site=site,
+        on_retry=goodput.record_retry if goodput is not None else None,
+    )
 
 
 def _ocp():
@@ -85,6 +139,146 @@ def _sharded_copy_fn(sharding):
 
 
 # ---------------------------------------------------------------------------
+# verified atomic checkpoints: manifest + tmp-stage + one os.replace
+# ---------------------------------------------------------------------------
+
+
+def _file_crc32(path: Path) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_checkpoint_manifest(ckpt_dir) -> str:
+    """Record every file's size + crc32 under ``ckpt_dir`` in
+    ``checkpoint_manifest.json``.
+
+    Written LAST (after all payload files, before the atomic publish), so a
+    manifest's presence asserts that every listed byte reached the staging
+    directory before the checkpoint became visible."""
+    root = Path(ckpt_dir)
+    files: dict[str, dict] = {}
+    for p in sorted(root.rglob("*")):
+        if not p.is_file() or p.name == CHECKPOINT_MANIFEST_NAME:
+            continue
+        files[p.relative_to(root).as_posix()] = {
+            "size": p.stat().st_size,
+            "crc32": f"{_file_crc32(p):08x}",
+        }
+    payload = {"version": 1, "files": files}
+    out = root / CHECKPOINT_MANIFEST_NAME
+    out.write_text(json.dumps(payload, indent=1))
+    return str(out)
+
+
+def verify_checkpoint(ckpt_dir) -> tuple[bool, list[str]]:
+    """``(ok, problems)`` for one checkpoint directory.
+
+    Every manifest entry is checked for existence, size, and crc32 — the
+    truncated-shard and bit-flipped-shard cases both land in ``problems``.
+    A directory without a manifest (written before the resilience layer, or
+    with ``ResiliencePlugin.verify_checkpoints=False``) passes as
+    valid-but-unverified with a note; a ``*.tmp`` staging directory or a
+    missing path is invalid outright."""
+    root = Path(ckpt_dir)
+    if not root.is_dir():
+        return False, ["missing directory"]
+    if root.name.endswith(CHECKPOINT_TMP_SUFFIX):
+        return False, ["unpublished .tmp staging directory (torn write)"]
+    manifest = root / CHECKPOINT_MANIFEST_NAME
+    if not manifest.exists():
+        return True, ["no manifest (unverified pre-resilience checkpoint)"]
+    try:
+        payload = json.loads(manifest.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        return False, [f"unreadable manifest: {e}"]
+    problems = []
+    for rel, meta in payload.get("files", {}).items():
+        p = root / rel
+        if not p.is_file():
+            problems.append(f"missing file {rel}")
+            continue
+        size = p.stat().st_size
+        if size != meta.get("size"):
+            problems.append(f"size mismatch {rel}: {size} != {meta.get('size')}")
+            continue
+        if f"{_file_crc32(p):08x}" != meta.get("crc32"):
+            problems.append(f"checksum mismatch {rel}")
+    return (not problems), problems
+
+
+# per-directory stat snapshot taken at finalize (and refreshed after a full
+# verify): the retention-GC validity scan compares stats (sizes + mtimes,
+# no byte reads) and only falls back to a full crc32 verify_checkpoint when
+# a file changed under it — so the common save loop never re-reads the
+# checkpoints it just wrote (at 7B that would be tens of GB per save)
+_FINALIZED_SNAPSHOTS: dict = {}
+
+
+def _file_stats(root: Path) -> dict:
+    return {
+        p.relative_to(root).as_posix(): (p.stat().st_size, p.stat().st_mtime_ns)
+        for p in sorted(root.rglob("*"))
+        if p.is_file()
+    }
+
+
+def _presumed_valid_for_gc(ckpt_dir: Path) -> bool:
+    """GC's validity oracle: stat-compare against the finalize-time snapshot
+    first; any drift (or no snapshot — e.g. a dir written by a previous
+    process) falls back to the full manifest verify, whose positive result
+    is then snapshotted for the next GC round."""
+    key = str(ckpt_dir)
+    snap = _FINALIZED_SNAPSHOTS.get(key)
+    if snap is not None:
+        try:
+            if _file_stats(ckpt_dir) == snap:
+                return True
+        except OSError:
+            pass
+    ok = verify_checkpoint(ckpt_dir)[0]
+    if ok:
+        try:
+            _FINALIZED_SNAPSHOTS[key] = _file_stats(ckpt_dir)
+        except OSError:  # pragma: no cover - raced deletion
+            _FINALIZED_SNAPSHOTS.pop(key, None)
+    else:
+        _FINALIZED_SNAPSHOTS.pop(key, None)
+    return ok
+
+
+def _finalize_checkpoint(tmp_dir, final_dir, manifest: bool = True) -> None:
+    """Publish a staged checkpoint: manifest over the complete tmp contents,
+    then one atomic ``os.replace`` — a reader can never observe a partial
+    ``checkpoint_<i>``.  An existing target (explicit ``output_dir`` reuse)
+    is removed first; the staged copy is already complete at that point, so
+    the worst crash window leaves the ``.tmp`` (ignored by scans) rather
+    than a half-written published directory."""
+    tmp, final = Path(tmp_dir), Path(final_dir)
+    if manifest:
+        write_checkpoint_manifest(tmp)
+    if final.exists():
+        shutil.rmtree(final)
+    os.replace(tmp, final)
+    # snapshot BEFORE the fault hook: injected post-publish corruption must
+    # read as drift to the GC oracle, exactly like real bit rot would
+    _FINALIZED_SNAPSHOTS[str(final)] = _file_stats(final)
+    # fault hook: simulate post-publish corruption (bit rot / torn shard) —
+    # exactly what verify-on-load + fallback must absorb
+    for ev in fault_point("post_save"):
+        if ev.kind == "corrupt_ckpt":
+            from .resilience.faults import active_fault_plan, corrupt_checkpoint
+
+            plan = active_fault_plan()
+            corrupt_checkpoint(final, mode=ev.mode, seed=plan.seed if plan else 0)
+
+
+# ---------------------------------------------------------------------------
 # async-save lifecycle
 # ---------------------------------------------------------------------------
 
@@ -92,7 +286,18 @@ def _sharded_copy_fn(sharding):
 # Strong refs on purpose: a garbage-collected Accelerator must not orphan an
 # in-flight write (the checkpoint would be truncated at interpreter teardown).
 _LIVE_ASYNC_CKPTRS: set = set()
+# ckptr -> (tmp_dir, final_dir, manifest): the atomic publish deferred until
+# that checkpointer's in-flight write commits (wait_for_pending_checkpoint,
+# or the interpreter-exit flush below — either way the rename happens after
+# the last byte, so async saves keep the torn-write-free contract)
+_PENDING_FINALIZES: dict = {}
 _atexit_registered = False
+
+
+def _run_pending_finalize(ckptr) -> None:
+    fin = _PENDING_FINALIZES.pop(ckptr, None)
+    if fin is not None:
+        _finalize_checkpoint(*fin)
 
 
 def _flush_live_checkpointers_at_exit() -> None:
@@ -100,9 +305,11 @@ def _flush_live_checkpointers_at_exit() -> None:
         ckptr = _LIVE_ASYNC_CKPTRS.pop()
         try:
             ckptr.wait_until_finished()
+            _run_pending_finalize(ckptr)
         except Exception:  # one failed write must not orphan the others
             import traceback
 
+            _PENDING_FINALIZES.pop(ckptr, None)  # leave the .tmp for post-mortem
             traceback.print_exc()
         finally:
             ckptr.close()
@@ -161,9 +368,20 @@ def wait_for_pending_checkpoint(accelerator) -> None:
         ckptr.wait_until_finished()
     except BaseException:
         # a failed write poisons the checkpointer: release its threads and
-        # drop it from the reuse cache rather than leaking them per retry
+        # drop it from the reuse cache rather than leaking them per retry.
+        # The .tmp staging dir stays on disk for post-mortem — scans ignore
+        # it and the next save sweeps it.
+        _PENDING_FINALIZES.pop(ckptr, None)
         _release_async_checkpointer(accelerator, ckptr)
         raise
+    # the write committed: publish atomically (manifest + os.replace).
+    # Single-writer by construction — saves serialize through this very
+    # barrier — so the main process performing the rename is safe; other
+    # ranks only ever read the published name after their own barrier.
+    if accelerator is None or accelerator.is_main_process:
+        _run_pending_finalize(ckptr)
+    else:
+        _PENDING_FINALIZES.pop(ckptr, None)
 
 
 def close_async_checkpointer(accelerator) -> None:
@@ -190,15 +408,56 @@ def _auto_checkpoint_dir(accelerator, output_dir: Optional[str]):
     if not pc.automatic_checkpoint_naming:
         return base
     base.mkdir(parents=True, exist_ok=True)
+    if accelerator.is_main_process:
+        # sweep dead staging dirs: the caller drained this process's pending
+        # write before reaching here, so any surviving *.tmp is a torn write
+        # from a crashed run — never a checkpoint, never load-visible
+        for stale_tmp in base.glob(f"{CHECKPOINT_DIR_PREFIX}_*{CHECKPOINT_TMP_SUFFIX}"):
+            if stale_tmp.is_dir():
+                shutil.rmtree(stale_tmp, ignore_errors=True)
     # retention GC
     existing = sorted(
         (p for p in base.iterdir() if re.fullmatch(CHECKPOINT_DIR_PATTERN, p.name)),
         key=lambda p: int(p.name.split("_")[1]),
     )
-    if pc.total_limit is not None and len(existing) + 1 > pc.total_limit:
-        for stale in existing[: len(existing) + 1 - pc.total_limit]:
-            if accelerator.is_main_process:
-                shutil.rmtree(stale, ignore_errors=True)
+    if (
+        pc.total_limit is not None
+        and len(existing) + 1 > pc.total_limit
+        and accelerator.is_main_process
+    ):
+        # main-process only end to end: rmtree always was, and the validity
+        # scan would make every non-main rank (which never gets the
+        # finalize-time stat snapshots) crc32-read the newest checkpoint on
+        # every save for a decision it doesn't act on
+        doomed = existing[: len(existing) + 1 - pc.total_limit]
+        survivors = existing[len(existing) + 1 - pc.total_limit:]
+        # GC must never delete a checkpoint a fallback load_state scan could
+        # still select: if NO survivor verifies (e.g. the newest checkpoint
+        # is the corrupt one), the newest valid doomed directory IS the
+        # fallback candidate — spare it this round (it falls out of the
+        # window naturally once a newer valid checkpoint exists).
+        spare = None
+        if not any(_presumed_valid_for_gc(s) for s in reversed(survivors)):
+            for d in reversed(doomed):
+                if _presumed_valid_for_gc(d):
+                    spare = d
+                    break
+        for stale in doomed:
+            if stale == spare:
+                logger.warning(
+                    "retention GC sparing %s: it is the newest checkpoint "
+                    "that verifies (every newer one is corrupt or partial)",
+                    stale,
+                )
+                continue
+            shutil.rmtree(stale, ignore_errors=True)
+    if existing:
+        # a resumed process starts with a fresh ProjectConfiguration
+        # (iteration=0) but inherits the checkpoint directory: numbering must
+        # continue past what's on disk, or the post-resume saves would
+        # overwrite older indices and break the "newest = highest index"
+        # ordering every fallback/resume scan relies on
+        pc.iteration = max(pc.iteration, int(existing[-1].name.split("_")[1]) + 1)
     out = base / f"{CHECKPOINT_DIR_PREFIX}_{pc.iteration}"
     pc.iteration += 1
     return out
@@ -271,11 +530,20 @@ def save_accelerator_state(
     # and rmtree runs on the main process)
     wait_for_pending_checkpoint(accelerator)
     accelerator.wait_for_everyone()
-    output_dir = _auto_checkpoint_dir(accelerator, output_dir)
-    output_dir = Path(output_dir).absolute()
+    final_dir = Path(_auto_checkpoint_dir(accelerator, output_dir)).absolute()
+    verify, io_policy = _resilience_knobs(accelerator)
+    # every file stages in a sibling .tmp directory; one os.replace publishes
+    # the complete checkpoint (manifest written last) — see _finalize_checkpoint
+    output_dir = final_dir.parent / (final_dir.name + CHECKPOINT_TMP_SUFFIX)
+    if output_dir.exists() and accelerator.is_main_process:
+        # dead staging dir from a crashed writer (nothing of ours is in
+        # flight — the wait above drained it): never a checkpoint, remove
+        shutil.rmtree(output_dir)
+    accelerator.wait_for_everyone()
     output_dir.mkdir(parents=True, exist_ok=True)
 
-    # pre-hooks (reference :3664)
+    # pre-hooks (reference :3664) — handed the staging dir, so any files a
+    # hook writes ride the same manifest + atomic publish
     for hook in accelerator._save_model_state_pre_hooks.values():
         hook(accelerator._models, [], str(output_dir))
 
@@ -325,7 +593,14 @@ def save_accelerator_state(
             ckptr.save(output_dir / TRAIN_STATE_DIR, array_tree, force=True)
             accelerator._pending_checkpointer = ckptr
         else:
-            ocp.PyTreeCheckpointer().save(output_dir / TRAIN_STATE_DIR, array_tree, force=True)
+            _io_retry(
+                accelerator,
+                lambda: ocp.PyTreeCheckpointer().save(
+                    output_dir / TRAIN_STATE_DIR, array_tree, force=True
+                ),
+                site=f"checkpoint-save {final_dir.name}",
+                policy=io_policy,
+            )
 
     process_index = accelerator.process_index
     # 2. RNG (per process)
@@ -352,7 +627,21 @@ def save_accelerator_state(
                 pickle.dump(obj.state_dict(), f)
 
     accelerator.wait_for_everyone()
-    return str(output_dir)
+    if async_save and accelerator._pending_checkpointer is not None:
+        # publish deferred until the background train-state write commits
+        # (wait_for_pending_checkpoint / the interpreter-exit flush run it).
+        # Registered on the MAIN process only: the publish must happen once —
+        # a non-main rank's interpreter-exit flush racing the rename could
+        # rmtree the directory main just published.
+        if accelerator.is_main_process:
+            _PENDING_FINALIZES[accelerator._pending_checkpointer] = (
+                output_dir, final_dir, verify,
+            )
+    else:
+        if accelerator.is_main_process:
+            _finalize_checkpoint(output_dir, final_dir, manifest=verify)
+        accelerator.wait_for_everyone()
+    return str(final_dir)
 
 
 def load_accelerator_state(
@@ -363,20 +652,74 @@ def load_accelerator_state(
 ):
     """Restore from a checkpoint dir.  ``train_state`` must be a template
     TrainState (same structure/shardings — e.g. freshly built via
-    ``create_train_state``); returns the restored TrainState (or None)."""
-    ocp = _ocp()
+    ``create_train_state``); returns the restored TrainState (or None).
+
+    Every candidate directory is **verified** against its manifest first.
+    With ``input_dir=None`` (auto-resume) the scan walks the checkpoints
+    newest→oldest and restores the newest one that verifies *and* restores
+    cleanly — a truncated or bit-flipped latest checkpoint produces a loud
+    warning and a fallback, not a crash (the CheckFreq resume contract).
+    An explicitly named ``input_dir`` that fails verification raises
+    :class:`CheckpointCorruptError` instead: the caller asked for those
+    exact bytes, so silently substituting older ones would be worse."""
     # the latest checkpoint may still be writing asynchronously — on any rank
     wait_for_pending_checkpoint(accelerator)
     accelerator.wait_for_everyone()
-    if input_dir is None:
+    verify_enabled, _ = _resilience_knobs(accelerator)
+    if input_dir is not None:
+        candidates = [Path(input_dir).absolute()]
+        if not candidates[0].is_dir():
+            raise FileNotFoundError(f"checkpoint dir {candidates[0]} does not exist")
+        explicit = True
+    else:
         ckpts = list_checkpoints(accelerator.project_dir or ".")
         if not ckpts:
             raise FileNotFoundError("no checkpoints found")
-        input_dir = ckpts[-1]
-    input_dir = Path(input_dir).absolute()
-    if not input_dir.is_dir():
-        raise FileNotFoundError(f"checkpoint dir {input_dir} does not exist")
+        candidates = [Path(c) for c in reversed(ckpts)]
+        explicit = False
 
+    failures: list[str] = []
+    for i, cand in enumerate(candidates):
+        if verify_enabled:
+            ok, problems = verify_checkpoint(cand)
+            if not ok:
+                msg = f"checkpoint {cand} failed verification: {problems}"
+                if explicit:
+                    raise CheckpointCorruptError(msg)
+                logger.warning("%s — falling back to the previous checkpoint", msg)
+                failures.append(msg)
+                continue
+            for note in problems:  # valid-but-unverified (legacy) notes
+                logger.warning("checkpoint %s: %s", cand, note)
+        try:
+            return _load_checkpoint_dir(
+                accelerator, cand, train_state=train_state,
+                load_sampler_states=load_sampler_states,
+            )
+        except Exception as e:
+            # a verified-but-unrestorable checkpoint (template structure
+            # drift, or a torn legacy dir with no manifest to catch it —
+            # including the FileNotFoundError a missing shard file raises):
+            # explicit requests surface it; the auto-resume scan records it
+            # and walks on to the previous candidate
+            if explicit or i == len(candidates) - 1:
+                raise
+            msg = f"checkpoint {cand} failed to restore: {type(e).__name__}: {e}"
+            logger.warning("%s — falling back to the previous checkpoint", msg)
+            failures.append(msg)
+    raise CheckpointCorruptError(
+        "no valid checkpoint found among "
+        f"{[str(c) for c in candidates]}: {failures}"
+    )
+
+
+def _load_checkpoint_dir(
+    accelerator,
+    input_dir: Path,
+    train_state=None,
+    load_sampler_states: bool = True,
+):
+    ocp = _ocp()
     for hook in accelerator._load_model_state_pre_hooks.values():
         hook(accelerator._models, [], str(input_dir))
 
@@ -407,8 +750,12 @@ def load_accelerator_state(
                 template[str(i)] = a
                 restore_args[str(i)] = ocp.RestoreArgs()
         ckptr = ocp.PyTreeCheckpointer()
-        restored = ckptr.restore(
-            input_dir / TRAIN_STATE_DIR, item=template, restore_args=restore_args
+        restored = _io_retry(
+            accelerator,
+            lambda: ckptr.restore(
+                input_dir / TRAIN_STATE_DIR, item=template, restore_args=restore_args
+            ),
+            site=f"checkpoint-restore {input_dir.name}",
         )
         for i, a in enumerate(arrays):
             key = str(i)

@@ -33,6 +33,8 @@ from .ops.operations import (
     send_to_device,
     slice_tensors,
 )
+from .resilience.faults import maybe_fail_transfer
+from .resilience.retry import DEFAULT_POLICY, with_retries
 from .state import GradientState, PartialState
 from .utils.dataclasses import RNGType
 from .utils.imports import is_torch_available
@@ -471,6 +473,8 @@ class DataLoaderShard(DataLoaderStateMixin):
         even_batches: bool = True,
         _non_blocking: bool = True,
         _loader_batch_size: Optional[int] = None,
+        transfer_retry_policy=None,
+        on_transfer_retry=None,
     ):
         self.inner = inner
         self.device = device
@@ -487,6 +491,10 @@ class DataLoaderShard(DataLoaderStateMixin):
         self._loader_batch_size = _loader_batch_size
         self._batches_yielded = 0  # intra-epoch stateful-resume position
         self._skip_once = False    # skip_batches came from load_state_dict
+        # bounded-retry knobs for the H2D staging (resilience/retry.py);
+        # the Accelerator threads its ResiliencePlugin budget + goodput hook
+        self._retry_policy = transfer_retry_policy or DEFAULT_POLICY
+        self._on_transfer_retry = on_transfer_retry
 
     # -- device placement ---------------------------------------------------
 
@@ -522,8 +530,24 @@ class DataLoaderShard(DataLoaderStateMixin):
         if self.mesh is not None and self.batch_spec is not None:
             if self.even_batches:
                 batch = self._pad_to_device_multiple(batch)
-            return host_local_to_global(batch, self.mesh, self.batch_spec)
-        return send_to_device(batch, self.device)
+
+            def _place():
+                # injected-fault hook + bounded retry: a transient H2D
+                # staging failure costs a backoff, not the training run
+                maybe_fail_transfer("transfer")
+                return host_local_to_global(batch, self.mesh, self.batch_spec)
+
+            return with_retries(_place, site="dataloader-h2d",
+                                policy=self._retry_policy,
+                                on_retry=self._on_transfer_retry)
+
+        def _send():
+            maybe_fail_transfer("transfer")
+            return send_to_device(batch, self.device)
+
+        return with_retries(_send, site="dataloader-h2d",
+                            policy=self._retry_policy,
+                            on_retry=self._on_transfer_retry)
 
     def __iter__(self):
         if self.rng_types is not None:
@@ -653,6 +677,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         skip_batches: int = 0,
         slice_fn: Optional[Callable] = None,
         _loader_batch_size: Optional[int] = None,
+        transfer_retry_policy=None,
+        on_transfer_retry=None,
     ):
         self.inner = inner
         self.split_batches = split_batches
@@ -667,6 +693,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self._loader_batch_size = _loader_batch_size
         self._batches_yielded = 0  # intra-epoch stateful-resume position
         self._skip_once = False    # skip_batches came from load_state_dict
+        self._retry_policy = transfer_retry_policy or DEFAULT_POLICY
+        self._on_transfer_retry = on_transfer_retry
 
     def _fetch_batches(self, iterator):
         """Rank 0 reads one global batch (split mode) or num_processes batches
@@ -703,11 +731,20 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
             slice_size = whole // self.state.num_processes
             start = self.state.process_index * slice_size
             local = self.slice_fn(batch, slice(start, start + slice_size))
-            if self.mesh is not None and self.batch_spec is not None:
-                return host_local_to_global(local, self.mesh, self.batch_spec)
-            if self.device is not None:
-                return send_to_device(local, self.device)
-            return local
+
+            def _place():
+                # same bounded-retry H2D staging discipline as
+                # DataLoaderShard._device_put_batch (resilience/retry.py)
+                maybe_fail_transfer("transfer")
+                if self.mesh is not None and self.batch_spec is not None:
+                    return host_local_to_global(local, self.mesh, self.batch_spec)
+                if self.device is not None:
+                    return send_to_device(local, self.device)
+                return local
+
+            return with_retries(_place, site="dataloader-h2d",
+                                policy=self._retry_policy,
+                                on_retry=self._on_transfer_retry)
 
         try:
             # one-batch lookahead, like DataLoaderShard: the NEXT batch's
@@ -805,6 +842,8 @@ def prepare_data_loader(
     batch_spec: Optional[PartitionSpec] = None,
     parallelism_config=None,
     prefetch_size: int = 0,
+    transfer_retry_policy=None,
+    on_transfer_retry=None,
 ):
     """Re-wrap a dataloader (torch DataLoader or any batch iterable) for
     per-rank sharding + global-array device placement.
@@ -848,6 +887,8 @@ def prepare_data_loader(
             device=device if put_on_device else None,
             slice_fn=slice_fn_for_dispatch,
             _loader_batch_size=getattr(dataloader, "batch_size", None),
+            transfer_retry_policy=transfer_retry_policy,
+            on_transfer_retry=on_transfer_retry,
         )
 
     synchronized_generator = None
@@ -912,6 +953,8 @@ def prepare_data_loader(
         even_batches=even_batches,
         _non_blocking=non_blocking,
         _loader_batch_size=loader_batch_size,
+        transfer_retry_policy=transfer_retry_policy,
+        on_transfer_retry=on_transfer_retry,
     )
 
 

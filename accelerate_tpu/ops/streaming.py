@@ -52,6 +52,9 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from ..resilience.faults import maybe_fail_transfer
+from ..resilience.retry import DEFAULT_POLICY, RetryPolicy, with_retries
+
 
 # ---------------------------------------------------------------------------
 # Byte accounting
@@ -113,6 +116,10 @@ class StreamStats:
     wall_s: float = 0.0
     ici_bytes: int = 0
     tp_overlap_frac: Optional[float] = None
+    # transient host-transfer failures absorbed by the bounded retry layer
+    # (resilience/retry.py) — joins the report only when nonzero, like the
+    # ICI fields above
+    transfer_retries: int = 0
 
     def overlap_report(self, serial_transfer_s: Optional[float] = None) -> dict:
         rep = {
@@ -133,6 +140,8 @@ class StreamStats:
             rep["ici_bytes"] = int(self.ici_bytes)
         if self.tp_overlap_frac is not None:
             rep["tp_overlap_frac"] = round(self.tp_overlap_frac, 4)
+        if self.transfer_retries:
+            rep["transfer_retries"] = self.transfer_retries
         return rep
 
 
@@ -301,7 +310,8 @@ class LayerPrefetcher:
 
     def __init__(self, fetch: Callable[[int], Any], n_layers: int, *,
                  depth: int = 1, wrap: bool = False, enabled: bool = True,
-                 stats: Optional[StreamStats] = None):
+                 stats: Optional[StreamStats] = None,
+                 retry_policy: Optional[RetryPolicy] = DEFAULT_POLICY):
         if n_layers < 1:
             raise ValueError(f"n_layers must be >= 1, got {n_layers}")
         self.fetch = fetch
@@ -310,10 +320,29 @@ class LayerPrefetcher:
         self.wrap = wrap
         self.enabled = enabled
         self.stats = stats
+        # bounded retry/backoff for the host-driven H2D staging (a transient
+        # PCIe/pinned-alloc failure must not kill a decode mid-sweep); None
+        # restores fail-on-first-error.  The injected-fault hook fires inside
+        # each attempt, so the CPU suite exercises the real backoff path.
+        self.retry_policy = retry_policy
         self._slots: dict[int, Any] = {}
 
+    def _on_retry(self, site, attempt, exc):
+        if self.stats is not None:
+            self.stats.transfer_retries += 1
+
     def _issue(self, i: int):
-        tree = self.fetch(i)
+        def attempt():
+            maybe_fail_transfer("transfer")
+            return self.fetch(i)
+
+        if self.retry_policy is not None:
+            tree = with_retries(
+                attempt, policy=self.retry_policy,
+                site=f"layer-prefetch[{i}]", on_retry=self._on_retry,
+            )
+        else:
+            tree = attempt()
         if self.stats is not None:
             self.stats.h2d_bytes += tree_bytes(tree)
             self.stats.fetches += 1

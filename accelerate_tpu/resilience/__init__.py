@@ -1,0 +1,54 @@
+"""Preemption-safe resilience layer (CheckFreq / Varuna discipline).
+
+The subsystem that turns faults from run-killers into accounting entries:
+
+- :mod:`.faults` — deterministic, seeded fault injection through fixed hook
+  points in the real hot paths (preemptions, NaN bursts, transient
+  transfers, checkpoint corruption);
+- :mod:`.guard` — jit-compatible NaN/Inf skip-step with persisted counters
+  and a consecutive-skip abort;
+- :mod:`.preemption` — SIGTERM → step boundary → emergency checkpoint →
+  distinct resume exit code (75, ``EX_TEMPFAIL``);
+- :mod:`.retry` — bounded retry/backoff for checkpoint I/O and host↔device
+  staging;
+- :mod:`.goodput` — measured + predicted goodput accounting (the
+  ``StreamStats`` discipline applied to fault handling).
+
+Checkpoint verification (manifests, atomic publish, valid-fallback load)
+lives in :mod:`accelerate_tpu.checkpointing`; the knobs live on
+:class:`~accelerate_tpu.utils.dataclasses.ResiliencePlugin`
+(``ACCELERATE_RESILIENCE=1`` arms the guard + preemption handling).
+"""
+
+from .faults import (  # noqa: F401
+    CORRUPTION_MODES,
+    FAULT_KINDS,
+    FaultEvent,
+    FaultPlan,
+    InjectedTransferError,
+    active_fault_plan,
+    corrupt_checkpoint,
+    fault_plan,
+    fault_point,
+    install_fault_plan,
+    maybe_fail_transfer,
+    poison_batch,
+)
+from .goodput import GoodputTracker, goodput_accounting  # noqa: F401
+from .guard import (  # noqa: F401
+    GUARD_METRIC_KEYS,
+    NanGuardAbort,
+    check_abort,
+    finite_and,
+    guard_metrics,
+    init_guard_state,
+    select_tree,
+    update_guard_counters,
+)
+from .preemption import RESUME_EXIT_CODE, PreemptionHandler  # noqa: F401
+from .retry import (  # noqa: F401
+    DEFAULT_POLICY,
+    RetryPolicy,
+    TransientIOError,
+    with_retries,
+)

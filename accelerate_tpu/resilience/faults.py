@@ -1,0 +1,302 @@
+"""Deterministic fault injection for the save/restore/step spine.
+
+CheckFreq (Mohan et al., FAST'21) and Varuna (Athlur et al., EuroSys'22)
+treat faults as the *normal case* of large training runs — preemptions,
+transient transfer failures, NaN bursts, torn checkpoint writes — and the
+only way to trust the recovery machinery is to rehearse every one of them
+on demand.  This module is that rehearsal harness: a **seeded, fully
+deterministic fault plan** delivered through fixed hook points in the real
+hot paths (no monkeypatching — the production code calls
+:func:`fault_point` itself, and with no plan installed the hook is a single
+``None`` check).
+
+Hook sites and the fault kinds they arm:
+
+========================  =====================================================
+site                      kinds
+========================  =====================================================
+``step``                  ``preempt`` (a real ``SIGTERM`` via ``os.kill``,
+                          delivered through the installed
+                          :class:`~.preemption.PreemptionHandler`) and
+                          ``nan_grad`` (the incoming batch is NaN-poisoned, so
+                          the non-finite gradients flow through the *genuine*
+                          ``value_and_grad`` → guard path)
+``transfer``              ``transfer`` — a :class:`InjectedTransferError`
+                          raised from host↔device staging
+                          (``ops/streaming.LayerPrefetcher``, dataloader
+                          device placement)
+``checkpoint_io``         ``transfer`` — same, from checkpoint read/write
+``post_save``             ``corrupt_ckpt`` — the just-published checkpoint has
+                          one shard file truncated or bit-flipped (the torn
+                          write / bit-rot simulation the verified-manifest
+                          load path must catch)
+========================  =====================================================
+
+Occurrence counting is per-site and 1-based: an event ``FaultEvent("preempt",
+at=4)`` fires on the 4th prepared-train-step call of the process, every time,
+for every seed — which is what makes the resilience acceptance matrix
+reproducible in CI.  ``FaultPlan.from_seed`` derives a random-but-deterministic
+plan from a seed for soak-style testing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+from collections import defaultdict
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..logging import get_logger
+from .retry import TransientIOError
+
+logger = get_logger(__name__)
+
+FAULT_KINDS = ("preempt", "nan_grad", "transfer", "corrupt_ckpt")
+
+# default hook site per kind (a transfer event may override its site to
+# "checkpoint_io" to target checkpoint I/O instead of the streaming path)
+KIND_DEFAULT_SITE = {
+    "preempt": "step",
+    "nan_grad": "step",
+    "transfer": "transfer",
+    "corrupt_ckpt": "post_save",
+}
+
+CORRUPTION_MODES = ("truncate", "bitflip")
+
+
+class InjectedTransferError(TransientIOError):
+    """The fault plan's transient transfer failure (retryable by design)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault.
+
+    ``at`` is the 1-based occurrence index of the hook site this event arms;
+    ``count`` extends it over consecutive occurrences (a ``transfer`` event
+    with ``count=2`` fails two attempts in a row — one past the default
+    retry budget's first re-attempt, still within the bounded budget);
+    ``mode`` selects the corruption flavor for ``corrupt_ckpt``.
+    """
+
+    kind: str
+    at: int = 1
+    count: int = 1
+    mode: str = "truncate"
+    site: str = ""
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; options: {FAULT_KINDS}")
+        if self.at < 1 or self.count < 1:
+            raise ValueError(f"at/count must be >= 1 (got at={self.at}, count={self.count})")
+        if self.kind == "corrupt_ckpt" and self.mode not in CORRUPTION_MODES:
+            raise ValueError(f"unknown corruption mode {self.mode!r}; options: {CORRUPTION_MODES}")
+        if not self.site:
+            object.__setattr__(self, "site", KIND_DEFAULT_SITE[self.kind])
+
+    def covers(self, occurrence: int) -> bool:
+        return self.at <= occurrence < self.at + self.count
+
+
+class FaultPlan:
+    """A deterministic schedule of :class:`FaultEvent`\\ s.
+
+    Install with :func:`install_fault_plan` / the :func:`fault_plan` context
+    manager, or ship it to a subprocess as JSON through the
+    ``ACCELERATE_FAULT_PLAN`` environment variable (the Accelerator installs
+    an env-borne plan at construction).  ``fired`` records every delivered
+    event — the test-side audit trail.
+    """
+
+    def __init__(self, events: Sequence[FaultEvent] = (), seed: int = 0):
+        self.events = tuple(events)
+        self.seed = int(seed)
+        self._occurrences: dict[str, int] = defaultdict(int)
+        self.fired: list[tuple[str, int, FaultEvent]] = []
+
+    def fire(self, site: str) -> tuple[FaultEvent, ...]:
+        """Advance ``site``'s occurrence counter and return the events armed
+        for this occurrence (usually empty)."""
+        self._occurrences[site] += 1
+        occ = self._occurrences[site]
+        hits = tuple(e for e in self.events if e.site == site and e.covers(occ))
+        for e in hits:
+            self.fired.append((site, occ, e))
+            logger.warning("fault injection: %s fires at %s occurrence %d", e.kind, site, occ)
+        return hits
+
+    def occurrences(self, site: str) -> int:
+        return self._occurrences[site]
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "FaultPlan":
+        """Build from the JSON shape ``{"seed": 0, "events": [{"kind": ...,
+        "at": ..., "count": ..., "mode": ..., "site": ...}, ...]}``."""
+        events = [
+            FaultEvent(
+                kind=d["kind"], at=int(d.get("at", 1)), count=int(d.get("count", 1)),
+                mode=d.get("mode", "truncate"), site=d.get("site", ""),
+            )
+            for d in spec.get("events", [])
+        ]
+        return cls(events, seed=int(spec.get("seed", 0)))
+
+    @classmethod
+    def from_env(cls, var: str = "ACCELERATE_FAULT_PLAN") -> Optional["FaultPlan"]:
+        raw = os.environ.get(var)
+        if not raw:
+            return None
+        return cls.from_spec(json.loads(raw))
+
+    @classmethod
+    def from_seed(
+        cls, seed: int, n_steps: int, *,
+        p_preempt: float = 0.0, p_nan: float = 0.0,
+        p_transfer: float = 0.0, p_corrupt: float = 0.0,
+    ) -> "FaultPlan":
+        """A random-but-reproducible plan: each step draws each enabled fault
+        kind independently at its probability.  Same seed → same plan,
+        always — the soak-test entry point."""
+        rng = np.random.default_rng(seed)
+        events = []
+        for step in range(1, n_steps + 1):
+            if p_preempt and rng.random() < p_preempt:
+                events.append(FaultEvent("preempt", at=step))
+                break  # a preemption ends the process; later events are moot
+            if p_nan and rng.random() < p_nan:
+                events.append(FaultEvent("nan_grad", at=step))
+            if p_transfer and rng.random() < p_transfer:
+                events.append(FaultEvent("transfer", at=step))
+            if p_corrupt and rng.random() < p_corrupt:
+                events.append(FaultEvent("corrupt_ckpt", at=step,
+                                         mode=CORRUPTION_MODES[int(rng.integers(2))]))
+        return cls(events, seed=seed)
+
+    def to_spec(self) -> dict:
+        return {
+            "seed": self.seed,
+            "events": [dataclasses.asdict(e) for e in self.events],
+        }
+
+    def __repr__(self):
+        return f"FaultPlan(seed={self.seed}, events={list(self.events)!r})"
+
+
+# ---------------------------------------------------------------------------
+# the ambient plan + hook points (what the production code calls)
+# ---------------------------------------------------------------------------
+
+
+_ACTIVE_PLAN: Optional[FaultPlan] = None
+
+
+def install_fault_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install ``plan`` as the process-wide active plan (``None`` disarms);
+    returns the previous plan so callers can restore it."""
+    global _ACTIVE_PLAN
+    previous = _ACTIVE_PLAN
+    _ACTIVE_PLAN = plan
+    return previous
+
+
+def active_fault_plan() -> Optional[FaultPlan]:
+    return _ACTIVE_PLAN
+
+
+@contextlib.contextmanager
+def fault_plan(plan: Optional[FaultPlan]):
+    """Scope a plan to a ``with`` block (tests; restores the previous plan)."""
+    previous = install_fault_plan(plan)
+    try:
+        yield plan
+    finally:
+        install_fault_plan(previous)
+
+
+def fault_point(site: str) -> tuple[FaultEvent, ...]:
+    """The hook the real hot paths call.  With no plan installed this is one
+    global-read + ``None`` check — cheap enough for per-step/per-batch
+    placement."""
+    if _ACTIVE_PLAN is None:
+        return ()
+    return _ACTIVE_PLAN.fire(site)
+
+
+def maybe_fail_transfer(site: str = "transfer") -> None:
+    """Raise :class:`InjectedTransferError` when the plan arms a ``transfer``
+    fault for this occurrence of ``site`` — called at the top of each
+    host-transfer attempt, *inside* the retry wrapper, so every injected
+    failure exercises the real backoff path."""
+    for e in fault_point(site):
+        if e.kind == "transfer":
+            raise InjectedTransferError(
+                f"injected transient transfer failure at {site} "
+                f"(occurrence {_ACTIVE_PLAN.occurrences(site)})"
+            )
+
+
+# ---------------------------------------------------------------------------
+# fault payloads
+# ---------------------------------------------------------------------------
+
+
+def poison_batch(batch):
+    """NaN-fill every inexact array leaf of ``batch`` (integer leaves — token
+    ids, masks — pass through untouched).
+
+    This is how ``nan_grad`` faults enter the step: the poisoned batch flows
+    through the *real* loss → ``value_and_grad`` → guard path, so the skip
+    machinery is tested against genuine non-finite gradients, not a mock."""
+    import jax
+    import jax.numpy as jnp
+
+    def _p(x):
+        if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.inexact):
+            return jnp.full_like(x, jnp.nan)
+        if isinstance(x, np.ndarray) and np.issubdtype(x.dtype, np.inexact):
+            return np.full_like(x, np.nan)
+        return x
+
+    return jax.tree_util.tree_map(_p, batch)
+
+
+def corrupt_checkpoint(ckpt_dir, mode: str = "truncate", seed: int = 0) -> str:
+    """Deterministically corrupt one data file of a written checkpoint —
+    the torn-write (``truncate``) / bit-rot (``bitflip``) simulation that
+    ``checkpointing.verify_checkpoint`` must catch.  Prefers a train-state
+    shard (the biggest loss surface); the choice is seeded.  Returns the
+    corrupted file's path."""
+    from ..utils.constants import CHECKPOINT_MANIFEST_NAME, TRAIN_STATE_DIR
+
+    if mode not in CORRUPTION_MODES:
+        raise ValueError(f"unknown corruption mode {mode!r}; options: {CORRUPTION_MODES}")
+    root = Path(ckpt_dir)
+    files = sorted(
+        p for p in root.rglob("*")
+        if p.is_file() and p.name != CHECKPOINT_MANIFEST_NAME and p.stat().st_size > 0
+    )
+    if not files:
+        raise FileNotFoundError(f"no corruptible files under {root}")
+    shard_files = [p for p in files if TRAIN_STATE_DIR in p.parts]
+    candidates = shard_files or files
+    rng = np.random.default_rng(seed)
+    target = candidates[int(rng.integers(len(candidates)))]
+    data = target.read_bytes()
+    if mode == "truncate":
+        target.write_bytes(data[: len(data) // 2])
+    else:  # bitflip
+        pos = int(rng.integers(len(data)))
+        buf = bytearray(data)
+        buf[pos] ^= 0xFF
+        target.write_bytes(bytes(buf))
+    logger.warning("fault injection: corrupted %s (%s)", target, mode)
+    return str(target)

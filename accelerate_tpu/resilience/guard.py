@@ -1,0 +1,115 @@
+"""NaN/Inf step guard: skip the update, keep the state, count the damage.
+
+One NaN burst — a bad batch, an fp16 overflow outside the loss-scale's
+reach, a transient numerics bug — poisons params *and* optimizer moments,
+and everything after it is wasted accelerator time until someone notices
+the loss is ``nan`` and restores a checkpoint by hand.  The guard makes the
+step self-defending: when the loss or global grad-norm is non-finite, the
+update is dropped **inside the jit** and the returned params/opt-state are
+bitwise the input buffers.
+
+Mechanism: a ``where``-select per leaf (:func:`select_tree`) gated on a
+single finiteness scalar, the same skipped-step discipline the fp16
+loss-scale path already uses (reference overflow handling).  A select
+rather than a ``lax.cond`` over the whole state on purpose: ``cond`` cannot
+mix memory spaces, and under ``cpu_offload`` the opt-state/master leaves
+live in pinned host memory — the select runs *inside* the host-compute
+update region where every operand already shares a space (the same
+constraint that keeps ``across_steps``'s accumulator in HBM,
+``accelerator.py``).  ``jnp.where(pred, x, y)`` with a scalar ``pred``
+returns ``y``'s exact bytes when the predicate is false, which is what the
+"params bitwise unchanged" acceptance test pins.
+
+Skip counters ride the TrainState (``guard_state``) so they survive
+checkpoint/resume; the Python-side abort (``max_consecutive_nan_skips``)
+turns a persistent divergence into a loud :class:`NanGuardAbort` instead of
+an infinite skip loop.
+
+Known limitation: with gradient accumulation ``mode="across_steps"`` the
+carried accumulator is polluted *before* the boundary-step guard can see
+it; the default ``in_step`` mode folds microbatches inside the step, so the
+guard covers the whole accumulated gradient there.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# metric keys the guarded step adds (bench/trackers read these)
+GUARD_METRIC_KEYS = ("nan_skipped", "nan_skips", "consecutive_nan_skips")
+
+
+class NanGuardAbort(RuntimeError):
+    """Raised by the step wrapper after K consecutive non-finite steps.
+
+    The params/opt-state were held at their last finite values the whole
+    time, so the newest checkpoint (or an emergency save by the caller) is
+    clean — aborting here converts silent wasted accelerator time into an
+    actionable failure."""
+
+
+def init_guard_state() -> dict:
+    """Fresh on-device skip counters (a TrainState.guard_state value)."""
+    return {
+        "nan_skips": jnp.int32(0),
+        "consecutive_nan_skips": jnp.int32(0),
+    }
+
+
+def finite_and(*flags):
+    """AND-reduce finiteness flags/scalar predicates into one bool scalar."""
+    out = jnp.bool_(True)
+    for f in flags:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def select_tree(finite, new_tree, old_tree):
+    """The jit-compatible skip-step: per leaf, ``new`` when ``finite`` else
+    the *bitwise* ``old`` buffer.  Leaves whose shapes differ between new
+    and old (e.g. optimizer-state members an update legitimately reshapes)
+    pass the new value through — matching the loss-scale skip semantics in
+    ``accelerator.apply_update``."""
+
+    def _sel(n, o):
+        if hasattr(n, "shape") and n.shape == getattr(o, "shape", None):
+            return jnp.where(finite, n, o)
+        return n
+
+    return jax.tree_util.tree_map(_sel, new_tree, old_tree)
+
+
+def update_guard_counters(guard_state: dict, finite) -> dict:
+    """Advance the on-device counters for one step: total skips accumulate,
+    the consecutive counter resets on any finite step."""
+    skipped = jnp.logical_not(finite)
+    return {
+        "nan_skips": guard_state["nan_skips"] + skipped.astype(jnp.int32),
+        "consecutive_nan_skips": jnp.where(
+            skipped, guard_state["consecutive_nan_skips"] + 1, 0
+        ).astype(jnp.int32),
+    }
+
+
+def guard_metrics(metrics: dict, finite, new_guard_state: dict) -> dict:
+    """Attach the guard's observability keys to the step metrics."""
+    metrics["nan_skipped"] = jnp.logical_not(finite)
+    metrics["nan_skips"] = new_guard_state["nan_skips"]
+    metrics["consecutive_nan_skips"] = new_guard_state["consecutive_nan_skips"]
+    return metrics
+
+
+def check_abort(consecutive: int, threshold: int) -> None:
+    """Host-side abort check (the step wrapper calls this with the fetched
+    counter).  ``threshold`` <= 0 disables the abort — counters keep
+    accumulating either way."""
+    if threshold and threshold > 0 and consecutive >= threshold:
+        raise NanGuardAbort(
+            f"{consecutive} consecutive non-finite steps (threshold "
+            f"{threshold}): params/opt-state were held at their last finite "
+            "values; inspect the data pipeline / loss scaling and resume "
+            "from the newest checkpoint"
+        )

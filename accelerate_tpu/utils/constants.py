@@ -21,6 +21,12 @@ SCHEDULER_STATES_NAME = "scheduler_states.json"
 METADATA_NAME = "accelerate_metadata.json"
 CHECKPOINT_DIR_PREFIX = "checkpoint"
 CHECKPOINT_DIR_PATTERN = r"checkpoint_\d+"
+# verified atomic checkpoints (checkpointing.py): every file stages under
+# <dir>.tmp, the manifest (per-file sizes + crc32) is written last, and one
+# os.replace publishes the directory — the pattern above intentionally does
+# NOT match *.tmp, so scans/GC never see a half-written checkpoint
+CHECKPOINT_TMP_SUFFIX = ".tmp"
+CHECKPOINT_MANIFEST_NAME = "checkpoint_manifest.json"
 
 # -- unified weights files (save_model / load_checkpoint_in_model) -----------
 SAFE_WEIGHTS_NAME = "model.safetensors"

@@ -381,6 +381,67 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
 
 
 @dataclass
+class ResiliencePlugin(KwargsHandler):
+    """Preemption-safe training knobs (engine: ``accelerate_tpu/resilience/``;
+    CheckFreq/Varuna discipline — see docs/resilience.md).
+
+    ``ACCELERATE_RESILIENCE=1`` arms the whole layer by default (NaN guard +
+    preemption handling); individual ``ACCELERATE_NAN_GUARD`` /
+    ``ACCELERATE_PREEMPTION`` flags override per-feature.  Checkpoint
+    verification and bounded I/O retry are on regardless — they cost nothing
+    on the hot path and are what the corruption-fallback contract rests on.
+    """
+
+    nan_guard: Optional[bool] = None        # lax-select skip-step on non-finite
+                                            # loss/grad-norm inside the jitted
+                                            # step; counters persist in
+                                            # TrainState.guard_state.  Default:
+                                            # env ACCELERATE_NAN_GUARD, else
+                                            # ACCELERATE_RESILIENCE.
+    max_consecutive_nan_skips: int = 3      # abort (NanGuardAbort) after this
+                                            # many consecutive skipped steps;
+                                            # 0 disables the abort only — the
+                                            # armed guard always fetches its
+                                            # skip scalar per step so goodput/
+                                            # bench counters stay truthful.
+    handle_preemption: Optional[bool] = None  # install the SIGTERM-at-step-
+                                            # boundary handler at Accelerator
+                                            # construction.  Default: env
+                                            # ACCELERATE_PREEMPTION, else
+                                            # ACCELERATE_RESILIENCE.
+    preemption_signals: tuple = ("SIGTERM",)
+    emergency_checkpoint: bool = True       # write a checkpoint at the stop
+                                            # boundary before exiting
+    resume_exit_code: int = 75              # EX_TEMPFAIL: "re-run me" — what
+                                            # supervisors key restarts on
+    verify_checkpoints: bool = True         # manifest (sizes+crc32) on save,
+                                            # verify + valid-fallback on load
+    io_retries: int = 3                     # bounded retry budget for
+                                            # checkpoint I/O + host transfers
+    io_backoff_s: float = 0.05              # first backoff; doubles per retry
+
+    def __post_init__(self):
+        armed = parse_flag_from_env("ACCELERATE_RESILIENCE")
+        if self.nan_guard is None:
+            self.nan_guard = parse_flag_from_env("ACCELERATE_NAN_GUARD", default=armed)
+        if self.handle_preemption is None:
+            self.handle_preemption = parse_flag_from_env(
+                "ACCELERATE_PREEMPTION", default=armed
+            )
+        if isinstance(self.preemption_signals, str):
+            self.preemption_signals = (self.preemption_signals,)
+        else:
+            self.preemption_signals = tuple(self.preemption_signals)
+        if self.max_consecutive_nan_skips < 0:
+            raise ValueError(
+                "max_consecutive_nan_skips must be >= 0 (0 disables the "
+                f"abort), got {self.max_consecutive_nan_skips}"
+            )
+        if self.io_retries < 0:
+            raise ValueError(f"io_retries must be >= 0, got {self.io_retries}")
+
+
+@dataclass
 class TensorParallelConfig(KwargsHandler):
     """reference TorchTensorParallelConfig dataclasses.py:2264.
 

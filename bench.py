@@ -926,6 +926,20 @@ def main():
     overlap_fields["tp_overlap_frac"] = tp_overlap
     extra_report["collective_matmul"] = cm_mode
 
+    # Resilience accounting — nan_skips/restarts/goodput_frac are ALWAYS
+    # emitted so BENCH_*.json tracks fault handling across rounds: a clean
+    # run reports zero skips/restarts and goodput_frac 1.0 (the measured
+    # tracker on the accelerator; predicted twin:
+    # resilience.goodput_accounting).  The full counter digest rides in
+    # extra["goodput"].
+    goodput = acc.goodput.report()
+    resilience_fields = {
+        "nan_skips": goodput["nan_skips"],
+        "restarts": goodput["restarts"],
+        "goodput_frac": goodput["goodput_frac"],
+    }
+    extra_report["goodput"] = goodput
+
     print(json.dumps({
         "metric": "llama_bf16_train_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -936,6 +950,7 @@ def main():
             # handler was installed (which sets the key above)
             "grad_dtype": extra_report.pop("grad_dtype", "fp32"),
             **overlap_fields,
+            **resilience_fields,
             **extra_report,
             "precision": args.precision,
             "optimizer": args.optimizer,

@@ -43,7 +43,8 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
     ]),
     "checkpointing": ("accelerate_tpu.checkpointing", [
         "save_accelerator_state", "load_accelerator_state", "save_model",
-        "load_model_params", "merge_weights",
+        "load_model_params", "merge_weights", "verify_checkpoint",
+        "write_checkpoint_manifest", "CheckpointCorruptError",
     ]),
     "generation": ("accelerate_tpu.generation", [
         "generate", "beam_search", "generate_streamed", "place_params_host",
@@ -82,10 +83,19 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "tp_comm_accounting",
     ]),
     "profiler": ("accelerate_tpu.utils.profiler", ["TPUProfiler"]),
+    "resilience": ("accelerate_tpu.resilience", [
+        "FaultPlan", "FaultEvent", "install_fault_plan", "fault_plan",
+        "fault_point", "maybe_fail_transfer", "poison_batch",
+        "corrupt_checkpoint", "PreemptionHandler", "RetryPolicy",
+        "with_retries", "TransientIOError", "NanGuardAbort",
+        "init_guard_state", "select_tree", "update_guard_counters",
+        "GoodputTracker", "goodput_accounting",
+    ]),
     "dataclasses": ("accelerate_tpu.utils.dataclasses", [
         "GradSyncKwargs", "ProfileKwargs", "GradientAccumulationPlugin",
-        "FullyShardedDataParallelPlugin", "ProjectConfiguration",
-        "DataLoaderConfiguration", "InitProcessGroupKwargs",
+        "FullyShardedDataParallelPlugin", "ResiliencePlugin",
+        "ProjectConfiguration", "DataLoaderConfiguration",
+        "InitProcessGroupKwargs",
     ]),
     "memory": ("accelerate_tpu.utils.memory", None),
 }

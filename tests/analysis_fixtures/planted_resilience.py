@@ -1,0 +1,45 @@
+"""PLANTED GL205 fixtures — intentionally torn-write-prone checkpoint code.
+
+Every function here contains a checkpoint-durability hazard the
+non-atomic-checkpoint rule must flag (the corrected twin is
+``clean_resilience.py``).  Excluded from repo-wide sweeps like the rest of
+this directory.
+"""
+
+import json
+import os
+import pickle
+
+
+def save_weights_non_atomic(step, payload):
+    # GL205(a): writes straight into the live checkpoint dir — a crash
+    # mid-write leaves a directory that looks like a checkpoint
+    d = f"checkpoints/checkpoint_{step}"
+    os.makedirs(d, exist_ok=True)
+    with open(f"{d}/weights.bin", "wb") as f:
+        f.write(payload)
+    return d
+
+
+def save_meta_non_atomic(step, meta):
+    # GL205(a): json.dump into a live checkpoint path, no os.replace in scope
+    with open(f"checkpoints/checkpoint_{step}/meta.json", "w") as f:
+        json.dump(meta, f)
+
+
+def save_rng_non_atomic(step, rng_state):
+    # GL205(a): pickle.dump variant
+    with open(f"checkpoints/checkpoint_{step}/rng.pkl", "wb") as f:
+        pickle.dump(rng_state, f)
+
+
+def restore_swallowing_failures(path):
+    # GL205(b): a swallowed restore failure reads as success — the caller
+    # trains on from garbage
+    state = {}
+    try:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+    except Exception:
+        pass
+    return state

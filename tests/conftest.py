@@ -46,12 +46,14 @@ def _reset_singletons():
     resets AcceleratorState, testing.py:650-661)."""
     yield
     from accelerate_tpu.ops.collective_matmul import set_collective_matmul
+    from accelerate_tpu.resilience.faults import install_fault_plan
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
     AcceleratorState._reset_state()
     GradientState._reset_state()
     PartialState._reset_state()
     set_collective_matmul(None)  # clear any ambient ring-matmul override
+    install_fault_plan(None)     # no fault plan may leak across tests
 
 
 @pytest.fixture

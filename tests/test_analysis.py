@@ -116,7 +116,8 @@ def test_report_counts_exit_code_and_json():
 def test_every_emitted_rule_is_in_the_catalog():
     # both engines draw severities/hints from rules.RULES; ids must resolve
     for rule_id in ("GL001", "GL002", "GL101", "GL102", "GL103", "GL104",
-                    "GL105", "GL106", "GL201", "GL202", "GL203", "GL204"):
+                    "GL105", "GL106", "GL201", "GL202", "GL203", "GL204",
+                    "GL205"):
         assert rule_id in RULES
         assert RULES[rule_id].summary and RULES[rule_id].fix_hint
 
@@ -428,6 +429,58 @@ def test_fixture_ast_planted_all_rules_fire():
 def test_fixture_ast_clean_twins_quiet():
     rep = lint_paths([FIXTURES / "clean_ast_rules.py"], excludes=())
     assert not rep.unsuppressed(), rep.render()
+
+
+def test_fixture_resilience_planted_gl205_fires():
+    rep = lint_paths([FIXTURES / "planted_resilience.py"], excludes=())
+    assert _rules_of(rep) == {"GL205"}, rep.render()
+    findings = [f for f in rep.unsuppressed() if f.rule == "GL205"]
+    # 3 non-atomic write variants (open-wb, json.dump, pickle.dump) + 1
+    # swallowed-exception variant, each individually located
+    assert len(findings) == 4, rep.render()
+    assert sum("atomic publish" in f.message for f in findings) == 3
+    assert sum("except Exception: pass" in f.message for f in findings) == 1
+
+
+def test_fixture_resilience_clean_twin_quiet():
+    rep = lint_paths([FIXTURES / "clean_resilience.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
+
+
+def test_gl205_one_hop_name_resolution_and_scope():
+    # the live path reaches the write through a local assignment — still hit
+    src = (
+        "import os, pickle\n"
+        "def save(step, tree):\n"
+        "    d = 'runs/checkpoint_%d' % step\n"
+        "    with open(d + '/w.bin', 'wb') as f:\n"
+        "        f.write(tree)\n"
+    )
+    assert {f.rule for f in lint_source(src, "m.py")} == {"GL205"}
+    # the tmp-stage + os.replace idiom retires it
+    fixed = (
+        "import os, pickle\n"
+        "def save(step, tree):\n"
+        "    d = 'runs/checkpoint_%d.tmp' % step\n"
+        "    with open(d + '/w.bin', 'wb') as f:\n"
+        "        f.write(tree)\n"
+        "    os.replace(d, d[:-4])\n"
+    )
+    assert lint_source(fixed, "m.py") == []
+    # a 2-argument str.replace path-mangle is NOT an atomic publish — only
+    # the 1-argument Path.replace/rename form (or os.replace & co.) retires
+    # the hazard
+    str_replace = (
+        "def save(step, data):\n"
+        "    d = ('ckpts/checkpoint_%d' % step).replace('//', '/')\n"
+        "    with open(d + '/w.bin', 'wb') as f:\n"
+        "        f.write(data)\n"
+    )
+    assert {f.rule for f in lint_source(str_replace, "m.py")} == {"GL205"}
+    # except-pass only fires on the resilience/checkpoint spine paths
+    swallow = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    assert lint_source(swallow, "some/module.py") == []
+    assert {f.rule for f in lint_source(swallow, "pkg/checkpoint_utils.py")} == {"GL205"}
 
 
 def test_fixtures_are_excluded_from_repo_sweeps_by_default():

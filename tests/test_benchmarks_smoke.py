@@ -108,6 +108,28 @@ def test_bench_collective_matmul_flag():
 
 
 @pytest.mark.slow
+def test_bench_resilience_fields_always_emitted():
+    """The resilience counters ride EVERY bench report (the CI contract for
+    BENCH_*.json cross-round tracking): nan_skips/restarts at zero and
+    goodput_frac at 1.0 when the run is clean, with the full measured
+    digest under extra["goodput"]."""
+    rep = _run(["bench.py", "--iters", "2", "--batch", "8"])
+    extra = rep["extra"]
+    assert extra["nan_skips"] == 0
+    assert extra["restarts"] == 0
+    assert extra["goodput_frac"] == 1.0
+    goodput = extra["goodput"]
+    assert goodput["kind"] == "measured"
+    assert goodput["steps"] > 0 and goodput["preemptions"] == 0
+
+    # the fields ride the offload flavor too (next to the streaming fields)
+    rep_off = _run(["bench.py", "--iters", "2", "--batch", "8", "--offload",
+                    "--chunk-gib", "1e-6"])
+    for field in ("nan_skips", "restarts", "goodput_frac", "overlap_frac"):
+        assert field in rep_off["extra"], field
+
+
+@pytest.mark.slow
 def test_bench_plan_audit_hook():
     """``--plan N --audit`` embeds the graft-lint jaxpr-audit summary for
     the selected step: a tiny train step traced through the real

@@ -107,10 +107,12 @@ def test_async_save_immediate_save_and_retention_race(tmp_path):
         dirs.append(acc.save_state(train_state=state, async_save=True))
         if len(dirs) == 3:
             break
-    # total_limit=2: first dir GC'd — and only after its write finished
+    # the third write is still in flight: its directory publishes only at
+    # commit (atomic tmp+rename), so drain before listing.  total_limit=2:
+    # first dir GC'd — and only after its write finished.
+    acc.wait_for_checkpoint()
     ckpts = list_checkpoints(str(tmp_path))
     assert [os.path.basename(c) for c in ckpts] == ["checkpoint_1", "checkpoint_2"]
-    acc.wait_for_checkpoint()
     for i, ckpt in enumerate(ckpts, start=1):
         template = acc.create_train_state(regression_init_params(), optax.adam(0.05))
         restored = acc.load_state(ckpt, train_state=template)
@@ -150,6 +152,59 @@ def test_end_training_flushes_async_save(tmp_path):
     template = acc.create_train_state(regression_init_params(), optax.adam(0.05))
     restored = acc.load_state(ckpt_dir, train_state=template)
     assert float(restored.params["a"]) == float(state.params["a"])
+
+
+def test_save_publishes_atomically_with_manifest(tmp_path):
+    """Every save stages under checkpoint_<i>.tmp and publishes with one
+    os.replace: after it returns there is a manifest, no staging dir, and
+    the directory verifies (docs/resilience.md)."""
+    from accelerate_tpu.checkpointing import verify_checkpoint
+
+    acc, dl, state, step = _setup(tmp_path)
+    ckpt = acc.save_state(train_state=state)
+    assert os.path.exists(os.path.join(ckpt, "checkpoint_manifest.json"))
+    assert not list((tmp_path / "checkpoints").glob("*.tmp"))
+    ok, problems = verify_checkpoint(ckpt)
+    assert ok, problems
+
+    # async saves publish at commit through the same atomic path
+    ckpt2 = acc.save_state(train_state=state, async_save=True)
+    acc.wait_for_checkpoint()
+    assert not list((tmp_path / "checkpoints").glob("*.tmp"))
+    ok, problems = verify_checkpoint(ckpt2)
+    assert ok, problems
+
+
+def test_stale_tmp_dir_is_swept_on_next_save(tmp_path):
+    """A torn write from a crashed run (checkpoint_*.tmp) is never
+    load-visible and the next save sweeps it."""
+    from accelerate_tpu.checkpointing import list_checkpoints as _lc
+
+    acc, dl, state, step = _setup(tmp_path)
+    acc.save_state(train_state=state)
+    stale = tmp_path / "checkpoints" / "checkpoint_9.tmp"
+    stale.mkdir(parents=True)
+    (stale / "garbage.bin").write_bytes(b"\x00" * 16)
+    assert all(".tmp" not in os.path.basename(c) for c in _lc(str(tmp_path)))
+    acc.save_state(train_state=state)
+    assert not stale.exists()
+
+
+def test_resumed_process_numbering_continues_past_existing(tmp_path):
+    """A fresh ProjectConfiguration (iteration=0) over an existing checkpoint
+    tree must keep numbering monotonic — otherwise post-resume saves would
+    shadow the 'newest = highest index' ordering resume scans rely on."""
+    acc, dl, state, step = _setup(tmp_path)
+    acc.save_state(train_state=state)
+    acc.save_state(train_state=state)
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc2, dl2, state2, step2 = _setup(tmp_path)  # iteration starts at 0 again
+    ckpt = acc2.save_state(train_state=state2)
+    assert os.path.basename(ckpt) == "checkpoint_2"
 
 
 def test_rng_state_roundtrip(tmp_path):

@@ -35,6 +35,13 @@ def test_sharded_over_hbm_decode_leg():
 
 
 @pytest.mark.slow
+def test_resilience_leg():
+    info = graft._resilience_leg(np.random.default_rng(0))
+    assert "parity ok" in info
+    assert "exit75" in info and "fallback" in info
+
+
+@pytest.mark.slow
 def test_plan_infer_report_70b():
     sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
     from bench import plan_infer_report
