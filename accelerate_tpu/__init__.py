@@ -28,6 +28,7 @@ from .utils.dataclasses import (
     ProjectConfiguration,
     ResiliencePlugin,
     SequenceParallelConfig,
+    ServingPlugin,
     ShardingStrategy,
     TensorParallelConfig,
 )

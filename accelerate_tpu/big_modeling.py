@@ -582,6 +582,40 @@ def load_checkpoint_and_dispatch(
     )
 
 
+def serve_model(model, params, serving_plugin=None, generation_config=None, rng=None):
+    """Stand up a continuous-batching :class:`~accelerate_tpu.serving.ServingEngine`
+    over an already-dispatched param tree — the serving-side completion of
+    the reference's load→dispatch→generate contract (big_modeling.py:513 +
+    benchmarks/big_model_inference), rebuilt at production scale: paged KV
+    cache, per-step admission/eviction, chunked prefill (docs/serving.md).
+
+    ``params`` is whatever :func:`load_checkpoint_and_dispatch` or
+    :meth:`~accelerate_tpu.accelerator.Accelerator.init_params` produced —
+    including int8 ``QuantizedTensor`` leaves, which decode through the
+    Pallas in-tile-dequant matmuls unchanged."""
+    from .serving import ServingEngine
+
+    return ServingEngine(model, params, serving_plugin, generation_config, rng=rng)
+
+
+def load_checkpoint_and_serve(
+    module,
+    checkpoint: Union[str, os.PathLike],
+    *,
+    serving_plugin=None,
+    generation_config=None,
+    sample_args: tuple = (),
+    dtype=None,
+    **dispatch_kwargs,
+):
+    """One call from checkpoint to serving engine:
+    :func:`load_checkpoint_and_dispatch` then :func:`serve_model`."""
+    params, _store = load_checkpoint_and_dispatch(
+        module, checkpoint, sample_args=sample_args, dtype=dtype, **dispatch_kwargs
+    )
+    return serve_model(module, params, serving_plugin, generation_config)
+
+
 def dispatch_model(params, placement: dict[str, Union[int, str]], offload_folder: Optional[str] = None):
     """Place an already-materialized pytree per a placement map
     (reference dispatch_model big_modeling.py:310)."""

@@ -200,6 +200,60 @@ def _jitted_generate(model, generation_config, apply_fn=None):
                    static_argnums=(4,))
 
 
+def generate_paged(
+    model,
+    params,
+    input_ids,
+    generation_config: Optional[GenerationConfig] = None,
+    *,
+    prompt_lengths=None,
+    serving_plugin=None,
+    rng=None,
+):
+    """:func:`generate`-shaped decoding through the **paged serving path**
+    (``accelerate_tpu/serving/``): the batch rows become requests, decode
+    runs through the block-table paged KV cache and the continuous-batching
+    engine, and the output comes back as the same right-padded
+    ``[B, max_new_tokens]`` int32 array (``pad_token_id`` after EOS).
+
+    Greedy paged serving emits tokens **identical** to :func:`generate` —
+    the acceptance contract tests/test_serving.py pins.  This is also the
+    offline entry point for batch inference over the serving stack (the
+    per-request path is :class:`~accelerate_tpu.serving.ServingEngine`).
+    """
+    from .serving import Request, ServingEngine
+    from .utils.dataclasses import ServingPlugin
+
+    generation_config = generation_config or GenerationConfig()
+    input_ids = np.asarray(input_ids)
+    b, t_prompt = input_ids.shape
+    if prompt_lengths is None:
+        prompt_lengths = [t_prompt] * b
+    else:
+        prompt_lengths = [int(x) for x in np.asarray(prompt_lengths)]
+    n_new = generation_config.max_new_tokens
+    if serving_plugin is None:
+        # provision for the offline case: every row resident at once
+        page_size = 16
+        pages = max(1, -(-(t_prompt + n_new) // page_size))
+        serving_plugin = ServingPlugin(
+            num_slots=b, page_size=page_size, pages_per_slot=pages,
+            num_pages=b * pages, prefill_chunk=max(16, t_prompt),
+        )
+    engine = ServingEngine(model, params, serving_plugin, generation_config, rng=rng)
+    for i in range(b):
+        engine.add_request(Request(
+            uid=i, prompt=tuple(int(x) for x in input_ids[i, : prompt_lengths[i]]),
+            max_new_tokens=n_new,
+        ))
+    results = engine.run([])
+    out = np.full((b, n_new), generation_config.pad_token_id, np.int32)
+    for i in range(b):
+        toks = results[i]
+        out[i, : len(toks)] = toks
+    return jnp.asarray(out)
+
+
 # ---------------------------------------------------------------------------
 # Beam search (decoder-only)
 # ---------------------------------------------------------------------------

@@ -240,6 +240,78 @@ def init_cache(config, batch_size: int, max_len: int, dtype=None):
     ]
 
 
+def init_paged_cache(config, num_pages: int, page_size: int, num_slots: int,
+                     pages_per_slot: int, dtype=None):
+    """Paged variant of :func:`init_cache` — the serving-core KV layout
+    (vLLM PagedAttention discipline; see ``accelerate_tpu/serving/``).
+
+    Instead of one dense ``[B, max_len]`` strip per sequence, K/V live in a
+    **preallocated pool of fixed-size pages** shared by every sequence:
+
+    - per layer: ``k_pages``/``v_pages`` ``[Hkv, num_pages, page_size, D]``
+      (head-major so the Pallas paged-decode kernel's blocks keep a
+      TPU-friendly ``(page_size, D)`` trailing tile);
+    - ``block_tables`` ``[num_slots, pages_per_slot]`` int32 — slot *i*'s
+      *j*-th logical page lives in physical page ``block_tables[i, j]``;
+    - ``seq_lens`` ``[num_slots]`` int32 tokens written per slot (0 = dead);
+    - ``free_stack``/``free_top`` — the device-side page allocator's free
+      list (``serving/paged_cache.py`` pops/pushes it functionally, so the
+      decode step stays jit- and donation-clean).
+
+    Liveness is positional, like the dense cache: a kv index is visible to a
+    query iff ``kv_index <= q_position``, and a slot's pages are only ever
+    read up to its own ``seq_len`` — recycled pages never need zeroing.
+    """
+    dtype = dtype or config.dtype
+    hkv, d = config.num_key_value_heads, config.head_dim
+    return {
+        "layers": [
+            {
+                "k_pages": jnp.zeros((hkv, num_pages, page_size, d), dtype),
+                "v_pages": jnp.zeros((hkv, num_pages, page_size, d), dtype),
+            }
+            for _ in range(config.num_hidden_layers)
+        ],
+        "block_tables": jnp.zeros((num_slots, pages_per_slot), jnp.int32),
+        "seq_lens": jnp.zeros((num_slots,), jnp.int32),
+        "free_stack": jnp.arange(num_pages, dtype=jnp.int32),
+        "free_top": jnp.asarray(num_pages, jnp.int32),
+    }
+
+
+def paged_gather_kv(k_pages, v_pages, block_tables):
+    """Gather a ``[B, S, Hkv, D]`` linear KV view through the block table.
+
+    ``k_pages``/``v_pages``: ``[Hkv, P, page, D]``; ``block_tables``:
+    ``[B, n]``.  Returns ``(k, v, kv_positions)`` with ``S = n * page`` and
+    ``kv_positions`` the within-sequence token index of every gathered slot
+    — ready for :func:`cached_attention`'s positional liveness mask (stale
+    pages beyond a slot's ``seq_len`` sit at positions the causal
+    comparison never admits)."""
+    hkv, _, page, d = k_pages.shape
+    b, n = block_tables.shape
+
+    def lin(pages):
+        g = pages[:, block_tables]                      # [Hkv, B, n, page, D]
+        return g.transpose(1, 2, 3, 0, 4).reshape(b, n * page, hkv, d)
+
+    kv_positions = jnp.broadcast_to(jnp.arange(n * page, dtype=jnp.int32), (b, n * page))
+    return lin(k_pages), lin(v_pages), kv_positions
+
+
+def paged_write_kv(pages, values, page_ids, offsets):
+    """Scatter per-token K or V rows into the page pool.
+
+    ``pages``: ``[Hkv, P, page, D]``; ``values``: ``[B, T, Hkv, D]``;
+    ``page_ids``/``offsets``: ``[B, T]`` int32 (masked tokens carry an
+    out-of-bounds page id and drop — the write-mask convention)."""
+    hkv, _, _, d = pages.shape
+    flat = values.reshape(-1, hkv, d).transpose(1, 0, 2)   # [Hkv, B*T, D]
+    return pages.at[:, page_ids.reshape(-1), offsets.reshape(-1)].set(
+        flat.astype(pages.dtype), mode="drop"
+    )
+
+
 def cached_attention(q, k_cache, v_cache, kv_positions, q_positions):
     """Decode-path attention against a pre-allocated KV cache.
 
@@ -302,6 +374,43 @@ class LlamaAttention(nn.Module):
         cos, sin = jnp.asarray(cos), jnp.asarray(sin)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
+
+        if cache is not None and "k_pages" in cache:
+            # paged serving path (serving/): write this chunk's K/V through
+            # the block table, then attend ragged against the gathered pages.
+            # Works for both serving shapes — batched decode ([S, 1]) and a
+            # single sequence's chunked prefill ([1, C]); liveness stays the
+            # positional kv_pos <= q_pos comparison of the dense path.
+            page_size = cache["k_pages"].shape[2]
+            pos_i32 = positions.astype(jnp.int32)
+            logical_page = pos_i32 // page_size
+            page_ids = jnp.take_along_axis(cache["block_tables"], logical_page, axis=1)
+            if cache_write_mask is not None:
+                # masked tokens (dead slots, prefill padding) write nowhere:
+                # an out-of-bounds page id drops the scatter
+                page_ids = jnp.where(cache_write_mask, page_ids,
+                                     cache["k_pages"].shape[1])
+            offsets = pos_i32 % page_size
+            k_pages = paged_write_kv(cache["k_pages"], k, page_ids, offsets)
+            v_pages = paged_write_kv(cache["v_pages"], v, page_ids, offsets)
+            if cfg.attn_implementation == "flash" and t == 1:
+                # batched single-token decode: the Pallas paged kernel walks
+                # each slot's pages through the block table (scalar-prefetch)
+                # without materializing the gathered window
+                from ..ops.flash_attention import paged_decode_attention
+
+                out = paged_decode_attention(
+                    q[:, 0], k_pages, v_pages, cache["block_tables"], pos_i32[:, 0]
+                )[:, None]
+            else:
+                k_lin, v_lin, kv_pos = paged_gather_kv(
+                    k_pages, v_pages, cache["block_tables"]
+                )
+                out = cached_attention(q, k_lin, v_lin, kv_pos, pos_i32)
+            new_cache = {"k_pages": k_pages, "v_pages": v_pages,
+                         "block_tables": cache["block_tables"]}
+            out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
+            return row(cfg.hidden_size, name="o_proj")(out), new_cache
 
         if cache is not None:
             # autoregressive path: write this chunk's K/V + positions at the
@@ -475,6 +584,11 @@ class LlamaForCausalLM(nn.Module):
         if positions is None:
             base = jnp.arange(input_ids.shape[1])
             if cache is not None:
+                if "index" not in cache[0]:
+                    raise ValueError(
+                        "paged layer caches have no global write index — pass "
+                        "explicit positions (the serving engine always does)"
+                    )
                 base = base + cache[0]["index"]
             positions = jnp.broadcast_to(base, input_ids.shape)
         embed = nn.Embed(

@@ -545,6 +545,131 @@ def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, segmented, positioned,
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Paged decode attention (the serving core's ragged kernel)
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scratch, l_scratch, acc_scratch,
+                         *, page_size, sm_scale):
+    """Grid: (slots, kv_heads, pages_per_slot); pages innermost/serial.
+
+    Each program attends one slot's GQA group of queries against ONE of its
+    KV pages, located through the scalar-prefetched block table (the
+    BlockSpec index_map already routed the right physical page into VMEM —
+    this body only sees a contiguous ``[page_size, D]`` tile).  Online
+    softmax accumulates across pages exactly like the dense flash kernel."""
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    pos = pos_ref[s]
+    kv_start = j * page_size
+
+    @pl.when(kv_start <= pos)
+    def _compute():
+        q = q_ref[0, 0]  # [group, D]
+        k = k_ref[0, 0]  # [page_size, D]
+        v = v_ref[0, 0]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [group, page_size]
+        idx = kv_start + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(idx <= pos, scores, DEFAULT_MASK_VALUE)
+        m_prev = m_scratch[:]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scratch[:] = alpha * l_scratch[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scratch[:] = m_new
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scratch[:]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scratch[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q,
+    k_pages,
+    v_pages,
+    block_tables,
+    positions,
+    *,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """Ragged single-token decode attention over a paged KV pool.
+
+    The serving core's hot op (``accelerate_tpu/serving/``): every decode
+    slot attends its own sequence, whose K/V live scattered across
+    fixed-size pages located by a block table — no dense per-sequence cache
+    strip, no gather materialization.  The block table and per-slot
+    positions ride as **scalar-prefetch** operands, so each grid step's
+    BlockSpec index_map DMAs exactly the one physical page the slot needs.
+
+    q: ``[S, H, D]`` (one token per slot); k_pages/v_pages:
+    ``[Hkv, P, page_size, D]``; block_tables: ``[S, n]`` int32; positions:
+    ``[S]`` int32 — the token's position, kv indices ``0..position`` are
+    live (dead slots simply mask everything and return zeros).  GQA runs
+    without repeating K/V, like :func:`flash_attention`.  Returns
+    ``[S, H, D]``.
+    """
+    s_slots, h, d = q.shape
+    hkv, num_pages, page_size, _ = k_pages.shape
+    if h % hkv != 0:
+        raise ValueError(f"num q heads {h} not divisible by kv heads {hkv}")
+    group = h // hkv
+    n = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("pallas tpu backend unavailable")
+
+    qg = q.reshape(s_slots, hkv, group, d)
+    bt_flat = block_tables.reshape(-1).astype(jnp.int32)
+    pos = positions.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_slots, hkv, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d), lambda s, h, j, bt, p: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda s, h, j, bt, p: (h, bt[s * n + j], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda s, h, j, bt, p: (h, bt[s * n + j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d), lambda s, h, j, bt, p: (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page_size=page_size, sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, hkv, group, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(bt_flat, pos, qg, k_pages, v_pages)
+    return out.reshape(s_slots, h, d)
+
+
 def flash_attention(
     q,
     k,

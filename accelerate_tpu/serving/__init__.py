@@ -1,0 +1,39 @@
+"""Serving core: paged KV cache + continuous batching (ROADMAP item 1).
+
+The production-inference rebuild of the reference's
+``inference.py``/``big_modeling.py`` contract — see docs/serving.md:
+
+- :mod:`.paged_cache` — functional device-side page allocator over the pool
+  built by :func:`accelerate_tpu.models.llama.init_paged_cache`;
+- :mod:`.scheduler` — deterministic continuous-batching policy (FIFO
+  admission, chunked prefill into shape buckets, youngest-first eviction);
+- :mod:`.engine` — the jitted, donation-clean prefill/decode/release
+  programs and the host-driven serving loop;
+- :mod:`.harness` — seeded traffic replay, serving metrics, and the
+  static-batching baseline.
+"""
+
+from .engine import ServingEngine
+from .harness import (
+    predicted_pool_utilization,
+    replay,
+    static_batching_report,
+    synthesize_trace,
+)
+from .paged_cache import allocate, kv_pool_accounting, pages_for, release
+from .scheduler import ContinuousBatchingScheduler, Request, SlotState
+
+__all__ = [
+    "ServingEngine",
+    "ContinuousBatchingScheduler",
+    "Request",
+    "SlotState",
+    "allocate",
+    "release",
+    "pages_for",
+    "kv_pool_accounting",
+    "synthesize_trace",
+    "replay",
+    "static_batching_report",
+    "predicted_pool_utilization",
+]

@@ -1,0 +1,404 @@
+"""The serving engine: paged-KV decode + continuous batching over one model.
+
+This is the production-scale rebuild of the reference's
+``inference.py``/``big_modeling.py`` contract (PAPER.md L5): where
+:func:`~accelerate_tpu.generation.generate` runs one fixed batch start-to-
+finish, the engine keeps a fixed set of **decode slots** and a fixed-size
+**page pool** busy under live traffic — requests are admitted, chunk-
+prefilled, decoded and retired *per step*, so a finished short request's
+slot and pages immediately serve the next arrival instead of padding out
+the longest sequence in the batch.
+
+Execution contract:
+
+- every device step is one of THREE jitted programs with **fixed shapes**
+  (one decode shape, one prefill shape per bucket, one release shape) — no
+  recompiles mid-traffic;
+- the cache pytree is **donated** through every step: pools update in place
+  (graft-lint GL101/GL201-clean — ``audit_decode_step`` checks on demand);
+- the decode loop is host-driven (tokens must surface per step for EOS/
+  stop handling anyway — the same shape as ``generate_streamed``'s loop);
+- sampling reuses :func:`~accelerate_tpu.generation.sample_logits`, so
+  greedy serving emits tokens identical to ``generate()`` (pinned by
+  tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..generation import GenerationConfig, sample_logits
+from ..models.llama import init_paged_cache
+from ..resilience import faults as _faults
+from ..utils.dataclasses import ServingPlugin
+from .paged_cache import allocate, pages_for, release
+from .scheduler import ContinuousBatchingScheduler, Request
+
+
+@lru_cache(maxsize=8)
+def _engine_fns(model, gen_config, page_size: int):
+    """The three jitted device programs, shared across engines of the same
+    (model, config, page geometry) — jax.jit caches per input shape, so
+    bucket widths and slot counts each compile exactly once per process."""
+    apply = model.apply
+
+    def decode_step(params, cache, tokens, active, rng):
+        # one token for every slot at once; dead slots write nowhere and
+        # their sampled token is ignored by the host
+        seq_lens = cache["seq_lens"]
+        pos = seq_lens
+        n_slots = tokens.shape[0]
+        need = active & (pos % page_size == 0)
+        block_tables, free_top = allocate(
+            cache["block_tables"], cache["free_stack"], cache["free_top"],
+            jnp.arange(n_slots, dtype=jnp.int32), pos // page_size, need,
+        )
+        layer_caches = [
+            {"k_pages": l["k_pages"], "v_pages": l["v_pages"],
+             "block_tables": block_tables}
+            for l in cache["layers"]
+        ]
+        logits, new_layers = apply(
+            params, tokens[:, None], positions=pos[:, None],
+            cache=layer_caches, cache_write_mask=active[:, None],
+        )
+        next_tok = sample_logits(logits[:, 0], rng, gen_config)
+        new_cache = {
+            "layers": [{"k_pages": l["k_pages"], "v_pages": l["v_pages"]}
+                       for l in new_layers],
+            "block_tables": block_tables,
+            "seq_lens": seq_lens + active.astype(jnp.int32),
+            "free_stack": cache["free_stack"],
+            "free_top": free_top,
+        }
+        return new_cache, next_tok
+
+    def prefill_step(params, cache, slot, chunk_ids, start, chunk_len):
+        # one bucket-padded chunk of one sequence's prompt; returns the
+        # logits of the chunk's last REAL token (the decode-loop seed once
+        # the prompt completes)
+        width = chunk_ids.shape[0]
+        positions = start + jnp.arange(width, dtype=jnp.int32)
+        wmask = jnp.arange(width) < chunk_len
+        need = wmask & (positions % page_size == 0)
+        block_tables, free_top = allocate(
+            cache["block_tables"], cache["free_stack"], cache["free_top"],
+            jnp.full((width,), slot, jnp.int32), positions // page_size, need,
+        )
+        row = jax.lax.dynamic_slice_in_dim(block_tables, slot, 1, axis=0)
+        layer_caches = [
+            {"k_pages": l["k_pages"], "v_pages": l["v_pages"], "block_tables": row}
+            for l in cache["layers"]
+        ]
+        logits, new_layers = apply(
+            params, chunk_ids[None], positions=positions[None],
+            cache=layer_caches, cache_write_mask=wmask[None],
+        )
+        last = jnp.take(logits[0], chunk_len - 1, axis=0)
+        new_cache = {
+            "layers": [{"k_pages": l["k_pages"], "v_pages": l["v_pages"]}
+                       for l in new_layers],
+            "block_tables": block_tables,
+            "seq_lens": cache["seq_lens"].at[slot].set(start + chunk_len),
+            "free_stack": cache["free_stack"],
+            "free_top": free_top,
+        }
+        return new_cache, last
+
+    def release_step(cache, mask):
+        seq_lens, free_stack, free_top = release(
+            cache["block_tables"], cache["seq_lens"], cache["free_stack"],
+            cache["free_top"], mask, page_size,
+        )
+        return {
+            "layers": cache["layers"],
+            "block_tables": cache["block_tables"],
+            "seq_lens": seq_lens,
+            "free_stack": free_stack,
+            "free_top": free_top,
+        }
+
+    def sample_first(last, rng):
+        return sample_logits(last[None], rng, gen_config)[0]
+
+    return (
+        jax.jit(decode_step, donate_argnums=(1,)),
+        jax.jit(prefill_step, donate_argnums=(1,)),
+        jax.jit(release_step, donate_argnums=(0,)),
+        jax.jit(sample_first),
+    )
+
+
+class ServingEngine:
+    """Continuous-batching serving over one model + param tree.
+
+    >>> engine = ServingEngine(model, params, plugin, generation_config)
+    >>> engine.add_request(Request(uid=0, prompt=(1, 2, 3), max_new_tokens=8))
+    >>> while not engine.idle():
+    ...     engine.step()
+    >>> engine.results[0]  # generated token ids
+
+    ``run(trace)`` replays a list of :class:`~.scheduler.Request` with
+    virtual-time arrivals (the traffic-replay harness's entry point).
+    """
+
+    def __init__(self, model, params, plugin: Optional[ServingPlugin] = None,
+                 generation_config: Optional[GenerationConfig] = None, rng=None):
+        self.plugin = plugin or ServingPlugin()
+        self.gen_config = generation_config or GenerationConfig()
+        if getattr(getattr(model, "config", None), "scan_layers", False):
+            from ..generation import _unrolled_view
+
+            model, params = _unrolled_view(model, params)
+        cfg = model.config
+        kernel = self.plugin.decode_kernel
+        if kernel == "auto":
+            kernel = "flash" if jax.default_backend() == "tpu" else "native"
+        if cfg.attn_implementation != kernel and kernel in ("native", "flash"):
+            cfg = dataclasses.replace(cfg, attn_implementation=kernel)
+            model = model.clone(config=cfg) if hasattr(model, "clone") else type(model)(cfg)
+        self.model = model
+        self.params = params
+        p = self.plugin
+        self.cache = init_paged_cache(
+            cfg, p.num_pages, p.page_size, p.num_slots, p.pages_per_slot
+        )
+        self.sched = ContinuousBatchingScheduler(
+            p.num_slots, p.num_pages, p.page_size, p.pages_per_slot,
+            p.prefill_chunk, p.prefill_buckets,
+        )
+        self._decode, self._prefill, self._release, self._sample = _engine_fns(
+            self.model, self.gen_config, p.page_size
+        )
+        self._base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.steps = 0
+        self.interrupted = False
+        self._undelivered: list[Request] = []
+        self.results: dict[int, list[int]] = {}
+        self._arrival_wall: dict[int, float] = {}
+        self._last_token_wall: dict[int, float] = {}
+        self._ttft_seen: set[int] = set()
+        self.metrics = {
+            "decode_steps": 0, "prefill_steps": 0, "idle_steps": 0,
+            "scheduled_decode_slots": 0, "useful_decode_tokens": 0,
+            "prefill_scheduled_tokens": 0, "prefill_useful_tokens": 0,
+            "evictions": 0, "page_step_sum": 0, "peak_used_pages": 0,
+            "prompt_tokens": 0, "generated_tokens": 0,
+        }
+        self.ttft_s: list[float] = []
+        self.token_gaps_s: list[float] = []
+
+    # -- request lifecycle ---------------------------------------------------
+
+    def add_request(self, request: Request) -> None:
+        self.sched.submit(request)
+        self._arrival_wall[request.uid] = time.perf_counter()
+
+    def idle(self) -> bool:
+        return self.sched.idle()
+
+    def unfinished_requests(self) -> list[Request]:
+        """Everything not yet finished — in admission order then queue order
+        (prompt intact, generated tokens discarded: the recompute-on-resume
+        contract a preemption drain relies on)."""
+        in_flight = [
+            self.sched.slots[s].request
+            for s in sorted(self.sched.slots, key=lambda s: self.sched.slots[s].admit_seq)
+        ]
+        return in_flight + list(self.sched.waiting)
+
+    def remaining_requests(self) -> list[Request]:
+        """After a drain: everything still owed — in-flight + queued +
+        trace arrivals the replay never delivered."""
+        return self.unfinished_requests() + list(self._undelivered)
+
+    # -- the engine tick -----------------------------------------------------
+
+    def step(self) -> dict:
+        """One scheduler decision + at most one device program."""
+        for ev in _faults.fault_point("serve_step"):
+            if ev.kind == "preempt":
+                # drain: stop taking work, hand every in-flight request back
+                # (the serving analog of the trainer's SIGTERM-at-step-
+                # boundary stop; resilience/preemption.py discipline)
+                self.interrupted = True
+                return {"type": "preempted", "step": self.steps}
+        self.sched.admit()
+        action = self.sched.next_action()
+        event: dict = {"type": action[0], "step": self.steps}
+        if action[0] == "prefill":
+            _, slot, start, chunk, bucket = action
+            survived, evicted = self.sched.plan_prefill_evictions(slot, chunk)
+            self._release_evicted(evicted)
+            if survived:
+                st = self.sched.slots[slot]
+                ids = np.zeros((bucket,), np.int32)
+                ids[:chunk] = st.request.prompt[start:start + chunk]
+                cache, last = self._prefill(
+                    self.params, self.cache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(ids), jnp.asarray(start, jnp.int32),
+                    jnp.asarray(chunk, jnp.int32),
+                )
+                self.cache = cache
+                self.sched.note_prefill(slot, chunk)
+                m = self.metrics
+                m["prefill_steps"] += 1
+                m["prefill_scheduled_tokens"] += bucket
+                m["prefill_useful_tokens"] += chunk
+                m["prompt_tokens"] += chunk
+                event.update(slot=slot, chunk=chunk, bucket=bucket)
+                if st.prefill_done:
+                    # the prompt's last-token logits seed the decode loop —
+                    # the first generated token, exactly like generate()
+                    tok = int(self._sample(last, self._step_rng()))
+                    m["generated_tokens"] += 1
+                    self._record_token(slot, tok)
+            else:
+                event["cancelled"] = True
+        elif action[0] == "decode":
+            active_slots, evicted = self.sched.plan_evictions(action[1])
+            self._release_evicted(evicted)
+            if active_slots:
+                needing = self.sched.decode_page_need(active_slots)
+                n = self.plugin.num_slots
+                tokens = np.zeros((n,), np.int32)
+                active = np.zeros((n,), bool)
+                for s in active_slots:
+                    tokens[s] = self.sched.slots[s].tokens[-1]
+                    active[s] = True
+                cache, next_tok = self._decode(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(active), self._step_rng(),
+                )
+                self.cache = cache
+                self.sched.note_decode(needing)
+                next_np = np.asarray(next_tok)
+                done_slots = []
+                for s in active_slots:
+                    if self._record_token(s, int(next_np[s]), release=False):
+                        done_slots.append(s)
+                if done_slots:
+                    self._release_slots(done_slots)
+                    self._finish_decode_slots(done_slots)
+                m = self.metrics
+                m["decode_steps"] += 1
+                m["scheduled_decode_slots"] += n
+                m["useful_decode_tokens"] += len(active_slots)
+                m["generated_tokens"] += len(active_slots)
+                event.update(slots=tuple(active_slots))
+            else:
+                event["cancelled"] = True
+        else:
+            self.metrics["idle_steps"] += 1
+        used = self.sched.used_pages
+        self.metrics["page_step_sum"] += used
+        self.metrics["peak_used_pages"] = max(self.metrics["peak_used_pages"], used)
+        self.steps += 1
+        return event
+
+    def run(self, trace: list[Request], max_steps: int = 200_000) -> dict[int, list[int]]:
+        """Replay ``trace`` (arrivals keyed on virtual step time) to
+        completion — or to the first injected preemption."""
+        pending = sorted(trace, key=lambda r: (r.arrival_step, r.uid))
+        i = 0
+        while True:
+            while i < len(pending) and pending[i].arrival_step <= self.steps:
+                self.add_request(pending[i])
+                i += 1
+            if self.interrupted or (self.idle() and i >= len(pending)):
+                break
+            self.step()
+            if self.steps >= max_steps:
+                raise RuntimeError(f"serving replay exceeded {max_steps} steps")
+        # arrivals that never reached the engine before a drain still count
+        # as unfinished work for the resume path
+        self._undelivered = pending[i:]
+        return self.results
+
+    # -- internals -----------------------------------------------------------
+
+    def _step_rng(self):
+        return jax.random.fold_in(self._base_rng, self.steps)
+
+    def _record_token(self, slot: int, tok: int, release: bool = True) -> bool:
+        """Append a sampled token; retire the sequence on EOS/max_new.
+        Returns True when the sequence finished (caller releases if it opted
+        out of the immediate release)."""
+        st = self.sched.slots[slot]
+        now = time.perf_counter()
+        uid = st.request.uid
+        if not st.tokens:
+            # once per request: an evicted-and-readmitted sequence must not
+            # re-sample its TTFT (the first life already delivered a token)
+            if uid not in self._ttft_seen:
+                self._ttft_seen.add(uid)
+                self.ttft_s.append(now - self._arrival_wall[uid])
+        elif uid in self._last_token_wall:
+            self.token_gaps_s.append(now - self._last_token_wall[uid])
+        self._last_token_wall[uid] = now
+        st.tokens.append(tok)
+        if not st.prefill_done:
+            raise AssertionError("token recorded before prefill completed")
+        eos = self.gen_config.eos_token_id
+        finished = (eos is not None and tok == eos) or \
+            len(st.tokens) >= st.request.max_new_tokens
+        if finished:
+            self.results[uid] = list(st.tokens)
+            # retire the per-request wall clocks: the serving loop is
+            # long-lived, so live-request bookkeeping must not grow with
+            # total requests served
+            self._arrival_wall.pop(uid, None)
+            self._last_token_wall.pop(uid, None)
+            self._ttft_seen.discard(uid)
+            if release:
+                self._release_slots([slot])
+                self.sched.finish(slot)
+            return True
+        return False
+
+    def _release_slots(self, slots: list[int]) -> None:
+        mask = np.zeros((self.plugin.num_slots,), bool)
+        mask[slots] = True
+        self.cache = self._release(self.cache, jnp.asarray(mask))
+
+    def _release_evicted(self, evicted: list[int]) -> None:
+        if evicted:
+            self._release_slots(evicted)
+            self.metrics["evictions"] += len(evicted)
+            # the evicted sequences' generated tokens were revoked: their
+            # inter-token clock must not bridge across the readmission
+            for req in self.sched.waiting:
+                self._last_token_wall.pop(req.uid, None)
+
+    def _finish_decode_slots(self, slots: list[int]) -> None:
+        for s in slots:
+            self.sched.finish(s)
+
+    # -- introspection --------------------------------------------------------
+
+    def audit_decode_step(self, **audit_kwargs):
+        """graft-lint jaxpr audit of the decode step (trace-only — the
+        donated pool buffers stay intact).  The pool update must come back
+        clean: donation fully consumed (no GL101), no in-trace transfers,
+        no donated-name reuse (the AST sweep covers GL201 separately)."""
+        from ..analysis import audit_jitted
+
+        n = self.plugin.num_slots
+        return audit_jitted(
+            self._decode, self.params, self.cache,
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            jax.ShapeDtypeStruct((n,), jnp.bool_),
+            self._base_rng, **audit_kwargs,
+        )
+
+    def free_page_mirror_in_sync(self) -> bool:
+        """Test hook: the host scheduler's free-page mirror equals the
+        device allocator's ``free_top`` (one scalar fetch)."""
+        return int(self.cache["free_top"]) == self.sched.free_pages

@@ -1,0 +1,197 @@
+"""Traffic-replay harness: seeded traces, serving metrics, and the
+static-batching baseline.
+
+The bench contract (``bench.py --serve``): replay a **seeded request trace**
+(Poisson arrivals in virtual engine-step time, mixed prompt/output lengths)
+through a :class:`~.engine.ServingEngine` and ALWAYS emit the serving
+fields — tokens/s/chip, p50/p99 per-token latency, KV-pool utilization
+(predicted + measured, CheckFreq-style twins), padding-waste fraction, and
+scheduler occupancy — zeros when the trace is empty, so BENCH_*.json can
+track them across rounds.
+
+The **static-batching baseline** is the CPU-measurable proxy for the
+continuous-batching win: it re-runs the same per-request work (actual
+prompt and generated lengths from the measured run) through the
+fixed-batch schedule ``generate()`` implies — pad every prompt to the
+batch max, decode until the LAST sequence finishes, only then start the
+next batch — and counts scheduled vs useful token-slots.  Padding waste
+and scheduled-token efficiency compare directly; wall-clock tokens/s needs
+a chip to differ meaningfully, the slot arithmetic does not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .paged_cache import pages_for
+from .scheduler import Request
+
+
+def synthesize_trace(
+    seed: int,
+    n_requests: int,
+    *,
+    vocab_size: int = 256,
+    mean_interarrival_steps: float = 2.0,
+    prompt_len_range: tuple = (4, 24),
+    new_tokens_range: tuple = (2, 16),
+) -> list[Request]:
+    """A deterministic request trace: Poisson arrivals (exponential gaps in
+    virtual engine-step time) with uniformly mixed prompt/output lengths.
+    Same seed -> same trace, always (the scheduler-determinism contract)."""
+    rng = np.random.default_rng(seed)
+    trace = []
+    t = 0.0
+    for uid in range(n_requests):
+        t += rng.exponential(mean_interarrival_steps)
+        p_len = int(rng.integers(prompt_len_range[0], prompt_len_range[1] + 1))
+        n_new = int(rng.integers(new_tokens_range[0], new_tokens_range[1] + 1))
+        prompt = tuple(int(x) for x in rng.integers(1, vocab_size, p_len))
+        trace.append(Request(uid=uid, prompt=prompt, max_new_tokens=n_new,
+                             arrival_step=int(t)))
+    return trace
+
+
+def _percentile_ms(samples: list, q: float) -> float:
+    if not samples:
+        return 0.0
+    return round(float(np.percentile(np.asarray(samples), q)) * 1e3, 3)
+
+
+def predicted_pool_utilization(trace: list[Request], *, num_slots: int,
+                               num_pages: int, page_size: int,
+                               pages_per_slot: int, prefill_chunk: int) -> float:
+    """CheckFreq-style *predicted* twin of the measured KV-pool utilization:
+    a model-free replay of the scheduler arithmetic over the trace,
+    assuming every request runs to its full ``max_new_tokens`` (the
+    prediction error vs the measured twin is exactly the EOS-early-exit
+    traffic the trace cannot know about)."""
+    if not trace:
+        return 0.0
+    from .scheduler import ContinuousBatchingScheduler
+
+    sched = ContinuousBatchingScheduler(
+        num_slots, num_pages, page_size, pages_per_slot, prefill_chunk,
+        (prefill_chunk,),
+    )
+    pending = sorted(trace, key=lambda r: (r.arrival_step, r.uid))
+    i, steps, page_step_sum = 0, 0, 0
+    while True:
+        while i < len(pending) and pending[i].arrival_step <= steps:
+            sched.submit(pending[i])
+            i += 1
+        if sched.idle() and i >= len(pending):
+            break
+        sched.admit()
+        action = sched.next_action()
+        if action[0] == "prefill":
+            slot, start, chunk = action[1], action[2], action[3]
+            survived, _ = sched.plan_prefill_evictions(slot, chunk)
+            if survived:
+                sched.note_prefill(slot, chunk)
+                st = sched.slots[slot]
+                if st.prefill_done:
+                    st.tokens.append(0)
+                    if len(st.tokens) >= st.request.max_new_tokens:
+                        sched.finish(slot)
+        elif action[0] == "decode":
+            active, _ = sched.plan_evictions(action[1])
+            if active:
+                sched.note_decode(sched.decode_page_need(active))
+                done = []
+                for s in active:
+                    st = sched.slots[s]
+                    st.tokens.append(0)
+                    if len(st.tokens) >= st.request.max_new_tokens:
+                        done.append(s)
+                for s in done:
+                    sched.finish(s)
+        page_step_sum += sched.used_pages
+        steps += 1
+        if steps > 1_000_000:  # pragma: no cover - trace arithmetic safety net
+            break
+    return round(page_step_sum / max(steps, 1) / num_pages, 4)
+
+
+def replay(engine, trace: list[Request]) -> dict:
+    """Run the trace through the engine and compose the serving report.
+    Every field is always present (zeros on an empty/idle trace)."""
+    import time
+
+    t0 = time.perf_counter()
+    results = engine.run(trace)
+    wall_s = time.perf_counter() - t0
+    m = engine.metrics
+    p = engine.plugin
+    import jax
+
+    n_chips = jax.device_count()
+    scheduled = m["scheduled_decode_slots"] + m["prefill_scheduled_tokens"]
+    useful = m["useful_decode_tokens"] + m["prefill_useful_tokens"]
+    work_steps = m["decode_steps"] + m["prefill_steps"]
+    total_steps = work_steps + m["idle_steps"]
+    gen = m["generated_tokens"]
+    predicted_util = predicted_pool_utilization(
+        trace, num_slots=p.num_slots, num_pages=p.num_pages,
+        page_size=p.page_size, pages_per_slot=p.pages_per_slot,
+        prefill_chunk=p.prefill_chunk,
+    )
+    return {
+        "requests": len(trace),
+        "completed": len(results),
+        "interrupted": engine.interrupted,
+        "prompt_tokens": m["prompt_tokens"],
+        "generated_tokens": gen,
+        "wall_s": round(wall_s, 4),
+        "tokens_per_sec": round(gen / wall_s, 2) if wall_s > 0 else 0.0,
+        "tokens_per_sec_per_chip": round(gen / wall_s / n_chips, 2) if wall_s > 0 else 0.0,
+        "p50_token_latency_ms": _percentile_ms(engine.token_gaps_s, 50),
+        "p99_token_latency_ms": _percentile_ms(engine.token_gaps_s, 99),
+        "ttft_p50_ms": _percentile_ms(engine.ttft_s, 50),
+        "kv_pool_utilization": round(
+            m["page_step_sum"] / max(total_steps, 1) / p.num_pages, 4),
+        "kv_pool_utilization_predicted": predicted_util,
+        "kv_pool_peak_utilization": round(m["peak_used_pages"] / p.num_pages, 4),
+        "padding_waste_frac": round(1.0 - useful / scheduled, 4) if scheduled else 0.0,
+        "scheduled_token_efficiency": round(useful / scheduled, 4) if scheduled else 0.0,
+        "scheduler_occupancy": round(work_steps / max(total_steps, 1), 4),
+        "engine_steps": total_steps,
+        "decode_steps": m["decode_steps"],
+        "prefill_steps": m["prefill_steps"],
+        "idle_steps": m["idle_steps"],
+        "evictions": m["evictions"],
+        "prefill_buckets": list(p.prefill_buckets),
+        "num_slots": p.num_slots,
+        "results": results,
+    }
+
+
+def static_batching_report(per_request: list, num_slots: int) -> dict:
+    """Slot-arithmetic for the fixed-batch schedule ``generate()`` implies.
+
+    ``per_request``: ``(prompt_len, generated_len)`` pairs in arrival order
+    — use the MEASURED lengths from the continuous run so both schedules
+    account identical work.  Batches of ``num_slots`` run start-to-finish:
+    prompts pad to the batch max, decode runs until the batch's longest
+    generation finishes.  Every batch is the full ``num_slots`` wide — both
+    schedules drive the SAME fixed-shape jitted decode program (the shape-
+    bucket contract); static batching just cannot refill a lane until the
+    whole batch retires.
+    """
+    if not per_request:
+        return {"padding_waste_frac": 0.0, "scheduled_token_efficiency": 0.0,
+                "scheduled_token_slots": 0, "useful_tokens": 0, "batches": 0}
+    scheduled = useful = 0
+    batches = [per_request[i:i + num_slots] for i in range(0, len(per_request), num_slots)]
+    for batch in batches:
+        max_prompt = max(p for p, _ in batch)
+        max_gen = max(g for _, g in batch)
+        scheduled += (max_prompt + max_gen) * num_slots
+        useful += sum(p + g for p, g in batch)
+    return {
+        "padding_waste_frac": round(1.0 - useful / scheduled, 4),
+        "scheduled_token_efficiency": round(useful / scheduled, 4),
+        "scheduled_token_slots": scheduled,
+        "useful_tokens": useful,
+        "batches": len(batches),
+    }

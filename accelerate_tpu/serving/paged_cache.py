@@ -1,0 +1,104 @@
+"""Functional device-side page allocator for the paged KV cache.
+
+The pool itself is built by :func:`~accelerate_tpu.models.llama.init_paged_cache`
+(fixed-size pages, per-slot block tables, a free-list stack).  This module is
+the allocator arithmetic that mutates that structure **functionally** — every
+operation is ``jnp`` index math on arrays the serving step carries through
+``donate_argnums``, so the jitted decode/prefill steps stay donation-clean
+(graft-lint GL101/GL201: the pool buffers alias in place, and no Python name
+outlives its donation).
+
+Design notes (vLLM PagedAttention discipline):
+
+- ``free_stack``/``free_top`` form a stack of free physical page ids.  Pops
+  never rewrite the stack (entries above ``free_top`` are dead); pushes
+  overwrite dead entries.  Both directions are scatter/gather with computed
+  ranks, so a *batch* of slots allocates/releases in one fused op.
+- Masked lanes route their scatter index out of bounds and drop
+  (``mode="drop"``) — the write-mask convention shared with the model's
+  paged attention path.
+- Exhaustion is the **scheduler's** job: the host mirrors the free count
+  deterministically (same arithmetic on the same trace) and evicts before a
+  pop could underflow; :func:`allocate` clamps indices so even a scheduler
+  bug corrupts allocation, not memory safety.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pages_for(tokens, page_size: int):
+    """Pages needed to hold ``tokens`` tokens (ceil division; 0 -> 0)."""
+    return -(-tokens // page_size)
+
+
+def allocate(block_tables, free_stack, free_top, slots, logical_pages, need):
+    """Pop one page per needing lane and write it into the block table.
+
+    ``slots``/``logical_pages``/``need``: aligned ``[K]`` arrays — lane *i*
+    asks for a fresh physical page at ``block_tables[slots[i],
+    logical_pages[i]]`` iff ``need[i]``.  Returns ``(block_tables,
+    free_top)``; ``free_stack`` itself is untouched (pops only move the
+    top).  Lanes with ``need=False`` drop their scatter.
+    """
+    need = need.astype(bool)
+    rank = jnp.cumsum(need.astype(jnp.int32)) - 1           # 0-based grab order
+    src = jnp.clip(free_top - 1 - rank, 0, free_stack.shape[0] - 1)
+    pages = free_stack[src]
+    rows = jnp.where(need, slots, block_tables.shape[0])    # OOB -> drop
+    block_tables = block_tables.at[rows, logical_pages].set(pages, mode="drop")
+    return block_tables, free_top - jnp.sum(need.astype(jnp.int32))
+
+
+def release(block_tables, seq_lens, free_stack, free_top, release_mask, page_size: int):
+    """Push every page owned by the masked slots back onto the free stack.
+
+    A slot owns ``ceil(seq_len / page_size)`` pages (its block-table prefix).
+    Returns ``(seq_lens, free_stack, free_top)`` with released slots' lengths
+    zeroed — the block-table rows are left stale on purpose: the positional
+    liveness mask never reads past ``seq_len``, so the next tenant just
+    overwrites them.
+    """
+    release_mask = release_mask.astype(bool)
+    n = block_tables.shape[1]
+    owned = release_mask[:, None] & (
+        jnp.arange(n)[None, :] < pages_for(seq_lens, page_size)[:, None]
+    )
+    flat_owned = owned.reshape(-1)
+    rank = jnp.cumsum(flat_owned.astype(jnp.int32)) - 1
+    dst = jnp.where(flat_owned, free_top + rank, free_stack.shape[0])  # OOB -> drop
+    free_stack = free_stack.at[dst].set(block_tables.reshape(-1), mode="drop")
+    free_top = free_top + jnp.sum(flat_owned.astype(jnp.int32))
+    seq_lens = jnp.where(release_mask, 0, seq_lens)
+    return seq_lens, free_stack, free_top
+
+
+def kv_pool_accounting(config, num_pages: int, page_size: int, dtype_bytes: int = 2) -> dict:
+    """Predicted KV-HBM ladder for a pool geometry (CheckFreq-style
+    predicted twin; the measured counterpart is the harness's
+    ``kv_pool_utilization``).
+
+    bytes/page is per *physical page across all layers* — the unit the
+    allocator hands out: ``2 (K+V) * L * page_size * Hkv * D * dtype``.
+    """
+    per_page = (
+        2 * config.num_hidden_layers * page_size
+        * config.num_key_value_heads * config.head_dim * dtype_bytes
+    )
+    total = per_page * num_pages
+    gib = lambda b: round(b / 2**30, 4)
+    return {
+        "page_size_tokens": page_size,
+        "num_pages": num_pages,
+        "bytes_per_page": per_page,
+        "pool_bytes": total,
+        "pool_gib": gib(total),
+        "tokens_capacity": num_pages * page_size,
+        # the ladder: how much of each chip generation's HBM the pool takes
+        "hbm_frac": {
+            "v5e_16GiB": round(total / (16 * 2**30), 6),
+            "v5p_95GiB": round(total / (95 * 2**30), 6),
+            "v6e_32GiB": round(total / (32 * 2**30), 6),
+        },
+    }

@@ -1,0 +1,70 @@
+"""Scoped JAX persistent compilation-cache management.
+
+The suite and the bench are compile-dominated on CPU hosts, so both lean on
+``jax_compilation_cache_dir`` — but one flat ``/tmp`` directory shared by
+every process proved fragile: **concurrent jax processes corrupt the shared
+cache** (documented segfault/garbage flakes on this rig), and entries from a
+different jax build are dead weight at best.  This module gives every run a
+**scoped** cache directory instead (the first slice of ROADMAP item 4's
+compilation-cache management):
+
+- keyed by ``jax``/Python version, so an upgraded toolchain never reads a
+  stale cache;
+- keyed by a **tag** per harness (``tests``, ``bench``, ...), so the suite
+  and bench subprocesses never share a directory;
+- optionally keyed by a **scope** for concurrent runs: the
+  ``ACCELERATE_JAX_CACHE_SCOPE`` env var, or — automatically — the
+  pytest-xdist worker id, so parallel test workers each get a private cache
+  (the exact shape of the documented corruption).
+
+``ACCELERATE_JAX_CACHE_ROOT`` moves the whole tree off ``/tmp``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+from typing import Optional
+
+
+def scoped_cache_dir(tag: str = "run", root: Optional[str] = None) -> str:
+    """The scoped cache directory for this (toolchain, tag, scope) — created
+    if missing, returned as a string path."""
+    import jax
+
+    root = root or os.environ.get(
+        "ACCELERATE_JAX_CACHE_ROOT", "/tmp/accelerate_tpu_jax_cache"
+    )
+    version_key = (
+        f"jax{jax.__version__}-py{sys.version_info.major}.{sys.version_info.minor}"
+    )
+    scope = os.environ.get("ACCELERATE_JAX_CACHE_SCOPE") or os.environ.get(
+        "PYTEST_XDIST_WORKER", ""
+    )
+    leaf = f"{tag}-{scope}" if scope else tag
+    path = Path(root) / version_key / leaf
+    path.mkdir(parents=True, exist_ok=True)
+    return str(path)
+
+
+def enable_scoped_compilation_cache(
+    tag: str = "run",
+    *,
+    root: Optional[str] = None,
+    min_compile_time_secs: float = 0.5,
+    min_entry_size_bytes: int = 0,
+) -> Optional[str]:
+    """Point jax's persistent compilation cache at the scoped directory.
+    Returns the directory, or ``None`` when this jax build lacks the knobs
+    (older releases — the run proceeds uncached, never fails)."""
+    import jax
+
+    try:
+        d = scoped_cache_dir(tag, root)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_time_secs)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", min_entry_size_bytes)
+        return d
+    except Exception:  # pragma: no cover - older jax without the knobs
+        return None

@@ -442,6 +442,88 @@ class ResiliencePlugin(KwargsHandler):
 
 
 @dataclass
+class ServingPlugin(KwargsHandler):
+    """Serving-core knobs (engine: ``accelerate_tpu/serving/`` — paged KV
+    cache + continuous batching; see docs/serving.md).
+
+    Geometry defaults target the CPU test mesh; production configs size the
+    pool off the predicted KV-HBM ladder
+    (``serving.paged_cache.kv_pool_accounting``).  Every knob reads an
+    ``ACCELERATE_SERVE_*`` environment default in ``__post_init__`` (explicit
+    arguments always win — the reference plugin contract).
+    """
+
+    num_slots: Optional[int] = None          # concurrent decode lanes
+                                             # (env ACCELERATE_SERVE_SLOTS, default 8)
+    page_size: Optional[int] = None          # tokens per KV page
+                                             # (env ACCELERATE_SERVE_PAGE_SIZE, default 16)
+    pages_per_slot: Optional[int] = None     # block-table width = per-sequence KV
+                                             # ceiling in pages (env
+                                             # ACCELERATE_SERVE_PAGES_PER_SLOT, default 8)
+    num_pages: Optional[int] = None          # pool size; default provisions ~half the
+                                             # worst case (num_slots * pages_per_slot
+                                             # // 2) — continuous batching's bet that
+                                             # sequences rarely all peak together
+                                             # (env ACCELERATE_SERVE_PAGES)
+    prefill_chunk: Optional[int] = None      # max prompt tokens prefilled per engine
+                                             # tick (chunked prefill; env
+                                             # ACCELERATE_SERVE_PREFILL_CHUNK, default 64)
+    prefill_buckets: Optional[tuple] = None  # pad-to-bucket widths for the jitted
+                                             # prefill step — one compile per bucket,
+                                             # never a recompile mid-traffic.  Default:
+                                             # powers of two from 16 up to prefill_chunk.
+    decode_kernel: str = ""                  # "auto" (paged Pallas kernel on TPU,
+                                             # native gather elsewhere) | "native" |
+                                             # "flash" (env ACCELERATE_SERVE_KERNEL)
+
+    def __post_init__(self):
+        env = os.environ
+        if self.num_slots is None:
+            self.num_slots = int(env.get("ACCELERATE_SERVE_SLOTS", 8))
+        if self.page_size is None:
+            self.page_size = int(env.get("ACCELERATE_SERVE_PAGE_SIZE", 16))
+        if self.pages_per_slot is None:
+            self.pages_per_slot = int(env.get("ACCELERATE_SERVE_PAGES_PER_SLOT", 8))
+        if self.num_pages is None:
+            env_pages = env.get("ACCELERATE_SERVE_PAGES")
+            self.num_pages = (int(env_pages) if env_pages
+                              else max(self.pages_per_slot,
+                                       self.num_slots * self.pages_per_slot // 2))
+        if self.prefill_chunk is None:
+            self.prefill_chunk = int(env.get("ACCELERATE_SERVE_PREFILL_CHUNK", 64))
+        if not self.decode_kernel:
+            self.decode_kernel = env.get("ACCELERATE_SERVE_KERNEL", "auto")
+        if self.decode_kernel not in ("auto", "native", "flash"):
+            raise ValueError(
+                f"decode_kernel must be 'auto', 'native' or 'flash', got "
+                f"{self.decode_kernel!r}"
+            )
+        for name in ("num_slots", "page_size", "pages_per_slot", "num_pages",
+                     "prefill_chunk"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"ServingPlugin.{name} must be >= 1, got {getattr(self, name)}")
+        if self.num_pages < self.pages_per_slot:
+            raise ValueError(
+                f"num_pages={self.num_pages} must cover at least one sequence "
+                f"(pages_per_slot={self.pages_per_slot})"
+            )
+        if self.prefill_buckets is None:
+            buckets, b = [], 16
+            while b < self.prefill_chunk:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.prefill_chunk)
+            self.prefill_buckets = tuple(buckets)
+        else:
+            self.prefill_buckets = tuple(sorted(int(b) for b in self.prefill_buckets))
+            if not self.prefill_buckets or self.prefill_buckets[-1] < self.prefill_chunk:
+                raise ValueError(
+                    f"prefill_buckets {self.prefill_buckets} must include a bucket "
+                    f">= prefill_chunk={self.prefill_chunk}"
+                )
+
+
+@dataclass
 class TensorParallelConfig(KwargsHandler):
     """reference TorchTensorParallelConfig dataclasses.py:2264.
 

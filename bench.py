@@ -387,6 +387,80 @@ def plan_infer_report(n_devices: int, seq: int, batch: int):
     }
 
 
+def serve_report(args) -> dict:
+    """``--serve``: replay a seeded request trace (Poisson arrivals, mixed
+    prompt/output lengths) through the continuous-batching serving engine
+    (accelerate_tpu/serving/) and report the serving fields — ALWAYS all of
+    them (tokens/s/chip, p50/p99 per-token latency, KV-pool utilization
+    predicted+measured, padding-waste fraction, scheduler occupancy), zeros
+    when the trace is empty, so BENCH_*.json tracks them across rounds.
+    The static-batching twin re-counts the SAME measured per-request work
+    under the fixed-batch schedule — the CPU-measurable proxy continuous
+    batching must beat on padding waste and scheduled-token efficiency."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.serving import (
+        ServingEngine, kv_pool_accounting, replay, static_batching_report,
+        synthesize_trace,
+    )
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # the 600m-class decode shape (the headline bench's model family);
+        # pool sized off the KV-HBM ladder, paged Pallas decode kernel
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=4096, attn_implementation="flash",
+            dtype=jnp.bfloat16,
+        )
+        plugin = ServingPlugin(
+            num_slots=args.batch or 16, page_size=64, pages_per_slot=32,
+            num_pages=(args.batch or 16) * 16, prefill_chunk=512,
+        )
+        prompt_range, new_range = (64, 512), (32, 256)
+    else:  # CPU-tiny smoke shape (the --batch 8 convention)
+        cfg = LlamaConfig.tiny()
+        plugin = ServingPlugin(
+            num_slots=args.batch or 4, page_size=4, pages_per_slot=16,
+            num_pages=(args.batch or 4) * 10, prefill_chunk=16,
+            decode_kernel="native",
+        )
+        prompt_range, new_range = (4, 24), (4, 24)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+    trace = synthesize_trace(
+        args.serve_seed, args.serve_requests, vocab_size=cfg.vocab_size,
+        mean_interarrival_steps=0.5, prompt_len_range=prompt_range,
+        new_tokens_range=new_range,
+    )
+    gen_cfg = GenerationConfig(max_new_tokens=new_range[1])
+    engine = ServingEngine(model, params, plugin, gen_cfg)
+    rep = replay(engine, trace)
+    results = rep.pop("results")
+    per_request = [(len(r.prompt), len(results.get(r.uid, ()))) for r in trace]
+    rep["static_baseline"] = static_batching_report(per_request, plugin.num_slots)
+    rep["kv_pool"] = kv_pool_accounting(
+        cfg, plugin.num_pages, plugin.page_size,
+        dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+    )
+    rep["serve_seed"] = args.serve_seed
+    rep["decode_kernel"] = engine.model.config.attn_implementation
+    rep["backend"] = jax.default_backend()
+    rep["device"] = getattr(jax.devices()[0], "device_kind", "?")
+    rep["n_devices"] = jax.device_count()
+    return {
+        "metric": "serving_tokens_per_sec_per_chip",
+        "value": rep["tokens_per_sec_per_chip"],
+        "unit": "tokens/s/chip",
+        "extra": rep,
+    }
+
+
 def main():
     import argparse
 
@@ -469,6 +543,21 @@ def main():
                     help="skip the loadavg + calibration quiet-box gate on the "
                          "host-bound offload configs (the gate only warns, never "
                          "refuses, but costs ~1s)")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-core traffic replay instead of the train "
+                         "bench: a seeded request trace (Poisson arrivals, "
+                         "mixed lengths) runs through the paged-KV "
+                         "continuous-batching engine; ALWAYS emits "
+                         "tokens/s/chip, p50/p99 per-token latency, KV-pool "
+                         "utilization (predicted+measured), padding-waste "
+                         "fraction and scheduler occupancy (zeros when the "
+                         "trace is empty), plus the static-batching twin. "
+                         "--batch sets the decode-slot count")
+    ap.add_argument("--serve-requests", type=int, default=16,
+                    help="trace length for --serve (0 = idle-engine report)")
+    ap.add_argument("--serve-seed", type=int, default=0,
+                    help="trace seed for --serve (same seed -> same trace "
+                         "-> same schedule, pinned by the determinism test)")
     ap.add_argument("--plan", type=int, default=None, metavar="N",
                     help="print the abstract per-device memory plan for an N-chip mesh and exit")
     ap.add_argument("--plan-task", choices=["train", "infer"], default="train",
@@ -504,12 +593,16 @@ def main():
         return
 
     # persistent compile cache: repeat bench runs (and driver rounds) skip
-    # the 30-40s first-compile of the train step
-    try:
-        jax.config.update("jax_compilation_cache_dir", "/tmp/accelerate_tpu_jax_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    # the 30-40s first-compile of the train step.  Scoped per toolchain +
+    # harness tag (utils/compile_cache.py) so bench never shares a cache dir
+    # with the test suite — the documented /tmp corruption shape.
+    from accelerate_tpu.utils.compile_cache import enable_scoped_compilation_cache
+
+    enable_scoped_compilation_cache("bench", min_compile_time_secs=1.0)
+
+    if args.serve:
+        print(json.dumps(serve_report(args)))
+        return
 
     from accelerate_tpu import Accelerator, ParallelismConfig
     from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM, make_llama_loss_fn

@@ -35,7 +35,8 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
     "big_modeling": ("accelerate_tpu.big_modeling", [
         "init_empty_weights", "abstract_init", "init_params_leafwise",
         "infer_auto_placement", "load_checkpoint_in_model",
-        "load_checkpoint_and_dispatch", "dispatch_model", "OffloadStore",
+        "load_checkpoint_and_dispatch", "load_checkpoint_and_serve",
+        "serve_model", "dispatch_model", "OffloadStore",
         "offload_store_params",
     ]),
     "pipeline": ("accelerate_tpu.parallel.pipeline_parallel", [
@@ -47,8 +48,14 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "write_checkpoint_manifest", "CheckpointCorruptError",
     ]),
     "generation": ("accelerate_tpu.generation", [
-        "generate", "beam_search", "generate_streamed", "place_params_host",
-        "GenerationConfig",
+        "generate", "beam_search", "generate_streamed", "generate_paged",
+        "place_params_host", "GenerationConfig",
+    ]),
+    "serving": ("accelerate_tpu.serving", [
+        "ServingEngine", "ContinuousBatchingScheduler", "Request", "SlotState",
+        "allocate", "release", "pages_for", "kv_pool_accounting",
+        "synthesize_trace", "replay", "static_batching_report",
+        "predicted_pool_utilization",
     ]),
     "tracking": ("accelerate_tpu.tracking", [
         "GeneralTracker", "JSONLTracker", "TensorBoardTracker", "WandBTracker",
@@ -93,7 +100,7 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
     ]),
     "dataclasses": ("accelerate_tpu.utils.dataclasses", [
         "GradSyncKwargs", "ProfileKwargs", "GradientAccumulationPlugin",
-        "FullyShardedDataParallelPlugin", "ResiliencePlugin",
+        "FullyShardedDataParallelPlugin", "ResiliencePlugin", "ServingPlugin",
         "ProjectConfiguration", "DataLoaderConfiguration",
         "InitProcessGroupKwargs",
     ]),

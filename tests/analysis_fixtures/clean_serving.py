@@ -1,0 +1,46 @@
+"""CLEAN serving-decode twins — the donation-clean step shape the real
+engine uses (``serving/engine.py``).
+
+Each function mirrors one in ``planted_serving.py`` with the hazard
+retired: post-step reads go through the RETURNED cache (the donated name is
+dead after the call), and the step returns the updated pool so the donated
+buffers alias outputs in place.  graft-lint must stay quiet on every
+function here.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _decode(cache, token):
+    k_pages = cache["k_pages"].at[0, 0].set(token)
+    logits = jnp.sum(k_pages, axis=(0, 1))
+    return {"k_pages": k_pages, "seq_lens": cache["seq_lens"] + 1}, logits
+
+
+jitted_decode = jax.jit(_decode, donate_argnums=(0,))
+
+
+def serve_step_reuses_donated_cache(cache, token):
+    # the returned structure is the only live view of the pool
+    new_cache, logits = jitted_decode(cache, token)
+    used_pages = new_cache["seq_lens"].sum()
+    return new_cache, logits, used_pages
+
+
+def decode_step_drops_pool(cache, token):
+    """Returns the updated pool alongside the logits: every donated buffer
+    aliases an output of the same byte size — the donation is consumed."""
+    k_pages = cache["k_pages"].at[0, 0].set(token)
+    return {"k_pages": k_pages, "seq_lens": cache["seq_lens"]}, jnp.sum(k_pages, axis=(0, 1))
+
+
+def example_args():
+    cache = {
+        "k_pages": jnp.zeros((4, 8, 16), jnp.float32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "serve_step_reuses_donated_cache": (cache, jnp.ones((16,), jnp.float32)),
+        "decode_step_drops_pool": (cache, jnp.ones((16,), jnp.float32)),
+    }

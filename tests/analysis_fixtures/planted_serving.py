@@ -1,0 +1,51 @@
+"""PLANTED serving-decode fixtures — the donation hazards the paged-KV
+serving step must never ship with.
+
+The serving engine's decode step donates the whole cache pytree (pool
+arrays update in place); these functions carry the two ways that contract
+breaks: reading the donated pool after the step (GL201 — the async-ckpt
+race shape applied to serving) and a step whose outputs cannot alias the
+donated pool (GL101, wasted donation).  Corrected twins:
+``clean_serving.py``.  Excluded from repo-wide sweeps like the rest of this
+directory.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _decode(cache, token):
+    k_pages = cache["k_pages"].at[0, 0].set(token)
+    logits = jnp.sum(k_pages, axis=(0, 1))
+    return {"k_pages": k_pages, "seq_lens": cache["seq_lens"] + 1}, logits
+
+
+jitted_decode = jax.jit(_decode, donate_argnums=(0,))
+
+
+def serve_step_reuses_donated_cache(cache, token):
+    # GL201: `cache`'s pool buffers were donated to the step — XLA may
+    # already be overwriting them in place when this utilization probe reads
+    # seq_lens off the STALE structure instead of the returned one
+    new_cache, logits = jitted_decode(cache, token)
+    used_pages = cache["seq_lens"].sum()
+    return new_cache, logits, used_pages
+
+
+def decode_step_drops_pool(cache, token):
+    """GL101 (the test jits with donate_argnums=(0,)): only the logits come
+    back — no output can alias the donated page pool, so the donation frees
+    nothing and the caller still loses the cache."""
+    k_pages = cache["k_pages"].at[0, 0].set(token)
+    return jnp.sum(k_pages, axis=(0, 1))
+
+
+def example_args():
+    cache = {
+        "k_pages": jnp.zeros((4, 8, 16), jnp.float32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "serve_step_reuses_donated_cache": (cache, jnp.ones((16,), jnp.float32)),
+        "decode_step_drops_pool": (cache, jnp.ones((16,), jnp.float32)),
+    }

@@ -30,12 +30,13 @@ if os.environ["JAX_PLATFORMS"] == "cpu":
 
 # The suite is compile-dominated (single-core host); the persistent cache
 # makes every run after the first skip recompiles of unchanged programs.
-try:
-    jax.config.update("jax_compilation_cache_dir", "/tmp/accelerate_tpu_test_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-except Exception:  # pragma: no cover - older jax without the knobs
-    pass
+# SCOPED per (jax version, harness tag, worker): concurrent jax processes
+# sharing one flat /tmp dir corrupted it on this rig (documented flake) —
+# utils/compile_cache.py keys the dir by toolchain + tag, and gives each
+# pytest-xdist worker (or ACCELERATE_JAX_CACHE_SCOPE) a private cache.
+from accelerate_tpu.utils.compile_cache import enable_scoped_compilation_cache  # noqa: E402
+
+enable_scoped_compilation_cache("tests")
 
 import pytest  # noqa: E402
 

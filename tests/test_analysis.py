@@ -447,6 +447,33 @@ def test_fixture_resilience_clean_twin_quiet():
     assert not rep.unsuppressed(), rep.render()
 
 
+def test_fixture_serving_planted_gl201_fires():
+    """The serving-decode donated-cache reuse (the paged-pool flavor of the
+    PR 2 async-ckpt race) is flagged at the AST level."""
+    rep = lint_paths([FIXTURES / "planted_serving.py"], excludes=())
+    assert "GL201" in _rules_of(rep), rep.render()
+
+
+def test_fixture_serving_planted_gl101_wasted_pool_donation():
+    """A serving step that donates the cache but returns only logits wastes
+    the donation — the jaxpr auditor flags it, and the corrected twin
+    (updated pool returned) is quiet."""
+    planted = _load_fixture("planted_serving")
+    args = planted.example_args()["decode_step_drops_pool"]
+    rep = audit_fn(planted.decode_step_drops_pool, *args, donate_argnums=(0,))
+    assert "GL101" in _rules_of(rep), rep.render()
+
+    clean = _load_fixture("clean_serving")
+    args = clean.example_args()["decode_step_drops_pool"]
+    rep = audit_fn(clean.decode_step_drops_pool, *args, donate_argnums=(0,))
+    assert not rep.unsuppressed(), rep.render()
+
+
+def test_fixture_serving_clean_twin_quiet():
+    rep = lint_paths([FIXTURES / "clean_serving.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
+
+
 def test_gl205_one_hop_name_resolution_and_scope():
     # the live path reaches the write through a local assignment — still hit
     src = (

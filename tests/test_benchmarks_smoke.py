@@ -130,6 +130,46 @@ def test_bench_resilience_fields_always_emitted():
 
 
 @pytest.mark.slow
+def test_bench_serve_smoke():
+    """CPU-tiny smoke of ``--serve`` (the serving-core traffic replay): the
+    report ALWAYS carries the serving fields — tokens/s/chip, p50/p99
+    per-token latency, KV-pool utilization (measured + predicted twin),
+    padding-waste fraction, scheduler occupancy — and on the seeded replay
+    trace continuous batching beats the static-batching twin on padding
+    waste and scheduled-token efficiency (the CPU-measurable acceptance
+    proxies)."""
+    rep = _run(["bench.py", "--serve", "--batch", "8"])
+    assert rep["metric"] == "serving_tokens_per_sec_per_chip"
+    extra = rep["extra"]
+    for field in ("tokens_per_sec_per_chip", "p50_token_latency_ms",
+                  "p99_token_latency_ms", "kv_pool_utilization",
+                  "kv_pool_utilization_predicted", "padding_waste_frac",
+                  "scheduled_token_efficiency", "scheduler_occupancy",
+                  "evictions", "static_baseline", "kv_pool"):
+        assert field in extra, field
+    assert extra["completed"] == extra["requests"] > 0
+    assert extra["tokens_per_sec_per_chip"] > 0
+    assert extra["kv_pool_utilization"] > 0
+    static = extra["static_baseline"]
+    assert extra["padding_waste_frac"] < static["padding_waste_frac"]
+    assert extra["scheduled_token_efficiency"] > static["scheduled_token_efficiency"]
+    # the predicted KV-HBM ladder rides every serve report
+    assert extra["kv_pool"]["bytes_per_page"] > 0
+    assert "v5e_16GiB" in extra["kv_pool"]["hbm_frac"]
+
+    # idle trace: every field still present, zeros (the always-emitted
+    # contract BENCH_*.json relies on)
+    rep_idle = _run(["bench.py", "--serve", "--batch", "8",
+                     "--serve-requests", "0"])
+    extra_idle = rep_idle["extra"]
+    assert extra_idle["tokens_per_sec_per_chip"] == 0.0
+    assert extra_idle["kv_pool_utilization"] == 0.0
+    assert extra_idle["padding_waste_frac"] == 0.0
+    assert extra_idle["scheduler_occupancy"] == 0.0
+    assert extra_idle["p50_token_latency_ms"] == 0.0
+
+
+@pytest.mark.slow
 def test_bench_plan_audit_hook():
     """``--plan N --audit`` embeds the graft-lint jaxpr-audit summary for
     the selected step: a tiny train step traced through the real

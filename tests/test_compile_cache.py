@@ -1,0 +1,50 @@
+"""Scoped compilation-cache management (utils/compile_cache.py): every
+harness gets a cache directory keyed by toolchain + tag + scope, retiring
+the documented shared-/tmp corruption flake (concurrent jax processes) and
+stale-version reuse."""
+
+import jax
+import pytest
+
+from accelerate_tpu.utils.compile_cache import (
+    enable_scoped_compilation_cache,
+    scoped_cache_dir,
+)
+
+
+def test_scoped_dir_keys_on_toolchain_and_tag(tmp_path):
+    d_tests = scoped_cache_dir("tests", root=str(tmp_path))
+    d_bench = scoped_cache_dir("bench", root=str(tmp_path))
+    assert d_tests != d_bench
+    assert f"jax{jax.__version__}" in d_tests
+    from pathlib import Path
+
+    assert Path(d_tests).is_dir() and Path(d_bench).is_dir()
+
+
+def test_scope_env_isolates_concurrent_runs(tmp_path, monkeypatch):
+    base = scoped_cache_dir("tests", root=str(tmp_path))
+    monkeypatch.setenv("ACCELERATE_JAX_CACHE_SCOPE", "runA")
+    a = scoped_cache_dir("tests", root=str(tmp_path))
+    monkeypatch.setenv("ACCELERATE_JAX_CACHE_SCOPE", "runB")
+    b = scoped_cache_dir("tests", root=str(tmp_path))
+    assert len({base, a, b}) == 3
+    # the pytest-xdist worker id scopes automatically (the exact concurrent-
+    # suite shape that corrupted the flat /tmp dir)
+    monkeypatch.delenv("ACCELERATE_JAX_CACHE_SCOPE")
+    monkeypatch.setenv("PYTEST_XDIST_WORKER", "gw3")
+    assert scoped_cache_dir("tests", root=str(tmp_path)).endswith("tests-gw3")
+
+
+def test_enable_points_jax_at_scoped_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("ACCELERATE_JAX_CACHE_SCOPE", raising=False)
+    monkeypatch.delenv("PYTEST_XDIST_WORKER", raising=False)
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        d = enable_scoped_compilation_cache("cache-test", root=str(tmp_path))
+        if d is None:  # pragma: no cover - older jax without the knobs
+            pytest.skip("jax build lacks compilation-cache config knobs")
+        assert jax.config.jax_compilation_cache_dir == d
+        assert d.startswith(str(tmp_path))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
