@@ -13,7 +13,7 @@
 FAST_BUDGET_S := 180
 FAST_HARD_S := 240
 
-.PHONY: test test-all test-examples quality lint
+.PHONY: test test-all test-examples quality lint preflight
 
 test:
 	@cache=/tmp/accelerate_tpu_test_jax_cache; \
@@ -42,3 +42,11 @@ quality:
 # error-severity finding — wire it ahead of `make test` in CI.
 lint:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu lint
+
+# deploy preflight: the lint sweep + AOT compile of every production
+# program (train step + the serving bucket ladder) + the compiled-artifact
+# audit (GL301-GL303; docs/static_analysis.md "Deploy preflight").  The
+# go-live order is lint -> preflight -> warm cache -> take traffic
+# (docs/serving.md).
+preflight:
+	JAX_PLATFORMS=cpu python -m accelerate_tpu preflight --train --serve

@@ -347,6 +347,14 @@ class Accelerator:
         self._async_checkpointer = None
         self.step_count = 0
         self._in_accumulate = False
+        # recompile guard: backend-compile events since construction (the
+        # process-wide jax.monitoring stream, reported as a delta) — after
+        # the first step compiles, a steady-state loop must stay flat;
+        # bench.py emits the compiles_predicted/compiles_measured twins
+        from .analysis.compiled_audit import install_global_compile_counter
+
+        self._compile_counter = install_global_compile_counter()
+        self._compile_baseline = self._compile_counter.count
 
         self.trackers: list = []
         self.log_with = log_with if isinstance(log_with, (list, tuple)) else ([log_with] if log_with else [])
@@ -1363,6 +1371,7 @@ class Accelerator:
                         raise ValueError(
                             f"batch dim {b} not divisible by gradient_accumulation_steps {accum_steps}"
                         )
+                    # graft-lint: disable=GL305 -- batch shapes are pinned by the dataloader; the accumulation reshape specializes once per fixed batch shape, never mid-traffic
                     return x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
 
                 micro = jax.tree_util.tree_map(reshape, batch)
@@ -1523,6 +1532,15 @@ class Accelerator:
         wrapped._lint_report = None
         self._prepared_train_step = wrapped
         return wrapped
+
+    @property
+    def compile_events(self) -> int:
+        """Real XLA backend compiles observed since this accelerator was
+        built (process-wide jax.monitoring stream, as a delta).  Snapshot
+        after warmup and watch for growth: a steady-state training loop
+        that keeps compiling is re-keying the jit cache every step — the
+        GL304 promotion-drift shape the preflight rules exist to catch."""
+        return self._compile_counter.count - self._compile_baseline
 
     def audit_step(self, step=None, *example_args, log: bool = True, **audit_kwargs):
         """Run the graft-lint jaxpr auditor over a prepared train step

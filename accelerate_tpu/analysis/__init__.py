@@ -1,23 +1,31 @@
 """graft-lint: static analysis for donation, transfer, and sharding hazards.
 
-Two engines over one report model (all CPU-safe, nothing executes on
+Three engines over one report model (all CPU-safe, nothing executes on
 device):
 
 - :mod:`.jaxpr_audit` — traces a step/decode function abstractly
   (``jax.jit(fn).trace``) and walks the ClosedJaxpr for hazards only the
   traced program shows: wasted donations (GL101), const-capture HBM
   blowups (GL102), in-trace memory-kind transfers (GL103), PRNG key reuse
-  (GL104), unsharded large outputs (GL105).
+  (GL104), unsharded large outputs (GL105), collective-matmul candidates
+  (GL106/GL107), donated promotion drift (GL304).
 - :mod:`.ast_rules` — repo-wide source linter for hazards only the caller's
   source shows: donated-name reuse after a ``donate_argnums`` call site
   (GL201, the PR 2 async-checkpoint race shape), host syncs in jitted code
   (GL202), ``jax.experimental.shard_map`` outside the compat shims (GL203),
-  wall-clock/stdlib randomness under trace (GL204).
+  wall-clock/stdlib randomness under trace (GL204), non-atomic checkpoint
+  writes (GL205), shape-dependent traces (GL305), jit-in-hot-loop (GL306).
+- :mod:`.compiled_audit` — AOT ``lower().compile()`` every production
+  program and read XLA's decisions off the executable: donation that did
+  not alias (GL301), HBM footprint over budget (GL302), compiled program
+  set vs the predicted bucket ladder (GL303), plus the flops/bytes cost
+  report and the runtime compile-event counter.
 
-Surfaces: ``python -m accelerate_tpu lint`` (``commands/lint.py``),
-``Accelerator.audit_step()`` / ``ACCELERATE_LINT=1``, ``make lint``, and
-``bench.py --plan N --audit``.  Rule catalog and suppression syntax:
-``docs/static_analysis.md``.
+Surfaces: ``python -m accelerate_tpu lint`` / ``preflight``
+(``commands/lint.py``, ``commands/preflight.py``),
+``Accelerator.audit_step()`` / ``ACCELERATE_LINT=1``, ``make lint`` /
+``make preflight``, and ``bench.py --plan N --audit``.  Rule catalog and
+suppression syntax: ``docs/static_analysis.md``.
 """
 
 from .ast_rules import (
@@ -26,12 +34,23 @@ from .ast_rules import (
     iter_python_files,
     lint_paths,
     lint_source,
+    resolve_targets,
+)
+from .compiled_audit import (
+    CompileCounter,
+    audit_aot,
+    audit_compiled,
+    audit_program_set,
+    aot_compile_program,
+    device_hbm_bytes,
+    install_global_compile_counter,
 )
 from .jaxpr_audit import audit_fn, audit_jitted, audit_traced, iter_eqns
 from .report import Finding, Report, Severity, apply_suppressions, parse_marker
 from .rules import RULES, Rule, rule
 
 __all__ = [
+    "CompileCounter",
     "DEFAULT_EXCLUDE_DIRS",
     "DEFAULT_EXCLUDES",
     "Finding",
@@ -39,14 +58,21 @@ __all__ = [
     "RULES",
     "Rule",
     "Severity",
+    "aot_compile_program",
     "apply_suppressions",
+    "audit_aot",
+    "audit_compiled",
     "audit_fn",
     "audit_jitted",
+    "audit_program_set",
     "audit_traced",
+    "device_hbm_bytes",
+    "install_global_compile_counter",
     "iter_eqns",
     "iter_python_files",
     "lint_paths",
     "lint_source",
     "parse_marker",
+    "resolve_targets",
     "rule",
 ]

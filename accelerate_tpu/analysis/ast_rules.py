@@ -387,6 +387,173 @@ def _string_constants(node) -> Iterable[str]:
             yield sub.value
 
 
+def _statics_of_jit_call(jit_call: ast.Call, fn) -> set:
+    """Parameter names of ``fn`` marked static by a ``jax.jit(...)`` call's
+    literal ``static_argnums`` / ``static_argnames``."""
+    a = fn.args
+    positional = [p.arg for p in (*a.posonlyargs, *a.args)]
+    names: set = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            idxs = []
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                idxs = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                idxs = [
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+            names.update(positional[i] for i in idxs if 0 <= i < len(positional))
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            elts = [v] if isinstance(v, ast.Constant) else (
+                v.elts if isinstance(v, (ast.Tuple, ast.List)) else []
+            )
+            names.update(
+                e.value for e in elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+    return names
+
+
+# shape-constructing calls GL305 watches: a traced-shape value flowing into
+# one of these re-specializes the program per input shape
+_SHAPE_CONSUMER_FUNCS = frozenset({
+    "jax.numpy.arange", "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full",
+    "jax.numpy.broadcast_to", "jax.lax.iota",
+})
+_SHAPE_CONSUMER_METHODS = frozenset({"reshape", "broadcast_to"})
+
+
+def _rule_shape_dependent_trace(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL305: ``arg.shape[i]`` of a non-static jit argument flowing directly
+    into a shape-constructing call inside jitted code — every distinct input
+    shape is a fresh compile (the mid-traffic recompile cause the serving
+    bucket ladder exists to remove).  Only the DIRECT flow is flagged: a
+    shape read bound to a local first is the documented miss (and routing
+    the width through a pinned bucket constant is the fix either way)."""
+    # parameter names each function has marked static, from its decorator
+    # or any jax.jit(fn_name, static_...) binding in the module
+    statics: dict[int, set] = {}
+    for fn in index.functions:
+        s: set = set()
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            if index.canonical(dec.func) in ("jax.jit", "jax.pmap"):
+                s |= _statics_of_jit_call(dec, fn)
+            elif (index.canonical(dec.func) in ("functools.partial", "partial")
+                    and dec.args
+                    and index.canonical(dec.args[0]) in ("jax.jit", "jax.pmap")):
+                s |= _statics_of_jit_call(dec, fn)
+        statics[id(fn)] = s
+    by_name: dict[str, list] = {}
+    for fn in index.functions:
+        by_name.setdefault(fn.name, []).append(fn)
+    for node in ast.walk(index.tree):
+        if index._is_jit_call(node) and node.args:
+            for fn in by_name.get(_dotted(node.args[0]) or "", []):
+                statics[id(fn)].update(_statics_of_jit_call(node, fn))
+
+    findings = []
+    for node in ast.walk(index.tree):
+        if not (isinstance(node, ast.Call) and index.in_jit_context(node)):
+            continue
+        canon = index.canonical(node.func)
+        is_method = (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SHAPE_CONSUMER_METHODS
+        )
+        if canon not in _SHAPE_CONSUMER_FUNCS and not is_method:
+            continue
+        fn = index.enclosing_function(node)
+        a = fn.args
+        params = {p.arg for p in (*a.posonlyargs, *a.args, *a.kwonlyargs)}
+        fn_statics = statics.get(id(fn), set())
+        flagged = False
+        for arg in (*node.args, *[kw.value for kw in node.keywords]):
+            if flagged:
+                break
+            for sub in ast.walk(arg):
+                if (
+                    isinstance(sub, ast.Subscript)
+                    and isinstance(sub.value, ast.Attribute)
+                    and sub.value.attr == "shape"
+                    and isinstance(sub.value.value, ast.Name)
+                    and sub.value.value.id in params
+                    and sub.value.value.id not in fn_statics
+                ):
+                    target = canon if canon in _SHAPE_CONSUMER_FUNCS else (
+                        f".{node.func.attr}()"
+                    )
+                    findings.append(
+                        _finding(
+                            "GL305",
+                            f"`{sub.value.value.id}.shape[...]` flows into "
+                            f"{target} inside jitted code and "
+                            f"`{sub.value.value.id}` is not static: the "
+                            "program re-specializes (recompiles) per input "
+                            "shape",
+                            path, node.lineno,
+                        )
+                    )
+                    flagged = True
+                    break
+    return findings
+
+
+def _walk_same_frame(root):
+    """``ast.walk`` that does not descend into nested function/lambda
+    bodies: their code runs when the function is CALLED, not where it is
+    defined, so a statement inside one is not executed by the enclosing
+    loop iteration."""
+    frame_nodes = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    yield root
+    stack = [] if isinstance(root, frame_nodes) else [root]
+    while stack:
+        for child in ast.iter_child_nodes(stack.pop()):
+            yield child
+            if not isinstance(child, frame_nodes):
+                stack.append(child)
+
+
+def _rule_jit_in_hot_loop(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL306: a ``jax.jit(...)`` call expression constructed inside a
+    ``for``/``while`` body — a fresh wrapper (and jit cache) every
+    iteration.  Loop ``else`` blocks run once and stay quiet; a ``while``
+    test is evaluated per iteration and counts.  A jit inside a function
+    merely *defined* in the loop runs at call time, not per iteration, and
+    stays quiet."""
+    findings = []
+    seen: set = set()
+    for node in ast.walk(index.tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        roots = list(node.body)
+        if isinstance(node, ast.While):
+            roots.append(node.test)
+        for root in roots:
+            for sub in _walk_same_frame(root):
+                if (
+                    isinstance(sub, ast.Call)
+                    and index.canonical(sub.func) in ("jax.jit", "jax.pmap")
+                    and id(sub) not in seen
+                ):
+                    seen.add(id(sub))
+                    findings.append(
+                        _finding(
+                            "GL306",
+                            f"{index.canonical(sub.func)}(...) constructed "
+                            "inside a loop body: a fresh jit wrapper (and "
+                            "cache) every iteration — the program recompiles "
+                            "per pass",
+                            path, sub.lineno,
+                        )
+                    )
+    return findings
+
+
 def _rule_checkpoint_atomicity(index: _ModuleIndex, path: str) -> list[Finding]:
     """GL205: non-atomic checkpoint writes + swallowed exceptions on the
     save/restore spine.
@@ -507,6 +674,8 @@ _ALL_RULES = (
     _rule_shard_map_compat,
     _rule_impure_in_jit,
     _rule_checkpoint_atomicity,
+    _rule_shape_dependent_trace,
+    _rule_jit_in_hot_loop,
 )
 
 
@@ -564,20 +733,35 @@ def iter_python_files(paths: Sequence, excludes: Sequence[str] = DEFAULT_EXCLUDE
             yield p
 
 
+def resolve_targets(
+    paths: Sequence,
+    excludes: Sequence[str] = DEFAULT_EXCLUDES,
+) -> tuple[list, list[Finding]]:
+    """The ONE target resolver every CLI surface shares (``lint`` and
+    ``preflight``): expand ``paths`` to ``(readable sources, GL002 findings
+    for every explicitly named target that does not exist or cannot be
+    read)``.  Factored so a typo'd CI path fails loudly in every command
+    that takes paths — never a silently skipped target passing as clean.
+
+    Returns ``[(Path, source_text), ...]`` plus the error findings.
+    """
+    sources: list = []
+    findings: list[Finding] = []
+    for f in iter_python_files(paths, excludes):
+        try:
+            sources.append((f, f.read_text()))
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(_finding("GL002", f"unreadable target: {e}", str(f), 1))
+    return sources, findings
+
+
 def lint_paths(
     paths: Sequence,
     excludes: Sequence[str] = DEFAULT_EXCLUDES,
 ) -> Report:
     """Lint every ``*.py`` under ``paths`` (files or directories), resolve
     inline suppressions, and return the combined :class:`Report`."""
-    findings: list[Finding] = []
-    for f in iter_python_files(paths, excludes):
-        try:
-            source = f.read_text()
-        except (OSError, UnicodeDecodeError) as e:
-            # never silently pass a target we could not read — a typo'd CI
-            # path must fail the run, not report clean
-            findings.append(_finding("GL002", f"unreadable target: {e}", str(f), 1))
-            continue
+    sources, findings = resolve_targets(paths, excludes)
+    for f, source in sources:
         findings.extend(lint_source(source, str(f)))
     return Report(apply_suppressions(findings))

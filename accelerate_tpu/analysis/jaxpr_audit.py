@@ -27,6 +27,14 @@ invisible to a source-level linter:
   exactly one ``dot_general`` is the monolithic gather-then-matmul pipe
   that ``ops/collective_matmul.py`` decomposes into a latency-hiding ring;
   only the traced program shows the consumer fan-out.
+- **GL107 collective-matmul reduce-scatter hint** (info) — the row-parallel
+  mirror: a ``dot_general`` whose result feeds exactly one
+  ``reduce_scatter`` serializes the monolithic scatter behind the matmul
+  that produced it (``ring_matmul_reduce_scatter`` is the decomposition).
+- **GL304 donated promotion drift** — a donated input whose only same-shape
+  outputs differ in dtype or weak_type (a python/numpy scalar promoted the
+  update): feeding the result back re-keys the jit cache every step, and
+  the widened output can no longer alias the donated buffer.
 
 Suppression is source-anchored (see :mod:`.report`): each finding resolves
 its file/line from the flagged equation's ``source_info``, so the same
@@ -176,6 +184,59 @@ def _audit_donation(jaxpr, donated: list[bool], path_hint) -> list[Finding]:
     return findings
 
 
+def _audit_donation_promotion(jaxpr, donated: list[bool], path_hint) -> list[Finding]:
+    """GL304: a donated input with no exact-aval output but a same-shape
+    output whose dtype or weak_type drifted — the promotion signature of a
+    python/numpy scalar mixed into the donated tree.  The drifted result
+    re-keys the jit cache when fed back (a recompile every step) and can no
+    longer alias the donated buffer."""
+    out_vars = [v for v in jaxpr.outvars if not isinstance(v, jax.core.Literal)]
+    passthrough = {id(v) for v in jaxpr.invars} & {id(v) for v in out_vars}
+
+    def _sig(aval):
+        return (
+            tuple(getattr(aval, "shape", ())),
+            str(getattr(aval, "dtype", "?")),
+            bool(getattr(aval, "weak_type", False)),
+        )
+
+    exact: dict[tuple, int] = {}
+    by_shape: dict[tuple, list] = {}
+    for v in out_vars:
+        if id(v) in passthrough:
+            continue
+        shape, dtype, weak = _sig(v.aval)
+        exact[(shape, dtype, weak)] = exact.get((shape, dtype, weak), 0) + 1
+        by_shape.setdefault(shape, []).append((dtype, weak))
+    findings = []
+    for i, (var, is_donated) in enumerate(zip(jaxpr.invars, donated)):
+        if not is_donated or id(var) in passthrough:
+            continue
+        shape, dtype, weak = _sig(var.aval)
+        if exact.get((shape, dtype, weak), 0) > 0:
+            exact[(shape, dtype, weak)] -= 1
+            continue
+        drifted = [
+            (d, w) for d, w in by_shape.get(shape, []) if (d, w) != (dtype, weak)
+        ]
+        if not drifted:
+            continue  # no same-shape output at all: GL101's case, not drift
+        d, w = drifted[0]
+        what = f"dtype {dtype} -> {d}" if d != dtype else f"weak_type {weak} -> {w}"
+        findings.append(
+            _finding(
+                "GL304",
+                f"donated argument {i} ({dtype}{list(shape)}) only matches "
+                f"an output of the same shape with promoted aval ({what}): "
+                "a python scalar in the update re-keys the jit cache every "
+                "step and breaks the donation alias",
+                path=path_hint[0] if path_hint else None,
+                line=path_hint[1] if path_hint else None,
+            )
+        )
+    return findings
+
+
 def _audit_consts(closed, threshold: int, path_hint) -> list[Finding]:
     """GL102: closed-over constants above the size threshold."""
     findings = []
@@ -303,24 +364,29 @@ def _audit_key_reuse(closed) -> list[Finding]:
 
 
 def _audit_collective_matmul(closed) -> list[Finding]:
-    """GL106 (hint): an ``all_gather`` whose result is consumed by exactly
-    one ``dot_general`` — the monolithic gather-then-matmul pipe the ring
-    collective-matmul decomposes.  Scope-local: jaxpr vars never cross
+    """GL106/GL107 (hints): the two monolithic collective-matmul pipes the
+    ring schedules decompose — an ``all_gather`` whose result is consumed by
+    exactly one ``dot_general`` (GL106, column-parallel), and a
+    ``dot_general`` whose result feeds exactly one ``reduce_scatter``
+    (GL107, the row-parallel mirror).  Scope-local: jaxpr vars never cross
     sub-jaxpr boundaries except through invars, so consumers are counted
-    within each (sub-)jaxpr; a gathered value that escapes the scope or
-    feeds anything else (norms, residuals, multiple dots) is not a pure
+    within each (sub-)jaxpr; a value that escapes the scope or feeds
+    anything else (norms, residuals, multiple consumers) is not a pure
     pipe and stays quiet."""
     findings = []
 
     def scan(jaxpr):
         consumers: dict[int, list] = {}
         gathers = []
+        dots = []
         for eqn in jaxpr.eqns:
             for v in eqn.invars:
                 if not isinstance(v, jax.core.Literal):
                     consumers.setdefault(id(v), []).append(eqn)
             if eqn.primitive.name == "all_gather":
                 gathers.append(eqn)
+            elif eqn.primitive.name == "dot_general":
+                dots.append(eqn)
             for sub in _sub_jaxprs(eqn):
                 scan(sub.jaxpr)
         escaped = {id(v) for v in jaxpr.outvars if not isinstance(v, jax.core.Literal)}
@@ -341,6 +407,27 @@ def _audit_collective_matmul(closed) -> list[Finding]:
                     "dot_general: a collective-matmul candidate (the gather "
                     "could ride a ppermute ring hidden under the partial "
                     "matmuls — ops/collective_matmul.py)",
+                    path=path, line=line,
+                )
+            )
+        for d in dots:
+            out = d.outvars[0]
+            cons = consumers.get(id(out), [])
+            if id(out) in escaped or len(cons) != 1:
+                continue
+            if cons[0].primitive.name != "reduce_scatter":
+                continue
+            path, line = _eqn_location(d)
+            aval = out.aval
+            findings.append(
+                _finding(
+                    "GL107",
+                    f"dot_general result {getattr(aval, 'dtype', '?')}"
+                    f"{list(getattr(aval, 'shape', ()))} feeds exactly one "
+                    "reduce_scatter: the row-parallel collective-matmul "
+                    "candidate (the scatter could ride a ppermute ring "
+                    "hidden under the partial matmuls — "
+                    "ops/collective_matmul.py ring_matmul_reduce_scatter)",
                     path=path, line=line,
                 )
             )
@@ -425,6 +512,7 @@ def audit_traced(
 
     findings = []
     findings += _audit_donation(closed.jaxpr, donated, path_hint)
+    findings += _audit_donation_promotion(closed.jaxpr, donated, path_hint)
     findings += _audit_consts(closed, const_bytes_threshold, path_hint)
     findings += _audit_transfers(closed.jaxpr, default_memory_kind)
     findings += _audit_key_reuse(closed)

@@ -1,11 +1,14 @@
-"""The graft-lint rule catalog — one registry both engines and the docs
+"""The graft-lint rule catalog — one registry all engines and the docs
 draw from.
 
 Numbering: GL0xx meta (the linter linting its own markers), GL1xx jaxpr
 rules (hazards visible only in the traced program), GL2xx AST rules
 (hazards visible only in the source — caller-side reuse, impure calls the
-trace would bake silently).  ``docs/static_analysis.md`` renders this table;
-``tests/test_analysis.py`` pins that every finding either engine can emit
+trace would bake silently), GL3xx compiled/recompile rules (hazards visible
+only in the lowered XLA executable — did the donation actually alias, does
+the footprint fit — plus the trace- and source-level shapes that cause
+mid-traffic recompiles).  ``docs/static_analysis.md`` renders this table;
+``tests/test_analysis.py`` pins that every finding any engine can emit
 carries an id registered here.
 """
 
@@ -21,7 +24,7 @@ class Rule:
     id: str
     name: str
     severity: Severity
-    engine: str  # "jaxpr" | "ast" | "meta"
+    engine: str  # "jaxpr" | "ast" | "meta" | "compiled"
     summary: str
     fix_hint: str
 
@@ -93,6 +96,17 @@ RULES: dict[str, Rule] = {
             "FullyShardedDataParallelPlugin.collective_matmul",
         ),
         Rule(
+            "GL107", "collective-matmul-rs-hint", Severity.INFO, "jaxpr",
+            "a dot_general whose result feeds exactly one reduce_scatter: "
+            "the row-parallel mirror of GL106 — the matmul finishes before a "
+            "single monolithic scatter starts, serializing ICI against the "
+            "compute that produced it (a hint, not a defect: suppressible, "
+            "and never fails a run)",
+            "route the pair through ops/collective_matmul.py "
+            "(ring_matmul_reduce_scatter), or enable "
+            "FullyShardedDataParallelPlugin.collective_matmul",
+        ),
+        Rule(
             "GL105", "unsharded-output", Severity.WARNING, "jaxpr",
             "a large output with no sharding constraint on its producer: "
             "GSPMD may resolve it fully replicated, costing a full copy of "
@@ -151,6 +165,75 @@ RULES: dict[str, Rule] = {
             "os.replace (checkpointing._finalize_checkpoint is the model); "
             "never silently swallow exceptions on the save/restore spine — "
             "log, re-raise, or route through resilience.retry.with_retries",
+        ),
+        # ------------------------------------------------------------------
+        # compiled engine (GL301-303) + recompile-cause rules (GL304-306):
+        # what the lowered XLA executable actually does, and the trace- and
+        # source-level shapes that re-key the jit cache mid-traffic
+        # ------------------------------------------------------------------
+        Rule(
+            "GL301", "donation-not-aliased", Severity.ERROR, "compiled",
+            "a donate_argnums input the compiled executable provably did "
+            "NOT alias (compiled memory analysis: aliased bytes < donated "
+            "bytes): the compiled-level twin of GL101 — the jaxpr auditor "
+            "predicts viability, this reads XLA's actual decision off the "
+            "executable, so it also catches donations the compiler declined "
+            "for layout/sharding reasons no trace-level model sees",
+            "return an update with the donated input's exact aval (shape, "
+            "dtype, weak_type, sharding) or drop the argument from "
+            "donate_argnums; re-run `accelerate_tpu preflight` to confirm "
+            "the alias landed",
+        ),
+        Rule(
+            "GL302", "hbm-over-budget", Severity.ERROR, "compiled",
+            "a compiled program whose argument+output+temp footprint "
+            "exceeds the device HBM budget (measured or --hbm-gb): the "
+            "program OOMs at first execution — after the deploy took "
+            "traffic, unless preflight catches it here",
+            "shrink the KV pool / batch / bucket ladder, enable offload, "
+            "or raise --hbm-gb if the budget was a stale estimate",
+        ),
+        Rule(
+            "GL303", "recompile-ladder-drift", Severity.WARNING, "compiled",
+            "the compiled program set does not match the predicted bucket "
+            "ladder (exactly len(prefill_buckets)+2 serving programs, or "
+            "extra backend compiles observed during preflight): every "
+            "extra distinct lowering is a mid-traffic recompile waiting "
+            "to happen",
+            "pin every device program to a fixed shape from the bucket "
+            "ladder (ServingPlugin.prefill_buckets); dedupe buckets; chase "
+            "stray compiles with JAX_LOG_COMPILES=1",
+        ),
+        Rule(
+            "GL304", "donated-promotion-drift", Severity.WARNING, "jaxpr",
+            "a donated input whose only same-shape outputs differ in dtype "
+            "or weak_type by promotion (a python scalar mixed into the "
+            "donated tree): feeding the result back re-keys the jit cache "
+            "— a recompile every step — and the widened output can no "
+            "longer alias the donated buffer",
+            "match the update's dtype to the state's (jnp.asarray(c, "
+            "x.dtype) / x.dtype-typed literals) so the output aval equals "
+            "the donated input aval",
+        ),
+        Rule(
+            "GL305", "shape-dependent-trace", Severity.WARNING, "ast",
+            "a traced-shape read (`arg.shape[i]` of a non-static jit "
+            "argument) flowing directly into a shape-constructing call "
+            "(jnp.arange/zeros/ones/full/reshape/broadcast_to) inside "
+            "jitted code: the program re-specializes per input shape, so "
+            "every unbucketed arrival is a fresh compile",
+            "pad inputs to a fixed bucket ladder before the jit boundary "
+            "(ServingPlugin.prefill_buckets is the model), or mark the "
+            "driving argument static (static_argnums/static_argnames)",
+        ),
+        Rule(
+            "GL306", "jit-in-hot-loop", Severity.WARNING, "ast",
+            "a jax.jit(...) call expression constructed inside a for/while "
+            "body: each iteration builds a fresh jit wrapper with a fresh "
+            "cache, so the XLA program recompiles (or at best re-hashes) "
+            "every pass through the loop",
+            "hoist the jax.jit(...) call above the loop and call the "
+            "wrapper inside it",
         ),
     ]
 }

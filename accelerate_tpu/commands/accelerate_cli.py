@@ -20,6 +20,7 @@ from .from_accelerate import from_accelerate_command_parser
 from .launch import launch_command_parser
 from .lint import lint_command_parser
 from .merge import merge_command_parser
+from .preflight import preflight_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
 
@@ -43,6 +44,7 @@ def build_parser() -> argparse.ArgumentParser:
     from_accelerate_command_parser(subparsers)
     cloud_command_parser(subparsers)
     lint_command_parser(subparsers)
+    preflight_command_parser(subparsers)
     return parser
 
 

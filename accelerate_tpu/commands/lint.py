@@ -52,14 +52,13 @@ def lint_command_parser(subparsers=None) -> argparse.ArgumentParser:
     return parser
 
 
-def audit_canonical_step(optimizer: str = "lion"):
-    """Jaxpr-audit a tiny train step built through the real accelerator
-    machinery (create_train_state + prepare_train_step, donation on).
-
-    This is the in-CI twin of the ``accelerator.py`` hot spot: the traced
-    program contains the genuine donation set, RNG threading, sharding
-    pins, and (for the -sr recipes) the SR hash streams.  Pure trace — no
-    device execution, runs on CPU.
+def build_canonical_step(optimizer: str = "lion"):
+    """The canonical tiny train step, built through the REAL accelerator
+    machinery (create_train_state + prepare_train_step, donation on):
+    returns ``(accelerator, step, state, batch)`` where ``batch`` is a
+    ``ShapeDtypeStruct`` stand-in.  One builder for every audit surface —
+    the lint CLI's jaxpr audit and the preflight's AOT compile both read
+    the same program, so their findings always describe the same artifact.
     """
     import jax
     import jax.numpy as jnp
@@ -76,6 +75,18 @@ def audit_canonical_step(optimizer: str = "lion"):
     state = acc.create_train_state(params, optimizer)
     step = acc.prepare_train_step(loss_fn)
     batch = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+    return acc, step, state, batch
+
+
+def audit_canonical_step(optimizer: str = "lion"):
+    """Jaxpr-audit the canonical tiny train step (:func:`build_canonical_step`).
+
+    This is the in-CI twin of the ``accelerator.py`` hot spot: the traced
+    program contains the genuine donation set, RNG threading, sharding
+    pins, and (for the -sr recipes) the SR hash streams.  Pure trace — no
+    device execution, runs on CPU.
+    """
+    acc, step, state, batch = build_canonical_step(optimizer)
     return acc.audit_step(step, state, batch, log=False)
 
 

@@ -1,0 +1,367 @@
+"""``accelerate-tpu preflight`` — deploy preflight: audit the artifacts a
+deploy will actually run, BEFORE taking traffic.
+
+The go-live discipline (docs/serving.md): ``lint`` checks the source and the
+trace, ``preflight`` checks the lowered XLA executables and the shape
+discipline that keeps them stable —
+
+1. the graft-lint sweep over the given paths (same target resolver as the
+   ``lint`` command: a typo'd path is a loud GL002 failure in both, never a
+   silently skipped target);
+2. AOT ``lower().compile()`` of every production program — the canonical
+   train step through the real ``prepare_train_step`` machinery
+   (``--train``), and the serving ladder (``--serve``): one prefill per
+   ``ServingPlugin.prefill_buckets`` entry plus the decode and release
+   programs, exactly ``len(buckets) + 2`` executables;
+3. the compiled audit of each executable: GL301 donation-not-aliased,
+   GL302 HBM-over-budget (``--hbm-gb`` or the backend's measured limit),
+   GL303 program count vs the predicted bucket ladder, plus the per-program
+   flops/bytes cost report the predicted-MFU arithmetic feeds on;
+4. the jaxpr audit of each traced program rides along (GL1xx + GL304), so
+   a hazard visible at either level fails the same run.
+
+Exit code 1 when any unsuppressed finding at or above ``--fail-on``
+severity (default: error — GL301/GL302 are errors) remains.  All CPU-safe:
+AOT compilation needs a backend but executes nothing, so the preflight runs
+on the CI box with ``ShapeDtypeStruct`` stand-ins (the serving params and
+KV pool are never allocated).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+
+from ..utils.dataclasses import PreflightConfig
+
+
+def preflight_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Deploy preflight: graft-lint sweep + AOT compile of every "
+        "production program + compiled-artifact audit (GL301-GL303; see "
+        "docs/static_analysis.md, 'Deploy preflight')."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser(
+            "preflight", description=description, help=description
+        )
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu preflight", description=description
+        )
+    parser.add_argument(
+        "paths", nargs="*", default=["."],
+        help="files/directories for the lint sweep (default: .)",
+    )
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="preflight the serving ladder: one prefill program per "
+             "ServingPlugin.prefill_buckets entry (ACCELERATE_SERVE_* env "
+             "sets the geometry) + decode + release — exactly "
+             "len(buckets)+2 executables",
+    )
+    parser.add_argument(
+        "--train", action="store_true",
+        help="preflight the canonical train step (the real "
+             "prepare_train_step machinery, donation on; --optimizer "
+             "selects the recipe)",
+    )
+    parser.add_argument(
+        "--program", action="append", default=[], metavar="FILE::FN[::donate=I,J]",
+        help="additionally preflight FN from FILE (the fixture convention: "
+             "the module's example_args()[FN] supplies the inputs); "
+             "repeatable.  donate= lists donated positional indices",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable report")
+    parser.add_argument(
+        "--hbm-gb", type=float, default=None,
+        help="HBM budget in GiB for GL302 (default: the backend's measured "
+             "bytes_limit; CPU reports none, so GL302 is skipped there "
+             "unless this is set)",
+    )
+    parser.add_argument(
+        "--fail-on", choices=["error", "warning", "info"], default=None,
+        help="lowest severity that fails the run (default: error)",
+    )
+    parser.add_argument(
+        "--optimizer", default=None,
+        help="optimizer recipe for the train-step program (default: lion)",
+    )
+    parser.add_argument(
+        "--no-lint", action="store_true",
+        help="skip the source sweep (compiled audit only)",
+    )
+    parser.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed findings (with their rationales) in the output",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=preflight_command)
+    return parser
+
+
+# ---------------------------------------------------------------------------
+# program builders
+# ---------------------------------------------------------------------------
+
+
+def _audit_program(prog, config: PreflightConfig, hbm_budget_bytes=None):
+    """Both audits of one AOT-compiled program (the
+    :class:`~..analysis.compiled_audit.CompiledProgram` carries the traced
+    handle precisely so the jaxpr audit rides the same single trace):
+    GL1xx/GL304 off ``prog.traced``, GL301/GL302 + the cost row off
+    ``prog.compiled``.  Returns ``(findings, [row])``."""
+    from ..analysis import audit_compiled, audit_traced
+
+    findings = list(
+        audit_traced(prog.traced, path_hint=prog.path_hint).findings
+    )
+    f, row = audit_compiled(
+        prog.compiled, label=prog.label, hbm_budget_bytes=hbm_budget_bytes,
+        donation_slack_bytes=config.donation_slack_bytes,
+        path_hint=prog.path_hint,
+    )
+    row["compile_s"] = round(prog.compile_s, 4)
+    row["compile_events"] = prog.compile_events
+    findings += f
+    return findings, [row]
+
+
+def preflight_train(config: PreflightConfig, hbm_budget_bytes=None):
+    """AOT-compile and audit the canonical train step.  Returns
+    ``(findings, rows)`` — jaxpr + compiled findings and one report row."""
+    from ..analysis.compiled_audit import audit_program_set, aot_compile_program
+    from ..state import AcceleratorState, GradientState
+    from .lint import build_canonical_step
+
+    try:
+        acc, step, state, batch = build_canonical_step(config.optimizer)
+        jitted = step._jitted
+        path_hint = None
+        code = getattr(getattr(jitted, "__wrapped__", None), "__code__", None)
+        if code is not None:
+            path_hint = (code.co_filename, code.co_firstlineno)
+        prog = aot_compile_program(
+            jitted, state, batch, label=f"train_step[{config.optimizer}]",
+            path_hint=path_hint,
+        )
+        findings, rows = _audit_program(prog, config, hbm_budget_bytes)
+        findings += audit_program_set(
+            rows, 1, measured_compile_events=prog.compile_events,
+            path_hint=path_hint,
+        )
+        return findings, rows
+    finally:
+        # the canonical step builds a real Accelerator: reset the singletons
+        # so in-process callers (tests, bench) start clean afterwards — even
+        # when the compile or audit raises
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+
+
+def _serve_setup():
+    """The serving model/plugin the preflight audits: geometry from the
+    ``ACCELERATE_SERVE_*`` env family (the ServingPlugin contract), the
+    tiny model on CPU and the 600m-class decode shape on TPU (bench.py's
+    ``--serve`` convention, so preflight audits what the bench measures)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..generation import GenerationConfig
+    from ..models import LlamaConfig
+    from ..utils.dataclasses import ServingPlugin
+
+    if jax.default_backend() == "tpu":
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=16, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=4096, attn_implementation="flash",
+            dtype=jnp.bfloat16,
+        )
+    else:
+        cfg = LlamaConfig.tiny()
+    return cfg, ServingPlugin(), GenerationConfig()
+
+
+def preflight_serve(config: PreflightConfig, hbm_budget_bytes=None,
+                    model=None, plugin=None, gen_config=None):
+    """AOT-compile and audit the serving ladder: one prefill per bucket +
+    decode + release (exactly ``len(prefill_buckets) + 2`` programs).
+
+    Everything compiles from ``ShapeDtypeStruct`` stand-ins — the params
+    and the KV pool are never allocated, so a production-sized ladder
+    preflights on a CPU box.  Returns ``(findings, rows)``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..analysis.compiled_audit import audit_program_set, aot_compile_program
+    from ..models import LlamaForCausalLM
+    from ..models.llama import init_paged_cache
+    from ..serving.engine import fresh_engine_jits
+
+    if model is None or plugin is None or gen_config is None:
+        cfg, env_plugin, env_gen = _serve_setup()
+        model = model or LlamaForCausalLM(cfg)
+        plugin = plugin or env_plugin
+        gen_config = gen_config or env_gen
+    p = plugin
+    # fresh wrappers on purpose: an engine-shared wrapper may hold an
+    # executable deserialized from the persistent cache, which has no
+    # donation alias table (every donation would read as GL301)
+    decode, prefill, release, _sample = fresh_engine_jits(
+        model, gen_config, p.page_size
+    )
+
+    cache_sds = jax.eval_shape(
+        lambda: init_paged_cache(
+            model.config, p.num_pages, p.page_size, p.num_slots, p.pages_per_slot
+        )
+    )
+    params_sds = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+    )
+    rng_sds = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    n = p.num_slots
+    sds = jax.ShapeDtypeStruct
+
+    specs = [
+        ("decode", decode,
+         (params_sds, cache_sds, sds((n,), jnp.int32), sds((n,), jnp.bool_),
+          rng_sds)),
+        ("release", release, (cache_sds, sds((n,), jnp.bool_))),
+    ]
+    for bucket in p.prefill_buckets:
+        specs.append((
+            f"prefill[{bucket}]", prefill,
+            (params_sds, cache_sds, sds((), jnp.int32), sds((bucket,), jnp.int32),
+             sds((), jnp.int32), sds((), jnp.int32)),
+        ))
+
+    findings, rows, events = [], [], 0
+    for label, jitted, args in specs:
+        prog = aot_compile_program(jitted, *args, label=label)
+        events += prog.compile_events
+        f, r = _audit_program(prog, config, hbm_budget_bytes)
+        findings += f
+        rows += r
+    findings += audit_program_set(
+        rows, len(p.prefill_buckets) + 2, measured_compile_events=events
+    )
+    return findings, rows
+
+
+def _parse_program_spec(spec: str):
+    parts = spec.split("::")
+    if len(parts) < 2:
+        raise ValueError(
+            f"--program {spec!r}: expected FILE::FN[::donate=I,J]"
+        )
+    path, fn_name = parts[0], parts[1]
+    donate = ()
+    for extra in parts[2:]:
+        if extra.startswith("donate="):
+            donate = tuple(int(i) for i in extra[len("donate="):].split(",") if i)
+    return path, fn_name, donate
+
+
+def preflight_program(spec: str, config: PreflightConfig, hbm_budget_bytes=None):
+    """Preflight one user-named program: ``FILE::FN`` with the fixture
+    convention (``example_args()[FN]`` supplies inputs).  A bad file or
+    function name is a GL002 finding — the shared loud-failure contract."""
+    from ..analysis import Finding, RULES
+    from ..analysis.compiled_audit import aot_compile_program
+
+    path, fn_name, donate = _parse_program_spec(spec)
+    try:
+        module_spec = importlib.util.spec_from_file_location("preflight_target", path)
+        mod = importlib.util.module_from_spec(module_spec)
+        module_spec.loader.exec_module(mod)
+        fn = getattr(mod, fn_name)
+        args = mod.example_args()[fn_name]
+    except Exception as e:
+        r = RULES["GL002"]
+        return [Finding(
+            rule="GL002", severity=r.severity, fix_hint=r.fix_hint,
+            message=f"preflight target {spec!r} could not be loaded: {e}",
+            path=path, line=1, engine="compiled",
+        )], []
+    code = getattr(fn, "__code__", None)
+    prog = aot_compile_program(
+        fn, *args, donate_argnums=donate, label=f"{path}::{fn_name}",
+        path_hint=(code.co_filename, code.co_firstlineno) if code else None,
+    )
+    return _audit_program(prog, config, hbm_budget_bytes)
+
+
+# ---------------------------------------------------------------------------
+# the command
+# ---------------------------------------------------------------------------
+
+
+def preflight_command(args) -> None:
+    from ..analysis import Report, Severity, apply_suppressions, lint_paths
+    from ..analysis.compiled_audit import device_hbm_bytes
+
+    config = PreflightConfig(
+        hbm_gb=args.hbm_gb,
+        fail_on=args.fail_on or "",
+        optimizer=args.optimizer or "",
+    )
+    budget = device_hbm_bytes(config.hbm_gb)
+
+    findings, rows = [], []
+    if not args.no_lint:
+        findings += lint_paths(args.paths).findings
+    flavors = []
+    run_train = args.train or not (args.serve or args.train or args.program)
+    run_serve = args.serve or not (args.serve or args.train or args.program)
+    if run_train:
+        f, r = preflight_train(config, budget)
+        findings += f
+        rows += r
+        flavors.append("train")
+    if run_serve:
+        f, r = preflight_serve(config, budget)
+        findings += f
+        rows += r
+        flavors.append("serve")
+    for spec in args.program:
+        f, r = preflight_program(spec, config, budget)
+        findings += f
+        rows += r
+        flavors.append(spec)
+
+    report = Report(apply_suppressions(findings))
+    if args.json:
+        print(json.dumps({
+            "flavors": flavors,
+            "hbm_budget_bytes": budget,
+            "programs": rows,
+            "findings": [f.to_dict() for f in report.findings],
+            "summary": report.summary(),
+        }, indent=2))
+    else:
+        print(report.render(show_suppressed=args.show_suppressed))
+        for row in rows:
+            hbm = row.get("hbm") or {}
+            print(
+                f"preflight {row['program']}: compile {row.get('compile_s', 0)}s, "
+                f"hbm {hbm.get('total', 0) / 2**20:.2f} MiB "
+                f"(args {hbm.get('arguments', 0)} B, temps {hbm.get('temps', 0)} B, "
+                f"aliased {hbm.get('aliased', 0)} B), "
+                f"flops {row.get('flops', 0):.3g}, "
+                f"bytes {row.get('bytes_accessed', 0):.3g}"
+            )
+        print(f"preflight: {len(rows)} program(s) compiled [{', '.join(flavors)}]")
+    raise SystemExit(report.exit_code(Severity.parse(config.fail_on)))
+
+
+def main():
+    preflight_command(preflight_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

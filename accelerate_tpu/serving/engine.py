@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.compiled_audit import install_global_compile_counter
 from ..generation import GenerationConfig, sample_logits
 from ..models.llama import init_paged_cache
 from ..resilience import faults as _faults
@@ -42,11 +43,11 @@ from .paged_cache import allocate, pages_for, release
 from .scheduler import ContinuousBatchingScheduler, Request
 
 
-@lru_cache(maxsize=8)
-def _engine_fns(model, gen_config, page_size: int):
-    """The three jitted device programs, shared across engines of the same
-    (model, config, page geometry) — jax.jit caches per input shape, so
-    bucket widths and slot counts each compile exactly once per process."""
+def _engine_step_fns(model, gen_config, page_size: int):
+    """The raw (un-jitted) device-program bodies.  :func:`_engine_fns`
+    wraps them in the process-shared jit cache for serving;
+    :func:`fresh_engine_jits` wraps them fresh for the deploy preflight,
+    whose executable-level stats must come from a real compile."""
     apply = model.apply
 
     def decode_step(params, cache, tokens, active, rng):
@@ -128,12 +129,34 @@ def _engine_fns(model, gen_config, page_size: int):
     def sample_first(last, rng):
         return sample_logits(last[None], rng, gen_config)[0]
 
+    return decode_step, prefill_step, release_step, sample_first
+
+
+def fresh_engine_jits(model, gen_config, page_size: int):
+    """FRESH jit wrappers over the engine program bodies — deliberately
+    outside the shared :func:`_engine_fns` cache.  The deploy preflight
+    compiles through these: a wrapper another engine already drove may hold
+    an executable deserialized from the persistent compilation cache, and
+    deserialized executables LOSE their buffer-donation alias table
+    (``memory_analysis().alias_size_in_bytes`` reads 0), which would turn
+    every healthy donation into a GL301 false positive."""
+    decode_step, prefill_step, release_step, sample_first = _engine_step_fns(
+        model, gen_config, page_size
+    )
     return (
         jax.jit(decode_step, donate_argnums=(1,)),
         jax.jit(prefill_step, donate_argnums=(1,)),
         jax.jit(release_step, donate_argnums=(0,)),
         jax.jit(sample_first),
     )
+
+
+@lru_cache(maxsize=8)
+def _engine_fns(model, gen_config, page_size: int):
+    """The jitted device programs, shared across engines of the same
+    (model, config, page geometry) — jax.jit caches per input shape, so
+    bucket widths and slot counts each compile exactly once per process."""
+    return fresh_engine_jits(model, gen_config, page_size)
 
 
 class ServingEngine:
@@ -178,6 +201,13 @@ class ServingEngine:
             self.model, self.gen_config, p.page_size
         )
         self._base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # recompile guard: compile events are counted process-wide (the
+        # jax.monitoring backend-compile stream) and reported as a delta
+        # from engine construction — after warmup() this must stay flat
+        # (the fixed-shape contract: a mid-traffic compile is a bug)
+        self._compile_counter = install_global_compile_counter()
+        self._compile_baseline = self._compile_counter.count
+        self.warmed_up = False
         self.steps = 0
         self.interrupted = False
         self._undelivered: list[Request] = []
@@ -220,6 +250,54 @@ class ServingEngine:
         return self.unfinished_requests() + list(self._undelivered)
 
     # -- the engine tick -----------------------------------------------------
+
+    def warmup(self) -> int:
+        """Compile every device program before taking traffic: one no-op
+        pass through decode, release, and each bucket's prefill (plus the
+        first-token sampler), using the engine's real cache and params so
+        every shape/dtype matches live traffic exactly.  No-op means no
+        slot state changes: decode runs with zero active slots, prefill
+        writes a zero-length chunk into an idle slot, release releases an
+        empty mask — tokens are never recorded and ``steps`` does not
+        advance.  Returns the number of backend compile events the warmup
+        cost (0 when the persistent compilation cache was already warm).
+
+        Call before traffic (the replay harness does); after it,
+        :attr:`compile_events` staying flat IS the no-mid-traffic-recompile
+        contract.
+        """
+        if self.sched.slots:
+            raise RuntimeError("warmup() must run before any traffic is admitted")
+        before = self._compile_counter.count
+        n = self.plugin.num_slots
+        rng = jax.random.fold_in(self._base_rng, 0)  # warms the fold_in program
+        cache, _ = self._decode(
+            self.params, self.cache, jnp.asarray(np.zeros((n,), np.int32)),
+            jnp.asarray(np.zeros((n,), bool)), rng,
+        )
+        self.cache = cache
+        last = None
+        for bucket in self.plugin.prefill_buckets:
+            cache, last = self._prefill(
+                self.params, self.cache, jnp.asarray(0, jnp.int32),
+                jnp.asarray(np.zeros((bucket,), np.int32)),
+                jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+            )
+            self.cache = cache
+        if last is not None:
+            self._sample(last, rng)
+        self.cache = self._release(
+            self.cache, jnp.asarray(np.zeros((n,), bool))
+        )
+        self.warmed_up = True
+        return self._compile_counter.count - before
+
+    @property
+    def compile_events(self) -> int:
+        """Real XLA backend compiles observed since this engine was built
+        (process-wide jax.monitoring stream, reported as a delta).  After
+        :meth:`warmup` this must not grow — every program is fixed-shape."""
+        return self._compile_counter.count - self._compile_baseline
 
     def step(self) -> dict:
         """One scheduler decision + at most one device program."""

@@ -113,14 +113,35 @@ def predicted_pool_utilization(trace: list[Request], *, num_slots: int,
     return round(page_step_sum / max(steps, 1) / num_pages, 4)
 
 
-def replay(engine, trace: list[Request]) -> dict:
+def replay(engine, trace: list[Request], *, strict_compiles: bool = True) -> dict:
     """Run the trace through the engine and compose the serving report.
-    Every field is always present (zeros on an empty/idle trace)."""
+    Every field is always present (zeros on an empty/idle trace).
+
+    The engine is warmed first (``engine.warmup()`` — every fixed-shape
+    program compiles before the clock starts), so the report's CheckFreq
+    twins ``compiles_predicted``/``compiles_measured`` count POST-warmup
+    compile events: the bucket-ladder contract predicts exactly zero, and a
+    measured compile mid-replay is a recompile a production deploy would
+    eat under traffic.  With ``strict_compiles`` (default) the harness
+    fails its report loudly in that case instead of publishing numbers a
+    recompile stall just poisoned.
+    """
     import time
 
+    compiles_warmup = engine.warmup() if not engine.warmed_up else 0
+    compiles_before = engine.compile_events
     t0 = time.perf_counter()
     results = engine.run(trace)
     wall_s = time.perf_counter() - t0
+    compiles_measured = engine.compile_events - compiles_before
+    if strict_compiles and compiles_measured > 0:
+        raise RuntimeError(
+            f"{compiles_measured} compile event(s) fired after warmup during "
+            f"the serving replay (warmup compiled {compiles_warmup}): a "
+            "mid-traffic recompile — some program shape is not pinned to "
+            "the bucket ladder (chase with JAX_LOG_COMPILES=1, or pass "
+            "strict_compiles=False to report anyway)"
+        )
     m = engine.metrics
     p = engine.plugin
     import jax
@@ -162,6 +183,12 @@ def replay(engine, trace: list[Request]) -> dict:
         "evictions": m["evictions"],
         "prefill_buckets": list(p.prefill_buckets),
         "num_slots": p.num_slots,
+        # CheckFreq twins for the recompile guard: post-warmup the bucket
+        # ladder predicts zero compiles; measured is the monitoring stream
+        "compiles_predicted": 0,
+        "compiles_measured": compiles_measured,
+        "compiles_warmup": compiles_warmup,
+        "programs_predicted": len(p.prefill_buckets) + 3,  # + decode/release/sampler
         "results": results,
     }
 

@@ -524,6 +524,55 @@ class ServingPlugin(KwargsHandler):
 
 
 @dataclass
+class PreflightConfig(KwargsHandler):
+    """Deploy-preflight knobs (``commands/preflight.py`` — AOT-compile every
+    production program and audit the executables; see the "Deploy
+    preflight" section of docs/static_analysis.md).
+
+    Every knob reads an ``ACCELERATE_PREFLIGHT_*`` environment default in
+    ``__post_init__`` (explicit arguments win — the plugin contract).
+    """
+
+    hbm_gb: Optional[float] = None           # HBM budget for GL302; None = use the
+                                             # backend's measured bytes_limit (CPU
+                                             # reports none -> GL302 skipped)
+                                             # (env ACCELERATE_PREFLIGHT_HBM_GB)
+    donation_slack_bytes: int = -1           # non-aliased donated bytes tolerated
+                                             # before GL301 (scalar counters XLA
+                                             # reasonably declines; default 1024,
+                                             # env ACCELERATE_PREFLIGHT_DONATION_SLACK)
+    fail_on: str = ""                        # lowest severity that fails the run
+                                             # ("error" | "warning" | "info"; env
+                                             # ACCELERATE_PREFLIGHT_FAIL_ON, default
+                                             # error — GL301/GL302 are errors)
+    optimizer: str = ""                      # optimizer recipe for the train-step
+                                             # program (env
+                                             # ACCELERATE_PREFLIGHT_OPTIMIZER,
+                                             # default lion)
+
+    def __post_init__(self):
+        env = os.environ
+        if self.hbm_gb is None:
+            raw = env.get("ACCELERATE_PREFLIGHT_HBM_GB")
+            self.hbm_gb = float(raw) if raw else None
+        if self.donation_slack_bytes < 0:
+            self.donation_slack_bytes = int(
+                env.get("ACCELERATE_PREFLIGHT_DONATION_SLACK", 1024)
+            )
+        if not self.fail_on:
+            self.fail_on = env.get("ACCELERATE_PREFLIGHT_FAIL_ON", "error")
+        if self.fail_on not in ("error", "warning", "info"):
+            raise ValueError(
+                f"PreflightConfig.fail_on must be 'error', 'warning' or "
+                f"'info', got {self.fail_on!r}"
+            )
+        if not self.optimizer:
+            self.optimizer = env.get("ACCELERATE_PREFLIGHT_OPTIMIZER", "lion")
+        if self.hbm_gb is not None and self.hbm_gb <= 0:
+            raise ValueError(f"PreflightConfig.hbm_gb must be > 0, got {self.hbm_gb}")
+
+
+@dataclass
 class TensorParallelConfig(KwargsHandler):
     """reference TorchTensorParallelConfig dataclasses.py:2264.
 

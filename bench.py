@@ -159,6 +159,7 @@ def selftest_kernels(report: dict) -> None:
     # m=64 -> the tiled (M, F, K) kernel; m=1 -> the whole-F-resident decode
     # kernel (its own Mosaic-sensitive constructs: K-only grid, in-kernel
     # chunked dequant, masked partial K for non-divisor H like 7B's 11008/4)
+    jitted_qmm = jax.jit(quantized_matmul)  # one wrapper; jit caches per shape
     for mm, hh2, ff2, label in [
         (64, 512, 1024, "int8_matmul"),
         (1, 2048, 5632, "int8_decode"),
@@ -167,7 +168,7 @@ def selftest_kernels(report: dict) -> None:
         w = (np.random.default_rng(5).standard_normal((hh2, ff2)) * 0.02).astype(np.float32)
         x = jax.random.normal(jax.random.key(12), (mm, hh2), jnp.bfloat16)
         qt = quantize(jax.device_put(jnp.asarray(w)), QuantizationConfig(load_in_8bit=True))
-        got = np.asarray(jax.jit(quantized_matmul)(x, qt).astype(jnp.float32))
+        got = np.asarray(jitted_qmm(x, qt).astype(jnp.float32))
         want = np.asarray(x.astype(jnp.float32) @ dequantize(qt, jnp.float32))
         err = np.abs(got - want).max() / (np.abs(want).max() + 1e-6)
         assert err < 2e-2, f"{label} mismatch: rel {err:.4f}"
@@ -585,10 +586,26 @@ def main():
                                      optimizer=args.optimizer or "lion-sr"),
             }
         if args.audit:
+            from accelerate_tpu.analysis import Report, apply_suppressions
             from accelerate_tpu.commands.lint import audit_canonical_step
+            from accelerate_tpu.commands.preflight import preflight_train
+            from accelerate_tpu.state import AcceleratorState, GradientState
+            from accelerate_tpu.utils.dataclasses import PreflightConfig
 
             audit = audit_canonical_step(args.optimizer or "lion-sr")
             rep["extra"]["audit"] = audit.summary()
+            AcceleratorState._reset_state(reset_partial_state=True)
+            GradientState._reset_state()
+            # the compiled twin rides next to the trace audit: AOT-compile
+            # the same canonical step and audit the executable (GL301-303
+            # + the flops/bytes cost row the predicted-MFU math feeds on)
+            findings, rows = preflight_train(
+                PreflightConfig(optimizer=args.optimizer or "lion-sr")
+            )
+            compiled_report = Report(apply_suppressions(findings))
+            rep["extra"]["compiled_audit"] = {
+                **compiled_report.summary(), "programs": rows,
+            }
         print(json.dumps(rep))
         return
 
@@ -953,12 +970,18 @@ def main():
             args.trace, dev_substr, breakdown=extra_report["op_breakdown"]
         )
 
+    compiles_before = acc.compile_events
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, b)
     float(metrics["loss"])  # host fetch: everything up to here has executed
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
+    # recompile guard twins (ALWAYS emitted): the warmup step above already
+    # compiled the program, so the steady-state loop predicts exactly zero
+    # compile events — a non-zero measured count is a re-keyed jit cache
+    # (the GL304 promotion-drift shape) poisoning every number in this report
+    compiles_measured = acc.compile_events - compiles_before
 
     toks_per_step = batch * seq
     toks_per_sec = toks_per_step * iters / dt
@@ -1030,6 +1053,8 @@ def main():
         "nan_skips": goodput["nan_skips"],
         "restarts": goodput["restarts"],
         "goodput_frac": goodput["goodput_frac"],
+        "compiles_predicted": 0,
+        "compiles_measured": compiles_measured,
     }
     extra_report["goodput"] = goodput
 

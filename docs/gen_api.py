@@ -23,7 +23,10 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
     "analysis": ("accelerate_tpu.analysis", [
         "Severity", "Finding", "Report", "Rule", "audit_fn", "audit_jitted",
         "audit_traced", "lint_source", "lint_paths", "iter_python_files",
-        "apply_suppressions", "parse_marker",
+        "resolve_targets", "apply_suppressions", "parse_marker",
+        "CompileCounter", "install_global_compile_counter",
+        "aot_compile_program", "audit_compiled", "audit_aot",
+        "audit_program_set", "device_hbm_bytes",
     ]),
     "state": ("accelerate_tpu.state", ["PartialState", "AcceleratorState", "GradientState"]),
     "parallelism_config": ("accelerate_tpu.parallelism_config", ["ParallelismConfig"]),

@@ -79,6 +79,33 @@ def collective_matmul_hint_step(x, w):
     )(x[None], w)
 
 
+def collective_matmul_rs_hint_step(x, w):
+    """GL107 fixed: the matmul-then-scatter pipe rides the ring schedule —
+    per-chunk partial matmuls with ppermute accumulator hops hidden under
+    them, no reduce_scatter in the trace (ops/collective_matmul.py)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from accelerate_tpu.ops.collective_matmul import ring_matmul_reduce_scatter
+
+    try:
+        from jax import shard_map as _shard_map
+
+        _no_check = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        _no_check = {"check_rep": False}
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("x",))
+
+    def body(xl, wl):
+        return ring_matmul_reduce_scatter(xl, wl, "x")
+
+    return _shard_map(body, mesh=mesh,
+                      in_specs=(P(None, None, "x"), P("x", None)),
+                      out_specs=P(None, "x", None), **_no_check)(x, w)
+
+
 def example_args():
     return {
         "wasted_donation_step": (jnp.ones((64, 64)), jnp.ones((64, 64))),
@@ -88,4 +115,5 @@ def example_args():
         "transfer_in_trace_step": (jnp.ones((8,)),),
         "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
         "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
+        "collective_matmul_rs_hint_step": (jnp.ones((1, 8, 16)), jnp.ones((16, 4))),
     }

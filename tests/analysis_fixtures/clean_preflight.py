@@ -1,0 +1,82 @@
+"""Corrected twins of ``planted_preflight.py`` — same audit parameters,
+zero findings."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def donation_dropped_step(state, batch):
+    """GL301 fixed: the update has the donated argument's exact aval, so
+    the compiled executable aliases the donated buffer to it."""
+    return state * 0.9 + batch, (state * batch).sum()
+
+
+def hbm_hog_step(x):
+    """GL302 fixed (same 4 KiB budget): the footprint shrank to fit it —
+    the example input is a vector, not the 64x64 working set."""
+    return x * 2.0 + 1.0
+
+
+# GL303 fixed: every compiled width IS a declared bucket
+BUCKETS = (16, 32)
+COMPILED_WIDTHS = (16, 32)
+
+
+def prefill_like(ids):
+    return ids.astype(jnp.float32) * 2.0
+
+
+def promotion_drift_step(state, batch):
+    """GL304 fixed: the scalar is typed to the state's dtype, so the
+    output aval equals the donated input aval — stable cache key, live
+    donation alias."""
+    new_state = state - jnp.asarray(0.1, state.dtype) * batch
+    return new_state, (state * batch).sum()
+
+
+@partial(jax.jit, static_argnames=("width",))
+def ragged_positions(ids, start, width):
+    """GL305 fixed: the width is an explicit static argument fed from the
+    bucket ladder — no traced-shape read, one compile per declared bucket."""
+    del ids
+    return start + jnp.arange(width)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def bucketed_zeros(spec, x):
+    """GL305's static exemption: reading ``.shape`` of a STATIC argument is
+    trace-time constant folding, not shape drift — stays quiet."""
+    return jnp.zeros(spec.shape[0]) + x.sum()
+
+
+_jitted_decode = jax.jit(lambda v: v * 2.0)
+
+
+def decode_loop(xs):
+    """GL306 fixed: one wrapper hoisted above the loop; jit caches the
+    compiled program across iterations."""
+    return [_jitted_decode(x) for x in xs]
+
+
+def step_factories(scales):
+    """GL306's defined-not-executed exemption: the jit lives in a function
+    *defined* in the loop body — each wrapper is constructed once, when the
+    factory is later called, not per loop iteration.  Stays quiet."""
+    factories = []
+    for scale in scales:
+        def make(s=scale):
+            return jax.jit(lambda v: v * s)
+        factories.append(make)
+    return factories
+
+
+def example_args():
+    return {
+        "donation_dropped_step": (jnp.ones((64, 64)), jnp.ones((64, 64))),
+        "hbm_hog_step": (jnp.ones((8,)),),
+        "promotion_drift_step": (
+            jnp.ones((64, 64), jnp.bfloat16), jnp.ones((64, 64), jnp.bfloat16),
+        ),
+    }

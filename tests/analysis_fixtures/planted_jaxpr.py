@@ -86,6 +86,32 @@ def collective_matmul_hint_step(x, w):
                       out_specs=P(None, None), **_no_check)(x, w)
 
 
+def collective_matmul_rs_hint_step(x, w):
+    """GL107 (hint): the row-parallel mirror of GL106 — the full partial
+    matmul finishes before ONE monolithic reduce_scatter starts.  Only the
+    trace sees the single-consumer pipe."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+
+        _no_check = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        _no_check = {"check_rep": False}
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("x",))
+
+    def body(xl, wl):
+        part = jax.lax.dot_general(xl, wl, (((2,), (0,)), ((), ())))
+        return jax.lax.psum_scatter(part, "x", scatter_dimension=1, tiled=True)
+
+    return _shard_map(body, mesh=mesh,
+                      in_specs=(P(None, None, "x"), P("x", None)),
+                      out_specs=P(None, "x", None), **_no_check)(x, w)
+
+
 def example_args():
     """Concrete example inputs for each planted function (tiny; tracing
     only reads shapes/dtypes)."""
@@ -97,4 +123,5 @@ def example_args():
         "transfer_in_trace_step": (jnp.ones((8,)),),
         "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
         "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
+        "collective_matmul_rs_hint_step": (jnp.ones((1, 8, 16)), jnp.ones((16, 4))),
     }

@@ -1,0 +1,71 @@
+"""PLANTED BUGS for the compiled auditor + recompile rules (GL301-GL306).
+
+One function (or source shape) per rule; ``tests/test_preflight.py`` drives
+the compiled rules through real AOT ``lower().compile()`` (CPU-safe —
+nothing executes) and the AST rules through ``lint_paths``.  Corrected
+twins: ``clean_preflight.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def donation_dropped_step(state, batch):
+    """GL301: the test compiles with ``donate_argnums=(0,)``, but only a
+    scalar comes back — XLA's memory analysis shows zero aliased bytes, so
+    the donation freed nothing and the caller still lost the buffer."""
+    return (state * batch).sum()
+
+
+def hbm_hog_step(x):
+    """GL302 (audited against a deliberately tiny ``--hbm-gb`` budget): the
+    64x64 matmul's argument+output+temp footprint blows a 4 KiB budget."""
+    return (x @ x.T) + x
+
+
+# GL303: the declared bucket ladder vs the widths the deploy actually
+# compiles — 24 is the stray lowering no bucket predicts (a mid-traffic
+# recompile once a 17..24-token prompt arrives)
+BUCKETS = (16, 32)
+COMPILED_WIDTHS = (16, 24, 32)
+
+
+def prefill_like(ids):
+    """One distinct lowering per input width (the GL303 program set)."""
+    return ids.astype(jnp.float32) * 2.0
+
+
+def promotion_drift_step(state, batch):
+    """GL304: the np.float32 learning-rate scalar promotes the donated
+    bf16 state to f32 — the fed-back result re-keys the jit cache every
+    step, and the widened output can no longer alias the donated buffer."""
+    new_state = state - np.float32(0.1) * batch
+    return new_state, (state * batch).sum()
+
+
+@jax.jit
+def ragged_positions(ids, start):
+    """GL305: ``ids.shape[0]`` flows straight into ``jnp.arange`` and
+    ``ids`` is not static — the program re-specializes per prompt length
+    (the unbucketed-prefill recompile shape)."""
+    return start + jnp.arange(ids.shape[0])
+
+
+def decode_loop(xs):
+    """GL306: a fresh ``jax.jit`` wrapper (and cache) every iteration."""
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda v: v * 2.0)(x))
+    return out
+
+
+def example_args():
+    """Concrete example inputs (tiny; compiling reads only shapes/dtypes)."""
+    return {
+        "donation_dropped_step": (jnp.ones((64, 64)), jnp.ones((64, 64))),
+        "hbm_hog_step": (jnp.ones((64, 64)),),
+        "promotion_drift_step": (
+            jnp.ones((64, 64), jnp.bfloat16), jnp.ones((64, 64), jnp.bfloat16),
+        ),
+    }

@@ -114,10 +114,11 @@ def test_report_counts_exit_code_and_json():
 
 
 def test_every_emitted_rule_is_in_the_catalog():
-    # both engines draw severities/hints from rules.RULES; ids must resolve
+    # all three engines draw severities/hints from rules.RULES; ids must resolve
     for rule_id in ("GL001", "GL002", "GL101", "GL102", "GL103", "GL104",
-                    "GL105", "GL106", "GL201", "GL202", "GL203", "GL204",
-                    "GL205"):
+                    "GL105", "GL106", "GL107", "GL201", "GL202", "GL203",
+                    "GL204", "GL205", "GL301", "GL302", "GL303", "GL304",
+                    "GL305", "GL306"):
         assert rule_id in RULES
         assert RULES[rule_id].summary and RULES[rule_id].fix_hint
 
@@ -134,6 +135,7 @@ _JAXPR_CASES = [
     ("transfer_in_trace_step", "GL103", {"default_memory_kind": "device"}),
     ("unsharded_output_step", "GL105", {}),
     ("collective_matmul_hint_step", "GL106", {}),
+    ("collective_matmul_rs_hint_step", "GL107", {}),
 ]
 
 
@@ -184,6 +186,17 @@ def test_jaxpr_suppression_resolves_through_source_info(tmp_path):
     rep = audit_fn(mod.reuse, jax.random.key(0), jnp.ones((4,)))
     assert not rep.unsuppressed(), rep.render()
     assert any(x.rule == "GL104" and x.suppressed for x in rep.findings)
+
+
+def test_gl107_hint_severity_matches_gl106():
+    # GL107 is GL106's row-parallel mirror: same INFO severity, same
+    # never-fails-a-run contract
+    mod = _load_fixture("planted_jaxpr")
+    fname = "collective_matmul_rs_hint_step"
+    rep = audit_fn(getattr(mod, fname), *mod.example_args()[fname])
+    hints = [f for f in rep.findings if f.rule == "GL107"]
+    assert hints and all(f.severity == Severity.INFO for f in hints)
+    assert rep.exit_code() == 0
 
 
 def test_gl106_hint_severity_and_suppressible(tmp_path):

@@ -121,6 +121,11 @@ def test_bench_resilience_fields_always_emitted():
     goodput = extra["goodput"]
     assert goodput["kind"] == "measured"
     assert goodput["steps"] > 0 and goodput["preemptions"] == 0
+    # recompile-guard twins ride EVERY train report: after the warmup step
+    # the steady-state loop predicts zero compiles, and a clean run measures
+    # exactly that (the zeros-clean contract)
+    assert extra["compiles_predicted"] == 0
+    assert extra["compiles_measured"] == extra["compiles_predicted"] == 0
 
     # the fields ride the offload flavor too (next to the streaming fields)
     rep_off = _run(["bench.py", "--iters", "2", "--batch", "8", "--offload",
@@ -156,6 +161,13 @@ def test_bench_serve_smoke():
     # the predicted KV-HBM ladder rides every serve report
     assert extra["kv_pool"]["bytes_per_page"] > 0
     assert "v5e_16GiB" in extra["kv_pool"]["hbm_frac"]
+    # the seeded replay's recompile-guard twins: warmup compiles every
+    # fixed-shape program up front, then the replay measures ZERO compile
+    # events — compiles_measured == compiles_predicted pins that no
+    # mid-traffic recompile fired (the harness raises if one does)
+    assert extra["compiles_predicted"] == 0
+    assert extra["compiles_measured"] == extra["compiles_predicted"] == 0
+    assert extra["programs_predicted"] == len(extra["prefill_buckets"]) + 3
 
     # idle trace: every field still present, zeros (the always-emitted
     # contract BENCH_*.json relies on)
@@ -180,6 +192,15 @@ def test_bench_plan_audit_hook():
     assert audit["ok"] is True
     assert audit["error"] == 0 and audit["warning"] == 0
     assert "rules" in audit and "suppressed" in audit
+    # the compiled twin rides next to the trace audit: the same canonical
+    # step AOT-compiled and audited at the executable level (GL301-303),
+    # with the per-program cost row the predicted-MFU math feeds on
+    compiled = rep["extra"]["compiled_audit"]
+    assert compiled["ok"] is True and compiled["error"] == 0
+    assert len(compiled["programs"]) == 1
+    prog = compiled["programs"][0]
+    assert prog["hbm"]["total"] > 0 and prog["flops"] > 0
+    assert prog["aliased_bytes"] > 0  # the donated state actually aliased
 
     # audit rides along on the inference plan flavor too
     rep_inf = _run(["bench.py", "--plan", "8", "--batch", "8",

@@ -355,7 +355,7 @@ def test_cli_help_lists_subcommands():
     )
     assert result.returncode == 0
     for sub in ("config", "env", "launch", "test", "estimate-memory", "merge-weights",
-                "tpu-config", "from-accelerate"):
+                "tpu-config", "from-accelerate", "lint", "preflight"):
         assert sub in result.stdout
 
 

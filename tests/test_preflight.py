@@ -1,0 +1,564 @@
+"""Compiled-artifact auditing + deploy preflight (analysis/compiled_audit.py,
+commands/preflight.py): GL301-GL306 over the planted/clean fixture twins,
+the compile-event counter, the serving warmup/recompile guard, and the CLI
+surface.  All CPU-safe: AOT compilation needs a backend but executes
+nothing, and every compiled program here is tiny.
+
+Budget discipline (tier-1 is compile-bound): the in-process tests compile
+only toy 64x64 programs; the single tier-1 CLI smoke preflights the tiny
+2-bucket serving ladder + the canonical train step — 5 programs, the
+asserted ceiling.  Anything compiling more is marked slow.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import warnings
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.analysis import (
+    RULES,
+    Report,
+    Severity,
+    apply_suppressions,
+    audit_aot,
+    audit_fn,
+    audit_program_set,
+    lint_paths,
+    lint_source,
+)
+from accelerate_tpu.analysis.compiled_audit import (
+    CompileCounter,
+    aot_compile_program,
+    audit_compiled,
+    device_hbm_bytes,
+    install_global_compile_counter,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+
+
+def _load_fixture(name):
+    spec = importlib.util.spec_from_file_location(name, FIXTURES / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rules_of(report_or_findings):
+    findings = getattr(report_or_findings, "unsuppressed", None)
+    findings = findings() if findings else report_or_findings
+    return {f.rule for f in findings}
+
+
+def _cli(args, env_extra=None, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu", "preflight", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+# ---------------------------------------------------------------------------
+# the compile-event counter
+# ---------------------------------------------------------------------------
+
+
+def test_compile_counter_counts_backend_compiles():
+    with CompileCounter() as c:
+        jax.jit(lambda x: x * 1.618034)(jnp.ones((7,)))
+    first = c.count
+    assert first >= 1
+    # stopped: later compiles are not attributed to this counter
+    jax.jit(lambda x: x * 2.618034)(jnp.ones((7,)))
+    assert c.count == first
+
+
+def test_global_counter_is_idempotent_and_monotonic():
+    a = install_global_compile_counter()
+    b = install_global_compile_counter()
+    assert a is b
+    before = a.count
+    jax.jit(lambda x: x + 0.577216)(jnp.ones((3,)))
+    assert a.count > before
+
+
+# ---------------------------------------------------------------------------
+# GL301/GL302: the compiled audit over the fixture twins
+# ---------------------------------------------------------------------------
+
+
+def test_gl301_planted_donation_not_aliased():
+    mod = _load_fixture("planted_preflight")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # jax's own donation warning
+        rep, row = audit_aot(
+            mod.donation_dropped_step,
+            *mod.example_args()["donation_dropped_step"],
+            donate_argnums=(0,), label="planted",
+        )
+    assert "GL301" in _rules_of(rep), rep.render()
+    assert row["aliased_bytes"] == 0 and row["donated_bytes"] > 0
+
+
+def test_gl301_clean_twin_aliases_fully():
+    mod = _load_fixture("clean_preflight")
+    rep, row = audit_aot(
+        mod.donation_dropped_step,
+        *mod.example_args()["donation_dropped_step"],
+        donate_argnums=(0,), label="clean",
+    )
+    assert not rep.unsuppressed(), rep.render()
+    assert row["aliased_bytes"] == row["donated_bytes"] > 0
+
+
+def test_gl301_immune_to_persistent_cache_deserialization(tmp_path):
+    """The sharp edge the auditor must absorb: an executable DESERIALIZED
+    from the persistent compilation cache loses its donation alias table
+    (alias_size_in_bytes reads 0).  Warm the disk cache, clear the
+    in-memory caches, deserialize via a jit call — the audit must still
+    compile fresh and report the alias honestly (no false GL301)."""
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+        def f(s, b):
+            return s * 0.7 + b, (s * b).sum()
+
+        def args():  # fresh buffers each call: the jit calls DONATE s
+            return jnp.ones((64, 64)), jnp.ones((64, 64))
+
+        jax.jit(f, donate_argnums=(0,))(*args())  # writes the disk entry
+        jax.clear_caches()
+        jax.jit(f, donate_argnums=(0,))(*args())  # deserializes (alias lost)
+        rep, row = audit_aot(f, *args(), donate_argnums=(0,), label="poisoned")
+        assert "GL301" not in _rules_of(rep), rep.render()
+        assert row["aliased_bytes"] == row["donated_bytes"] > 0
+        assert row["compile_events"] >= 1  # a REAL compile, not a cache read
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def test_gl301_slack_tolerates_scalar_members():
+    # a non-aliased donated SCALAR stays under the default 1 KiB slack —
+    # the shape XLA reasonably declines (step counters etc.)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rep, _ = audit_aot(
+            lambda c, x: x * 2.0, jnp.int32(3), jnp.ones((8,)),
+            donate_argnums=(0,), label="scalar-donation",
+        )
+    assert "GL301" not in _rules_of(rep), rep.render()
+
+
+@pytest.mark.parametrize("fixture,expect_over", [
+    ("planted_preflight", True), ("clean_preflight", False),
+])
+def test_gl302_hbm_budget(fixture, expect_over):
+    mod = _load_fixture(fixture)
+    rep, row = audit_aot(
+        mod.hbm_hog_step, *mod.example_args()["hbm_hog_step"],
+        label="hog", hbm_budget_bytes=4096,
+    )
+    assert ("GL302" in _rules_of(rep)) is expect_over, rep.render()
+    assert row["hbm"]["total"] > 0
+
+
+def test_device_hbm_bytes_explicit_budget_wins():
+    assert device_hbm_bytes(2.0) == 2 * 2**30
+    # CPU backend reports no bytes_limit -> None (GL302 skipped, not guessed)
+    assert device_hbm_bytes(None) in (None,) or device_hbm_bytes(None) > 0
+
+
+# ---------------------------------------------------------------------------
+# GL303: the program set vs the predicted bucket ladder
+# ---------------------------------------------------------------------------
+
+
+def test_gl303_planted_stray_width_vs_clean_ladder():
+    for name, expect in (("planted_preflight", True), ("clean_preflight", False)):
+        mod = _load_fixture(name)
+        rows = []
+        with CompileCounter() as counter:
+            for width in mod.COMPILED_WIDTHS:
+                prog = aot_compile_program(
+                    mod.prefill_like, jax.ShapeDtypeStruct((width,), jnp.int32),
+                    label=f"prefill[{width}]",
+                )
+                _, row = audit_compiled(prog.compiled, label=f"prefill[{width}]")
+                rows.append(row)
+        findings = audit_program_set(
+            rows, len(mod.BUCKETS), measured_compile_events=counter.count
+        )
+        assert (any(f.rule == "GL303" for f in findings)) is expect, (name, findings)
+
+
+def test_gl303_extra_backend_compiles_flagged():
+    rows = [{"program": "decode"}, {"program": "release"}]
+    findings = audit_program_set(rows, 2, measured_compile_events=5)
+    assert _rules_of(findings) == {"GL303"}
+    # cache hits (measured < programs) are fine
+    assert audit_program_set(rows, 2, measured_compile_events=0) == []
+
+
+# ---------------------------------------------------------------------------
+# GL304: donated promotion drift (jaxpr engine)
+# ---------------------------------------------------------------------------
+
+
+def test_gl304_planted_promotion_drift_flagged():
+    mod = _load_fixture("planted_preflight")
+    rep = audit_fn(
+        mod.promotion_drift_step, *mod.example_args()["promotion_drift_step"],
+        donate_argnums=(0,),
+    )
+    assert "GL304" in _rules_of(rep), rep.render()
+
+
+def test_gl304_clean_twin_quiet():
+    mod = _load_fixture("clean_preflight")
+    rep = audit_fn(
+        mod.promotion_drift_step, *mod.example_args()["promotion_drift_step"],
+        donate_argnums=(0,),
+    )
+    assert not rep.unsuppressed(), rep.render()
+
+
+def test_gl304_int_to_float_drift_variant():
+    # a python FLOAT mixed into an int state: int32 -> f32 drift, same shape
+    def f(state):
+        return state + 0.5, state.sum()
+
+    rep = audit_fn(
+        f, jax.ShapeDtypeStruct((4, 4), jnp.int32), donate_argnums=(0,)
+    )
+    assert "GL304" in _rules_of(rep), rep.render()
+
+
+# ---------------------------------------------------------------------------
+# GL305/GL306: the AST recompile-cause rules
+# ---------------------------------------------------------------------------
+
+
+def test_gl305_fixture_twins():
+    planted = lint_paths([FIXTURES / "planted_preflight.py"], excludes=())
+    assert {"GL305", "GL306"} <= _rules_of(planted), planted.render()
+    clean = lint_paths([FIXTURES / "clean_preflight.py"], excludes=())
+    assert not clean.unsuppressed(), clean.render()
+
+
+def test_gl305_static_args_are_exempt():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from functools import partial\n"
+        "@partial(jax.jit, static_argnums=(0,))\n"
+        "def f(spec, x):\n"
+        "    return jnp.zeros(spec.shape[0]) + x\n"
+        "@partial(jax.jit, static_argnames=('spec',))\n"
+        "def g(x, spec):\n"
+        "    return jnp.zeros(spec.shape[0]) + x\n"
+    )
+    assert lint_source(src, "m.py") == []
+
+
+def test_gl305_jit_binding_statics_respected():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(spec, x):\n"
+        "    return jnp.zeros(spec.shape[0]) + x\n"
+        "jitted = jax.jit(f, static_argnums=(0,))\n"
+    )
+    assert lint_source(src, "m.py") == []
+    bad = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def f(spec, x):\n"
+        "    return jnp.zeros(spec.shape[0]) + x\n"
+        "jitted = jax.jit(f)\n"
+    )
+    assert _rules_of(lint_source(bad, "m.py")) == {"GL305"}
+
+
+def test_gl305_local_binding_is_the_documented_miss():
+    # the width bound to a local first is not flagged (documented miss:
+    # the serving engine's bucket-pinned programs read widths this way)
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def f(ids):\n"
+        "    n = ids.shape[0]\n"
+        "    return jnp.arange(n)\n"
+    )
+    assert lint_source(src, "m.py") == []
+
+
+def test_gl306_loop_variants():
+    src = (
+        "import jax\n"
+        "def a(xs):\n"
+        "    for x in xs:\n"
+        "        y = jax.jit(lambda v: v)(x)\n"
+        "    return y\n"
+        "def b(xs):\n"
+        "    i = 0\n"
+        "    while i < len(xs):\n"
+        "        f = jax.jit(lambda v: v)\n"
+        "        i += 1\n"
+        "    return f\n"
+    )
+    findings = [f for f in lint_source(src, "m.py") if f.rule == "GL306"]
+    assert len(findings) == 2
+    # hoisted wrapper: quiet
+    good = (
+        "import jax\n"
+        "f = jax.jit(lambda v: v)\n"
+        "def a(xs):\n"
+        "    for x in xs:\n"
+        "        y = f(x)\n"
+        "    return y\n"
+    )
+    assert lint_source(good, "m.py") == []
+
+
+def test_new_rules_are_in_the_catalog():
+    for rule_id in ("GL107", "GL301", "GL302", "GL303", "GL304", "GL305", "GL306"):
+        assert rule_id in RULES
+        assert RULES[rule_id].summary and RULES[rule_id].fix_hint
+    assert RULES["GL107"].severity == Severity.INFO
+    assert RULES["GL301"].severity == Severity.ERROR
+    assert RULES["GL302"].severity == Severity.ERROR
+    assert RULES["GL301"].engine == RULES["GL302"].engine == "compiled"
+
+
+# ---------------------------------------------------------------------------
+# the preflight engine pieces (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_preflight_serve_compiles_exactly_the_ladder():
+    from accelerate_tpu.commands.preflight import preflight_serve
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils.dataclasses import PreflightConfig, ServingPlugin
+
+    plugin = ServingPlugin(
+        num_slots=4, page_size=4, pages_per_slot=16, num_pages=40,
+        prefill_chunk=32, prefill_buckets=(16, 32), decode_kernel="native",
+    )
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    findings, rows = preflight_serve(
+        PreflightConfig(), model=model, plugin=plugin,
+        gen_config=GenerationConfig(),
+    )
+    report = Report(apply_suppressions(findings))
+    assert not report.unsuppressed(), report.render()
+    assert len(rows) == len(plugin.prefill_buckets) + 2
+    labels = {r["program"] for r in rows}
+    assert labels == {"decode", "release", "prefill[16]", "prefill[32]"}
+    for row in rows:
+        assert row["hbm"]["total"] > 0
+        assert row["flops"] >= 0
+
+
+def test_preflight_program_loads_fixture_convention(tmp_path):
+    from accelerate_tpu.commands.preflight import preflight_program
+    from accelerate_tpu.utils.dataclasses import PreflightConfig
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        findings, rows = preflight_program(
+            f"{FIXTURES / 'planted_preflight.py'}::donation_dropped_step::donate=0",
+            PreflightConfig(),
+        )
+    assert "GL301" in {f.rule for f in findings}
+    assert len(rows) == 1
+    # a bad target is a loud GL002, the shared resolver contract
+    findings, rows = preflight_program(
+        f"{tmp_path / 'nope.py'}::fn", PreflightConfig()
+    )
+    assert {f.rule for f in findings} == {"GL002"} and rows == []
+
+
+# ---------------------------------------------------------------------------
+# serving warmup + runtime recompile guard
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_serving():
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+    return model, params, GenerationConfig(max_new_tokens=6)
+
+
+def test_serving_replay_compile_twins_zero_after_warmup(tiny_serving):
+    """The acceptance pin: a seeded replay reports compiles_measured ==
+    compiles_predicted (== 0) after warmup — no mid-traffic recompile."""
+    from accelerate_tpu.serving import ServingEngine, replay, synthesize_trace
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    model, params, gen = tiny_serving
+    plugin = ServingPlugin(
+        num_slots=4, page_size=4, pages_per_slot=16, num_pages=40,
+        prefill_chunk=16, prefill_buckets=(8, 16), decode_kernel="native",
+    )
+    engine = ServingEngine(model, params, plugin, gen)
+    assert engine.compile_events == 0  # nothing compiled at construction
+    rep = replay(engine, synthesize_trace(3, 6, vocab_size=model.config.vocab_size))
+    assert rep["compiles_predicted"] == 0
+    assert rep["compiles_measured"] == rep["compiles_predicted"] == 0
+    assert rep["programs_predicted"] == len(plugin.prefill_buckets) + 3
+    assert rep["completed"] == rep["requests"] > 0
+    # warmup is engine-side state: a second replay run skips it
+    assert engine.warmed_up
+
+
+def test_serving_warmup_is_a_scheduling_noop(tiny_serving):
+    """Warmup compiles every program but records nothing: token results of
+    a warmed engine are identical to a cold one's (the greedy-parity
+    contract extends through warmup)."""
+    from accelerate_tpu.serving import ServingEngine, synthesize_trace
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    model, params, gen = tiny_serving
+    plugin = ServingPlugin(
+        num_slots=4, page_size=4, pages_per_slot=16, num_pages=40,
+        prefill_chunk=16, prefill_buckets=(8, 16), decode_kernel="native",
+    )
+    trace = synthesize_trace(5, 5, vocab_size=model.config.vocab_size)
+    cold = ServingEngine(model, params, plugin, gen)
+    cold_results = cold.run(list(trace))
+    warm = ServingEngine(model, params, plugin, gen)
+    warm.warmup()
+    assert warm.steps == 0 and warm.idle()
+    after_warmup = warm.compile_events
+    warm_results = warm.run(list(trace))
+    assert warm_results == cold_results
+    # post-warmup the replay was compile-free (the fixed-shape contract)
+    assert warm.compile_events == after_warmup
+
+
+def test_serving_warmup_refuses_mid_traffic(tiny_serving):
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.serving.scheduler import Request
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    model, params, gen = tiny_serving
+    plugin = ServingPlugin(
+        num_slots=4, page_size=4, pages_per_slot=16, num_pages=40,
+        prefill_chunk=16, prefill_buckets=(8, 16), decode_kernel="native",
+    )
+    engine = ServingEngine(model, params, plugin, gen)
+    engine.add_request(Request(uid=0, prompt=(1, 2, 3), max_new_tokens=4))
+    engine.sched.admit()
+    with pytest.raises(RuntimeError, match="before any traffic"):
+        engine.warmup()
+
+
+# ---------------------------------------------------------------------------
+# the CLI (tier-1: ONE smoke, <= 5 compiled programs; failure paths ride
+# in-process through the same command function)
+# ---------------------------------------------------------------------------
+
+_TINY_SERVE_ENV = {
+    "ACCELERATE_SERVE_SLOTS": "4",
+    "ACCELERATE_SERVE_PAGE_SIZE": "4",
+    "ACCELERATE_SERVE_PAGES_PER_SLOT": "16",
+    "ACCELERATE_SERVE_PAGES": "40",
+    "ACCELERATE_SERVE_PREFILL_CHUNK": "32",
+    "ACCELERATE_SERVE_KERNEL": "native",
+}
+
+
+def test_preflight_cli_smoke_tier1():
+    """The acceptance command: ``python -m accelerate_tpu preflight --serve
+    --train`` on the tiny CPU config compiles exactly len(buckets)+2
+    serving programs (+1 train step — 5 total, the tier-1 ceiling), reports
+    per-program HBM + flops, and exits 0 with zero unsuppressed findings."""
+    out = _cli(["--serve", "--train", "--json", "--no-lint"],
+               env_extra=_TINY_SERVE_ENV)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    payload = json.loads(out.stdout)
+    assert payload["summary"]["ok"] is True
+    assert payload["summary"]["error"] == payload["summary"]["warning"] == 0
+    programs = payload["programs"]
+    # tiny 2-bucket ladder (prefill_chunk=32 -> buckets (16, 32)): decode +
+    # release + 2 prefills + the train step — the tier-1 <=5 budget guard
+    assert len(programs) == 2 + 2 + 1 <= 5
+    serve_labels = {p["program"] for p in programs if "train" not in p["program"]}
+    assert serve_labels == {"decode", "release", "prefill[16]", "prefill[32]"}
+    for p in programs:
+        assert p["hbm"]["total"] > 0, p
+        assert "flops" in p and "bytes_accessed" in p and "compile_s" in p
+
+
+def _run_inprocess_cli(argv):
+    from accelerate_tpu.commands.preflight import (
+        preflight_command, preflight_command_parser,
+    )
+
+    args = preflight_command_parser().parse_args(argv)
+    with pytest.raises(SystemExit) as exc:
+        preflight_command(args)
+    return exc.value.code
+
+
+def test_preflight_cli_hbm_budget_exit_nonzero(capsys):
+    mod_path = FIXTURES / "planted_preflight.py"
+    code = _run_inprocess_cli([
+        "--no-lint", "--hbm-gb", "0.0000001",
+        "--program", f"{mod_path}::hbm_hog_step",
+    ])
+    assert code == 1
+    assert "GL302" in capsys.readouterr().out
+
+
+def test_preflight_cli_planted_donation_exit_nonzero(capsys):
+    mod_path = FIXTURES / "planted_preflight.py"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        code = _run_inprocess_cli([
+            "--no-lint",
+            "--program", f"{mod_path}::donation_dropped_step::donate=0",
+        ])
+    assert code == 1
+    assert "GL301" in capsys.readouterr().out
+
+
+def test_preflight_and_lint_share_loud_missing_target(tmp_path, capsys):
+    """The factored resolver contract: the same typo'd path is a non-zero
+    GL002 exit in BOTH CLIs — never a silently skipped target."""
+    from accelerate_tpu.commands.lint import lint_command, lint_command_parser
+
+    missing = str(tmp_path / "typo.py")
+    code = _run_inprocess_cli(["--no-lint", "--program", f"{missing}::fn"])
+    assert code == 1 and "GL002" in capsys.readouterr().out
+    code2 = _run_inprocess_cli([missing, "--train"])
+    assert code2 == 1 and "GL002" in capsys.readouterr().out
+
+    args = lint_command_parser().parse_args(["--no-step-audit", missing])
+    with pytest.raises(SystemExit) as exc:
+        lint_command(args)
+    assert exc.value.code == 1 and "GL002" in capsys.readouterr().out
