@@ -209,6 +209,8 @@ def generate_paged(
     prompt_lengths=None,
     serving_plugin=None,
     rng=None,
+    adapters=None,
+    adapter_ids=None,
 ):
     """:func:`generate`-shaped decoding through the **paged serving path**
     (``accelerate_tpu/serving/``): the batch rows become requests, decode
@@ -220,6 +222,12 @@ def generate_paged(
     the acceptance contract tests/test_serving.py pins.  This is also the
     offline entry point for batch inference over the serving stack (the
     per-request path is :class:`~accelerate_tpu.serving.ServingEngine`).
+
+    Multi-tenant: pass an :class:`~accelerate_tpu.serving.AdapterStore` as
+    ``adapters`` plus per-row tenant ``adapter_ids`` (0 = base model) to
+    decode each row through its LoRA adapter — the per-request reference
+    path the serve-with-adapters parity test pins the batched engine
+    against.
     """
     from .serving import Request, ServingEngine
     from .utils.dataclasses import ServingPlugin
@@ -231,6 +239,10 @@ def generate_paged(
         prompt_lengths = [t_prompt] * b
     else:
         prompt_lengths = [int(x) for x in np.asarray(prompt_lengths)]
+    if adapter_ids is None:
+        adapter_ids = [0] * b
+    else:
+        adapter_ids = [int(x) for x in np.asarray(adapter_ids)]
     n_new = generation_config.max_new_tokens
     if serving_plugin is None:
         # provision for the offline case: every row resident at once
@@ -240,11 +252,12 @@ def generate_paged(
             num_slots=b, page_size=page_size, pages_per_slot=pages,
             num_pages=b * pages, prefill_chunk=max(16, t_prompt),
         )
-    engine = ServingEngine(model, params, serving_plugin, generation_config, rng=rng)
+    engine = ServingEngine(model, params, serving_plugin, generation_config,
+                           rng=rng, adapters=adapters)
     for i in range(b):
         engine.add_request(Request(
             uid=i, prompt=tuple(int(x) for x in input_ids[i, : prompt_lengths[i]]),
-            max_new_tokens=n_new,
+            max_new_tokens=n_new, adapter_id=adapter_ids[i],
         ))
     results = engine.run([])
     out = np.full((b, n_new), generation_config.pad_token_id, np.int32)

@@ -14,6 +14,13 @@ weight every step.)
 
 Non-quantized kernels take the standard ``jnp.dot`` path; NF4 kernels fall
 back to an in-layer dequantize that XLA fuses into the consumer.
+
+It is also the integration point for **multi-tenant batched LoRA**
+(``ops/lora.py``): when the module holds ``a``/``b`` stacks in the ``lora``
+variable collection and the caller passes per-row ``adapter_ids``, the
+segment-batched adapter contribution ``(x @ A[ids]) @ B[ids]`` joins the
+base matmul as one gathered einsum — fixed shapes for any tenant mix, so
+the serving decode step never recompiles on adapter routing.
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..ops.collective_matmul import dense_collective_matmul
+from ..ops.lora import lora_apply
 from ..ops.precision import fp8_current_scaled_dot, fp8_enabled
 from ..ops.quantized_matmul import quantized_matmul
 from ..utils.quantization import is_quantized
@@ -55,7 +63,7 @@ class QuantizableDense(nn.Module):
     tp_axis: str = "tp"
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         stored = None
         if not self.is_initializing() and self.has_variable("params", "kernel"):
             stored = self.get_variable("params", "kernel")
@@ -84,4 +92,14 @@ class QuantizableDense(nn.Module):
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.features,), self.param_dtype)
             y = y + bias.astype(dtype)
+        if adapter_ids is not None and self.has_variable("lora", "a"):
+            # segment-batched multi-adapter LoRA (ops/lora.py): the a/b
+            # stacks live in the "lora" collection (the AdapterStore's
+            # device pool), adapter_ids are per-row pool-slot indices, and
+            # id-0 rows come back bitwise-unchanged
+            y = lora_apply(
+                x.astype(dtype), y,
+                self.get_variable("lora", "a"), self.get_variable("lora", "b"),
+                adapter_ids,
+            )
         return y

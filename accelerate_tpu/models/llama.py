@@ -344,7 +344,8 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None):
+    def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None,
+                 adapter_ids=None):
         cfg = self.config
         b, t = x.shape[:2]
         # Ulysses boundary as collective matmul: q/k/v fuse with all_to_all
@@ -363,9 +364,9 @@ class LlamaAttention(nn.Module):
         dense = partial(QuantizableDense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
         col = partial(dense, tp_mode="column", tp_axis=ring_axis)
         row = partial(dense, tp_mode="row", tp_axis=ring_axis)
-        q = col(cfg.num_attention_heads * cfg.head_dim, name="q_proj")(x)
-        k = col(cfg.num_key_value_heads * cfg.head_dim, name="k_proj")(x)
-        v = col(cfg.num_key_value_heads * cfg.head_dim, name="v_proj")(x)
+        q = col(cfg.num_attention_heads * cfg.head_dim, name="q_proj")(x, adapter_ids)
+        k = col(cfg.num_key_value_heads * cfg.head_dim, name="k_proj")(x, adapter_ids)
+        v = col(cfg.num_key_value_heads * cfg.head_dim, name="v_proj")(x, adapter_ids)
         q = q.reshape(b, t, cfg.num_attention_heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.num_key_value_heads, cfg.head_dim)
@@ -410,7 +411,7 @@ class LlamaAttention(nn.Module):
             new_cache = {"k_pages": k_pages, "v_pages": v_pages,
                          "block_tables": cache["block_tables"]}
             out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
-            return row(cfg.hidden_size, name="o_proj")(out), new_cache
+            return row(cfg.hidden_size, name="o_proj")(out, adapter_ids), new_cache
 
         if cache is not None:
             # autoregressive path: write this chunk's K/V + positions at the
@@ -426,7 +427,7 @@ class LlamaAttention(nn.Module):
             out = cached_attention(q, k_cache, v_cache, pos_cache, positions)
             new_cache = {"k": k_cache, "v": v_cache, "pos": pos_cache, "index": idx + t}
             out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
-            return row(cfg.hidden_size, name="o_proj")(out), new_cache
+            return row(cfg.hidden_size, name="o_proj")(out, adapter_ids), new_cache
 
         attn = get_attention_impl(cfg.attn_implementation)
         attn_kwargs = {}
@@ -440,39 +441,42 @@ class LlamaAttention(nn.Module):
             attn_kwargs["heads_sharded"] = True
         out = attn(q, k, v, causal=True, segment_ids=segment_ids, **attn_kwargs)
         out = out.reshape(b, t, cfg.num_attention_heads * cfg.head_dim)
-        return row(cfg.hidden_size, name="o_proj")(out)
+        return row(cfg.hidden_size, name="o_proj")(out, adapter_ids)
 
 
 class LlamaMLP(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         cfg = self.config
         dense = partial(QuantizableDense, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32)
         # Megatron roles for the collective-matmul ring over tp: gate/up
         # column-parallel (gather the sequence into the matmul), down
         # row-parallel (reduce-scatter the output back to sequence shards)
-        gate = dense(cfg.intermediate_size, name="gate_proj", tp_mode="column")(x)
-        up = dense(cfg.intermediate_size, name="up_proj", tp_mode="column")(x)
-        return dense(cfg.hidden_size, name="down_proj", tp_mode="row")(nn.silu(gate) * up)
+        gate = dense(cfg.intermediate_size, name="gate_proj", tp_mode="column")(x, adapter_ids)
+        up = dense(cfg.intermediate_size, name="up_proj", tp_mode="column")(x, adapter_ids)
+        return dense(cfg.hidden_size, name="down_proj", tp_mode="row")(
+            nn.silu(gate) * up, adapter_ids)
 
 
 class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None):
+    def __call__(self, x, positions, segment_ids=None, cache=None, cache_write_mask=None,
+                 adapter_ids=None):
         cfg = self.config
         attn_in = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x)
         attn = LlamaAttention(cfg, name="self_attn")(attn_in, positions, segment_ids, cache,
-                                                     cache_write_mask)
+                                                     cache_write_mask, adapter_ids)
         new_cache = None
         if cache is not None:
             attn, new_cache = attn
         h = x + attn
         out = h + LlamaMLP(cfg, name="mlp")(
-            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h)
+            RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="post_attention_layernorm")(h),
+            adapter_ids,
         )
         if cache is not None:
             return out, new_cache
@@ -545,10 +549,21 @@ class LMHead(nn.Module):
     dtype: Any
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, adapter_ids=None):
         w = self.param(
             "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.vocab_size), jnp.float32
         )
+        if adapter_ids is not None and self.has_variable("lora", "a"):
+            from ..ops.lora import lora_apply
+
+            base = jax.lax.dot_general(
+                x, w.astype(self.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return lora_apply(
+                x, base, self.get_variable("lora", "a"),
+                self.get_variable("lora", "b"), adapter_ids,
+            )
         if x.ndim == 3:
             # column-parallel over tp (lm_head rule shards the vocab dim):
             # the ring gathers the sequence left tp-scattered by the last
@@ -579,8 +594,15 @@ class LlamaForCausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None, output_hidden: bool = False,
-                 cache=None, cache_write_mask=None):
+                 cache=None, cache_write_mask=None, adapter_ids=None):
         cfg = self.config
+        if adapter_ids is not None and cfg.scan_layers:
+            raise ValueError(
+                "adapter_ids (multi-tenant LoRA) has no scan_layers path — "
+                "the lora collection is per-layer; convert with "
+                "unstack_layer_params + scan_layers=False (generation and "
+                "the serving engine convert automatically)"
+            )
         if positions is None:
             base = jnp.arange(input_ids.shape[1])
             if cache is not None:
@@ -682,8 +704,13 @@ class LlamaForCausalLM(nn.Module):
             for i in range(cfg.num_hidden_layers):
                 layer = block(cfg, name=f"layers_{i}")
                 if cache is not None:
-                    x, layer_cache = layer(x, positions, segment_ids, cache[i], cache_write_mask)
+                    x, layer_cache = layer(x, positions, segment_ids, cache[i], cache_write_mask,
+                                           adapter_ids)
                     new_cache.append(layer_cache)
+                elif adapter_ids is not None:
+                    # positional through any remat wrapper (kwargs and
+                    # jax.checkpoint static handling don't always mix)
+                    x = layer(x, positions, segment_ids, None, None, adapter_ids)
                 else:
                     x = layer(x, positions, segment_ids)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
@@ -700,7 +727,7 @@ class LlamaForCausalLM(nn.Module):
             contract = (((x.ndim - 1,), (1,)), ((), ()))
             logits = jax.lax.dot_general(x, head_w, contract, preferred_element_type=jnp.float32)
         else:
-            logits = LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x)
+            logits = LMHead(cfg.vocab_size, cfg.dtype, name="lm_head")(x, adapter_ids)
         return (logits, new_cache) if cache is not None else logits
 
 

@@ -306,6 +306,12 @@ class LayerPrefetcher:
     HBM cost: at most ``depth + 1`` layers resident.  ``enabled=False``
     degrades to blocking per-layer fetches through the same interface (the
     serial baseline the overlap accounting is measured against).
+
+    ``depth=0`` disables the *sequential* lookahead while keeping the
+    double-buffer slots: the caller drives prefetch explicitly through
+    :meth:`prefetch` — the adapter hot-swap path
+    (``serving/adapters.py``), where "the next index" is the scheduler's
+    waiting queue, not ``i + 1``.
     """
 
     def __init__(self, fetch: Callable[[int], Any], n_layers: int, *,
@@ -314,9 +320,11 @@ class LayerPrefetcher:
                  retry_policy: Optional[RetryPolicy] = DEFAULT_POLICY):
         if n_layers < 1:
             raise ValueError(f"n_layers must be >= 1, got {n_layers}")
+        if depth < 0:
+            raise ValueError(f"depth must be >= 0, got {depth}")
         self.fetch = fetch
         self.n_layers = n_layers
-        self.depth = max(1, depth)
+        self.depth = depth
         self.wrap = wrap
         self.enabled = enabled
         self.stats = stats
@@ -381,6 +389,24 @@ class LayerPrefetcher:
             jax.block_until_ready(tree)  # measure the unhidden remainder
             self.stats.fetch_wait_s += time.perf_counter() - t0
         return tree
+
+    def prefetch(self, i: int) -> bool:
+        """Dispatch layer ``i``'s upload NOW without blocking (explicit
+        lookahead for callers whose next index is data-dependent — the
+        adapter hot-swap path).  Returns True when a transfer was issued
+        (False: already in flight, or prefetch disabled)."""
+        if not (0 <= i < self.n_layers):
+            raise IndexError(f"layer {i} out of range [0, {self.n_layers})")
+        if not self.enabled or i in self._slots:
+            return False
+        self._slots[i] = self._issue(i)
+        return True
+
+    def invalidate(self, i: int) -> None:
+        """Discard layer ``i``'s staged upload if one is in flight — the
+        source tree changed (adapter re-publish), so the staged copy must
+        never be served."""
+        self._slots.pop(i, None)
 
     def drop(self):
         """Release any in-flight slots (frees their HBM)."""

@@ -10,9 +10,20 @@ The production-inference rebuild of the reference's
 - :mod:`.engine` — the jitted, donation-clean prefill/decode/release
   programs and the host-driven serving loop;
 - :mod:`.harness` — seeded traffic replay, serving metrics, and the
-  static-batching baseline.
+  static-batching baseline;
+- :mod:`.adapters` — multi-tenant batched LoRA (ROADMAP item 2): the
+  fixed-size device adapter pool with hot-swap streaming + LRU behind the
+  segment-batched adapter matmul (``ops/lora.py``), and the per-adapter
+  fine-tuning trainer with host-resident optimizer state.
 """
 
+from .adapters import (
+    AdapterPoolFullError,
+    AdapterStore,
+    LoraTrainer,
+    adapter_pool_accounting,
+    predicted_adapter_hit_rate,
+)
 from .engine import ServingEngine
 from .harness import (
     predicted_pool_utilization,
@@ -28,6 +39,11 @@ __all__ = [
     "ContinuousBatchingScheduler",
     "Request",
     "SlotState",
+    "AdapterStore",
+    "AdapterPoolFullError",
+    "LoraTrainer",
+    "adapter_pool_accounting",
+    "predicted_adapter_hit_rate",
     "allocate",
     "release",
     "pages_for",

@@ -43,14 +43,34 @@ from .paged_cache import allocate, pages_for, release
 from .scheduler import ContinuousBatchingScheduler, Request
 
 
-def _engine_step_fns(model, gen_config, page_size: int):
+def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
+                     lora_kernel_mode: str = "auto"):
     """The raw (un-jitted) device-program bodies.  :func:`_engine_fns`
     wraps them in the process-shared jit cache for serving;
     :func:`fresh_engine_jits` wraps them fresh for the deploy preflight,
-    whose executable-level stats must come from a real compile."""
-    apply = model.apply
+    whose executable-level stats must come from a real compile.
 
-    def decode_step(params, cache, tokens, active, rng):
+    With ``lora=True`` (multi-tenant mode) decode/prefill additionally take
+    the adapter pool (the ``lora`` variable collection — read-only here;
+    the AdapterStore's donated insert program owns its mutation) and the
+    per-slot adapter ids.  The ids are **normal array arguments**: any
+    tenant mix reuses the same compiled program (the fixed-shape contract
+    ``strict_compiles`` enforces).  ``lora_kernel_mode`` is applied as a
+    SCOPED override around every trace (and keys the program cache), so
+    two engines with different kernel knobs never share a traced program
+    and engine construction never retargets the process-global mode."""
+    if lora:
+        from ..ops.lora import lora_kernel
+
+        raw_apply = model.apply
+
+        def apply(*args, **kwargs):
+            with lora_kernel(lora_kernel_mode):
+                return raw_apply(*args, **kwargs)
+    else:
+        apply = model.apply
+
+    def decode_step(params, lora_pool, cache, tokens, active, adapter_slots, rng):
         # one token for every slot at once; dead slots write nowhere and
         # their sampled token is ignored by the host
         seq_lens = cache["seq_lens"]
@@ -66,9 +86,11 @@ def _engine_step_fns(model, gen_config, page_size: int):
              "block_tables": block_tables}
             for l in cache["layers"]
         ]
+        variables = {**params, "lora": lora_pool} if lora else params
+        kwargs = {"adapter_ids": adapter_slots} if lora else {}
         logits, new_layers = apply(
-            params, tokens[:, None], positions=pos[:, None],
-            cache=layer_caches, cache_write_mask=active[:, None],
+            variables, tokens[:, None], positions=pos[:, None],
+            cache=layer_caches, cache_write_mask=active[:, None], **kwargs,
         )
         next_tok = sample_logits(logits[:, 0], rng, gen_config)
         new_cache = {
@@ -81,7 +103,8 @@ def _engine_step_fns(model, gen_config, page_size: int):
         }
         return new_cache, next_tok
 
-    def prefill_step(params, cache, slot, chunk_ids, start, chunk_len):
+    def prefill_step(params, lora_pool, cache, slot, chunk_ids, start, chunk_len,
+                     adapter_slot):
         # one bucket-padded chunk of one sequence's prompt; returns the
         # logits of the chunk's last REAL token (the decode-loop seed once
         # the prompt completes)
@@ -98,9 +121,11 @@ def _engine_step_fns(model, gen_config, page_size: int):
             {"k_pages": l["k_pages"], "v_pages": l["v_pages"], "block_tables": row}
             for l in cache["layers"]
         ]
+        variables = {**params, "lora": lora_pool} if lora else params
+        kwargs = {"adapter_ids": jnp.reshape(adapter_slot, (1,))} if lora else {}
         logits, new_layers = apply(
-            params, chunk_ids[None], positions=positions[None],
-            cache=layer_caches, cache_write_mask=wmask[None],
+            variables, chunk_ids[None], positions=positions[None],
+            cache=layer_caches, cache_write_mask=wmask[None], **kwargs,
         )
         last = jnp.take(logits[0], chunk_len - 1, axis=0)
         new_cache = {
@@ -129,10 +154,23 @@ def _engine_step_fns(model, gen_config, page_size: int):
     def sample_first(last, rng):
         return sample_logits(last[None], rng, gen_config)[0]
 
-    return decode_step, prefill_step, release_step, sample_first
+    if lora:
+        return decode_step, prefill_step, release_step, sample_first
+
+    # single-tenant mode keeps the original program arity (the preflight
+    # and every existing caller compile these signatures)
+    def decode_legacy(params, cache, tokens, active, rng):
+        return decode_step(params, None, cache, tokens, active, None, rng)
+
+    def prefill_legacy(params, cache, slot, chunk_ids, start, chunk_len):
+        return prefill_step(params, None, cache, slot, chunk_ids, start,
+                            chunk_len, None)
+
+    return decode_legacy, prefill_legacy, release_step, sample_first
 
 
-def fresh_engine_jits(model, gen_config, page_size: int):
+def fresh_engine_jits(model, gen_config, page_size: int, lora: bool = False,
+                      lora_kernel_mode: str = "auto"):
     """FRESH jit wrappers over the engine program bodies — deliberately
     outside the shared :func:`_engine_fns` cache.  The deploy preflight
     compiles through these: a wrapper another engine already drove may hold
@@ -141,22 +179,25 @@ def fresh_engine_jits(model, gen_config, page_size: int):
     (``memory_analysis().alias_size_in_bytes`` reads 0), which would turn
     every healthy donation into a GL301 false positive."""
     decode_step, prefill_step, release_step, sample_first = _engine_step_fns(
-        model, gen_config, page_size
+        model, gen_config, page_size, lora, lora_kernel_mode
     )
+    cache_arg = 2 if lora else 1
     return (
-        jax.jit(decode_step, donate_argnums=(1,)),
-        jax.jit(prefill_step, donate_argnums=(1,)),
+        jax.jit(decode_step, donate_argnums=(cache_arg,)),
+        jax.jit(prefill_step, donate_argnums=(cache_arg,)),
         jax.jit(release_step, donate_argnums=(0,)),
         jax.jit(sample_first),
     )
 
 
 @lru_cache(maxsize=8)
-def _engine_fns(model, gen_config, page_size: int):
+def _engine_fns(model, gen_config, page_size: int, lora: bool = False,
+                lora_kernel_mode: str = "auto"):
     """The jitted device programs, shared across engines of the same
-    (model, config, page geometry) — jax.jit caches per input shape, so
-    bucket widths and slot counts each compile exactly once per process."""
-    return fresh_engine_jits(model, gen_config, page_size)
+    (model, config, page geometry, lora kernel) — jax.jit caches per input
+    shape, so bucket widths and slot counts each compile exactly once per
+    process."""
+    return fresh_engine_jits(model, gen_config, page_size, lora, lora_kernel_mode)
 
 
 class ServingEngine:
@@ -173,7 +214,8 @@ class ServingEngine:
     """
 
     def __init__(self, model, params, plugin: Optional[ServingPlugin] = None,
-                 generation_config: Optional[GenerationConfig] = None, rng=None):
+                 generation_config: Optional[GenerationConfig] = None, rng=None,
+                 adapters=None):
         self.plugin = plugin or ServingPlugin()
         self.gen_config = generation_config or GenerationConfig()
         if getattr(getattr(model, "config", None), "scan_layers", False):
@@ -189,6 +231,12 @@ class ServingEngine:
             model = model.clone(config=cfg) if hasattr(model, "clone") else type(model)(cfg)
         self.model = model
         self.params = params
+        # multi-tenant mode: the AdapterStore's pool rides every decode/
+        # prefill program as a read-only extra arg, and per-slot adapter
+        # ids route each row through its tenant's adapter (ops/lora.py);
+        # the plugin's kernel mode scopes the program traces (it never
+        # touches the process-global ambient mode)
+        self.adapters = adapters
         p = self.plugin
         self.cache = init_paged_cache(
             cfg, p.num_pages, p.page_size, p.num_slots, p.pages_per_slot
@@ -196,9 +244,13 @@ class ServingEngine:
         self.sched = ContinuousBatchingScheduler(
             p.num_slots, p.num_pages, p.page_size, p.pages_per_slot,
             p.prefill_chunk, p.prefill_buckets,
+            adapters=adapters,
+            max_bypass_age=(adapters.plugin.max_bypass_age
+                            if adapters is not None else 16),
         )
         self._decode, self._prefill, self._release, self._sample = _engine_fns(
-            self.model, self.gen_config, p.page_size
+            self.model, self.gen_config, p.page_size, adapters is not None,
+            adapters.plugin.kernel if adapters is not None else "auto",
         )
         self._base_rng = rng if rng is not None else jax.random.PRNGKey(0)
         # recompile guard: compile events are counted process-wide (the
@@ -249,6 +301,21 @@ class ServingEngine:
         trace arrivals the replay never delivered."""
         return self.unfinished_requests() + list(self._undelivered)
 
+    # -- program dispatch (single-tenant vs multi-tenant arity) --------------
+
+    def _run_decode(self, tokens, active, adapter_slots, rng):
+        if self.adapters is None:
+            return self._decode(self.params, self.cache, tokens, active, rng)
+        return self._decode(self.params, self.adapters.pool, self.cache,
+                            tokens, active, adapter_slots, rng)
+
+    def _run_prefill(self, slot, chunk_ids, start, chunk_len, adapter_slot):
+        if self.adapters is None:
+            return self._prefill(self.params, self.cache, slot, chunk_ids,
+                                 start, chunk_len)
+        return self._prefill(self.params, self.adapters.pool, self.cache,
+                             slot, chunk_ids, start, chunk_len, adapter_slot)
+
     # -- the engine tick -----------------------------------------------------
 
     def warmup(self) -> int:
@@ -271,17 +338,19 @@ class ServingEngine:
         before = self._compile_counter.count
         n = self.plugin.num_slots
         rng = jax.random.fold_in(self._base_rng, 0)  # warms the fold_in program
-        cache, _ = self._decode(
-            self.params, self.cache, jnp.asarray(np.zeros((n,), np.int32)),
-            jnp.asarray(np.zeros((n,), bool)), rng,
+        cache, _ = self._run_decode(
+            jnp.asarray(np.zeros((n,), np.int32)),
+            jnp.asarray(np.zeros((n,), bool)),
+            jnp.asarray(np.zeros((n,), np.int32)), rng,
         )
         self.cache = cache
         last = None
         for bucket in self.plugin.prefill_buckets:
-            cache, last = self._prefill(
-                self.params, self.cache, jnp.asarray(0, jnp.int32),
+            cache, last = self._run_prefill(
+                jnp.asarray(0, jnp.int32),
                 jnp.asarray(np.zeros((bucket,), np.int32)),
                 jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.asarray(0, jnp.int32),
             )
             self.cache = cache
         if last is not None:
@@ -289,6 +358,10 @@ class ServingEngine:
         self.cache = self._release(
             self.cache, jnp.asarray(np.zeros((n,), bool))
         )
+        if self.adapters is not None:
+            # the pool-insert scatter is a fixed-shape production program
+            # too: a first hot-swap mid-traffic must hit a warm cache
+            self.adapters.warmup_insert()
         self.warmed_up = True
         return self._compile_counter.count - before
 
@@ -319,10 +392,11 @@ class ServingEngine:
                 st = self.sched.slots[slot]
                 ids = np.zeros((bucket,), np.int32)
                 ids[:chunk] = st.request.prompt[start:start + chunk]
-                cache, last = self._prefill(
-                    self.params, self.cache, jnp.asarray(slot, jnp.int32),
+                cache, last = self._run_prefill(
+                    jnp.asarray(slot, jnp.int32),
                     jnp.asarray(ids), jnp.asarray(start, jnp.int32),
                     jnp.asarray(chunk, jnp.int32),
+                    jnp.asarray(st.adapter_slot, jnp.int32),
                 )
                 self.cache = cache
                 self.sched.note_prefill(slot, chunk)
@@ -348,12 +422,14 @@ class ServingEngine:
                 n = self.plugin.num_slots
                 tokens = np.zeros((n,), np.int32)
                 active = np.zeros((n,), bool)
+                adapter_slots = np.zeros((n,), np.int32)
                 for s in active_slots:
                     tokens[s] = self.sched.slots[s].tokens[-1]
                     active[s] = True
-                cache, next_tok = self._decode(
-                    self.params, self.cache, jnp.asarray(tokens),
-                    jnp.asarray(active), self._step_rng(),
+                    adapter_slots[s] = self.sched.slots[s].adapter_slot
+                cache, next_tok = self._run_decode(
+                    jnp.asarray(tokens), jnp.asarray(active),
+                    jnp.asarray(adapter_slots), self._step_rng(),
                 )
                 self.cache = cache
                 self.sched.note_decode(needing)
@@ -465,14 +541,24 @@ class ServingEngine:
         """graft-lint jaxpr audit of the decode step (trace-only — the
         donated pool buffers stay intact).  The pool update must come back
         clean: donation fully consumed (no GL101), no in-trace transfers,
-        no donated-name reuse (the AST sweep covers GL201 separately)."""
+        no donated-name reuse (the AST sweep covers GL201 separately).
+        In multi-tenant mode the audited program includes the adapter pool
+        and id routing — the contract is identical."""
         from ..analysis import audit_jitted
 
         n = self.plugin.num_slots
+        if self.adapters is None:
+            return audit_jitted(
+                self._decode, self.params, self.cache,
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((n,), jnp.bool_),
+                self._base_rng, **audit_kwargs,
+            )
         return audit_jitted(
-            self._decode, self.params, self.cache,
+            self._decode, self.params, self.adapters.pool, self.cache,
             jax.ShapeDtypeStruct((n,), jnp.int32),
             jax.ShapeDtypeStruct((n,), jnp.bool_),
+            jax.ShapeDtypeStruct((n,), jnp.int32),
             self._base_rng, **audit_kwargs,
         )
 

@@ -35,10 +35,16 @@ def synthesize_trace(
     mean_interarrival_steps: float = 2.0,
     prompt_len_range: tuple = (4, 24),
     new_tokens_range: tuple = (2, 16),
+    adapters: int = 0,
 ) -> list[Request]:
     """A deterministic request trace: Poisson arrivals (exponential gaps in
     virtual engine-step time) with uniformly mixed prompt/output lengths.
-    Same seed -> same trace, always (the scheduler-determinism contract)."""
+    Same seed -> same trace, always (the scheduler-determinism contract).
+
+    With ``adapters=N`` each request draws a tenant ``adapter_id`` uniformly
+    from ``0..N`` — id 0 rows serve the base model, so every multi-tenant
+    trace mixes no-adapter traffic in (the id-0 bitwise contract's coverage).
+    """
     rng = np.random.default_rng(seed)
     trace = []
     t = 0.0
@@ -47,8 +53,9 @@ def synthesize_trace(
         p_len = int(rng.integers(prompt_len_range[0], prompt_len_range[1] + 1))
         n_new = int(rng.integers(new_tokens_range[0], new_tokens_range[1] + 1))
         prompt = tuple(int(x) for x in rng.integers(1, vocab_size, p_len))
+        adapter_id = int(rng.integers(0, adapters + 1)) if adapters > 0 else 0
         trace.append(Request(uid=uid, prompt=prompt, max_new_tokens=n_new,
-                             arrival_step=int(t)))
+                             arrival_step=int(t), adapter_id=adapter_id))
     return trace
 
 
@@ -68,13 +75,18 @@ def predicted_pool_utilization(trace: list[Request], *, num_slots: int,
     traffic the trace cannot know about)."""
     if not trace:
         return 0.0
+    import dataclasses as _dc
+
     from .scheduler import ContinuousBatchingScheduler
 
     sched = ContinuousBatchingScheduler(
         num_slots, num_pages, page_size, pages_per_slot, prefill_chunk,
         (prefill_chunk,),
     )
-    pending = sorted(trace, key=lambda r: (r.arrival_step, r.uid))
+    # page arithmetic only — adapter routing plays no part in the pool
+    # utilization model, so the replay strips tenant ids
+    pending = [_dc.replace(r, adapter_id=0)
+               for r in sorted(trace, key=lambda r: (r.arrival_step, r.uid))]
     i, steps, page_step_sum = 0, 0, 0
     while True:
         while i < len(pending) and pending[i].arrival_step <= steps:
@@ -189,7 +201,41 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True) -> dic
         "compiles_measured": compiles_measured,
         "compiles_warmup": compiles_warmup,
         "programs_predicted": len(p.prefill_buckets) + 3,  # + decode/release/sampler
+        # multi-tenant adapter fields — ALWAYS present (zeros without an
+        # AdapterStore), with the predicted/measured pool-hit-rate twins
+        **_adapter_fields(engine, trace),
         "results": results,
+    }
+
+
+def _adapter_fields(engine, trace: list[Request]) -> dict:
+    """The always-emitted multi-tenant block of the serving report: pool
+    hit rate (measured + the LRU-replay predicted twin), swap count/bytes,
+    and the tenant census of the trace.  Zeros-clean when the engine runs
+    single-tenant."""
+    store = getattr(engine, "adapters", None)
+    tenant_ids = [r.adapter_id for r in sorted(trace, key=lambda r: (r.arrival_step, r.uid))]
+    if store is None:
+        return {
+            "adapters": 0, "adapter_requests": 0,
+            "adapter_pool_slots": 0, "lora_rank": 0,
+            "adapter_pool_hit_rate": 0.0,
+            "adapter_pool_hit_rate_predicted": 0.0,
+            "adapter_swaps": 0, "adapter_swap_bytes": 0,
+        }
+    from .adapters import predicted_adapter_hit_rate
+
+    return {
+        "adapters": len({t for t in tenant_ids if t}),
+        "adapter_requests": sum(1 for t in tenant_ids if t),
+        "adapter_pool_slots": store.plugin.pool_slots,
+        "lora_rank": store.plugin.rank,
+        "adapter_pool_hit_rate": store.hit_rate(),
+        "adapter_pool_hit_rate_predicted": predicted_adapter_hit_rate(
+            tenant_ids, store.plugin.pool_slots
+        ),
+        "adapter_swaps": store.swaps,
+        "adapter_swap_bytes": store.swap_bytes,
     }
 
 

@@ -11,8 +11,18 @@ sync.
 
 Policy (every knob in :class:`~accelerate_tpu.utils.dataclasses.ServingPlugin`):
 
-- **Admission**: FIFO.  A waiting request is admitted when a decode slot is
-  free and the pool has pages for its prompt plus one decode page.
+- **Admission**: FIFO, with a **bounded-age adapter bypass** in multi-tenant
+  mode.  A waiting request is admitted when a decode slot is free and the
+  pool has pages for its prompt; a request carrying an ``adapter_id`` must
+  additionally have its adapter pin-able in the
+  :class:`~.adapters.AdapterStore` pool BEFORE it is scheduled (admission
+  pins — a scheduled request never waits on a swap mid-decode).  When the
+  head of the line is blocked on adapter-pool contention, younger
+  requests whose adapters are resident (or who carry none) may admit past
+  it — but only for ``max_bypass_age`` engine ticks: after that the line
+  holds until the head admits, so a tenant whose adapter needs a swap
+  cannot be starved by an endless stream of zero-swap arrivals (the
+  fairness contract, pinned by a deterministic trace test).
 - **Chunked prefill**: admitted prompts prefill in chunks of at most
   ``prefill_chunk`` tokens, padded up to the smallest **shape bucket** so the
   jitted prefill step compiles once per bucket, never mid-traffic.
@@ -41,12 +51,16 @@ class Request:
 
     ``arrival_step`` is in *virtual engine-step time* (the replay harness
     feeds arrivals deterministically by step index, not wall clock).
+    ``adapter_id`` is the requesting TENANT's LoRA adapter (0 = the base
+    model); admission maps it to a device pool slot through the
+    :class:`~.adapters.AdapterStore`.
     """
 
     uid: int
     prompt: tuple  # int token ids
     max_new_tokens: int
     arrival_step: int = 0
+    adapter_id: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -63,6 +77,7 @@ class SlotState:
     tokens: Optional[list] = None  # generated token ids
     last_token: int = 0            # decode input for the next step
     finished: bool = False
+    adapter_slot: int = 0          # device pool slot the request decodes with
 
     def __post_init__(self):
         if self.tokens is None:
@@ -88,19 +103,24 @@ class ContinuousBatchingScheduler:
     """
 
     def __init__(self, num_slots: int, num_pages: int, page_size: int,
-                 pages_per_slot: int, prefill_chunk: int, prefill_buckets: tuple):
+                 pages_per_slot: int, prefill_chunk: int, prefill_buckets: tuple,
+                 adapters=None, max_bypass_age: int = 16):
         self.num_slots = num_slots
         self.num_pages = num_pages
         self.page_size = page_size
         self.pages_per_slot = pages_per_slot
         self.prefill_chunk = prefill_chunk
         self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self.adapters = adapters             # AdapterStore (multi-tenant mode)
+        self.max_bypass_age = max_bypass_age
         self.waiting: deque[Request] = deque()
         self.slots: dict[int, SlotState] = {}
         self.free_slots: list[int] = list(range(num_slots))
         self.free_pages = num_pages          # host mirror of the device stack
         self._admit_counter = 0
         self._last_was_prefill = False
+        self._head_block_age = 0             # ticks the line head has been
+        self._head_block_uid = None          # adapter-blocked (fairness bound)
         self.events: list[tuple] = []        # the determinism log
 
     # -- queueing -----------------------------------------------------------
@@ -108,6 +128,17 @@ class ContinuousBatchingScheduler:
     def submit(self, request: Request) -> None:
         total = request.prompt_len + request.max_new_tokens
         cap = min(self.pages_per_slot, self.num_pages) * self.page_size
+        if request.adapter_id:
+            if self.adapters is None:
+                raise ValueError(
+                    f"request {request.uid} carries adapter_id="
+                    f"{request.adapter_id} but the engine has no AdapterStore"
+                )
+            if not self.adapters.known(request.adapter_id):
+                raise ValueError(
+                    f"request {request.uid}: adapter {request.adapter_id} "
+                    "was never published to the AdapterStore"
+                )
         if request.prompt_len < 1:
             raise ValueError(f"request {request.uid}: empty prompt")
         if request.max_new_tokens < 1:
@@ -130,21 +161,74 @@ class ContinuousBatchingScheduler:
 
     # -- admission ----------------------------------------------------------
 
+    def _adapter_ready(self, req: Request) -> bool:
+        return (self.adapters is None or req.adapter_id == 0
+                or self.adapters.can_pin(req.adapter_id))
+
+    def _pick_admissible(self) -> Optional[int]:
+        """Index into ``waiting`` of the next request admission may take:
+        the head when its adapter is pin-able, else — within the bounded
+        bypass age — the first younger request that is.  ``None`` holds the
+        line (head blocked past its age bound, or nothing ready)."""
+        if self._adapter_ready(self.waiting[0]):
+            return 0
+        if self._head_block_age > self.max_bypass_age:
+            return None  # fairness: the starved head gets the next free slot
+        for i in range(1, len(self.waiting)):
+            if self._adapter_ready(self.waiting[i]):
+                return i
+        return None
+
     def admit(self) -> list[int]:
-        """Admit FIFO while a slot is free and the pool can hold the whole
+        """Admit while a slot is free and the pool can hold the whole
         prompt (prefill feasibility — decode growth is the eviction path's
         job, and ``submit`` already guarantees a lone sequence can never
         outgrow the pool, so admission must not demand more than the pool
         can EVER offer or a submit-accepted request would wait forever).
-        Returns the admitted slot ids."""
+        FIFO, except that a head blocked on adapter-pool contention is
+        bypassed by adapter-ready requests for at most ``max_bypass_age``
+        ticks (see the module policy).  Admission PINS the request's
+        adapter before scheduling it.  Returns the admitted slot ids."""
+        if self.adapters is not None:
+            # hot-swap streaming: dispatch the next arrivals' adapter uploads
+            # under the current step's compute (LayerPrefetcher double
+            # buffer; a no-op for resident or already-in-flight adapters)
+            for req in list(self.waiting)[:2]:
+                if req.adapter_id:
+                    self.adapters.prefetch(req.adapter_id)
+        if self.waiting and not self._adapter_ready(self.waiting[0]):
+            head = self.waiting[0]
+            # one fairness tick per engine step the head stays blocked
+            if self._head_block_uid != head.uid:
+                self._head_block_uid = head.uid
+                self._head_block_age = 0
+            self._head_block_age += 1
+            if self.adapters is not None and head.adapter_id:
+                # stream the starved tenant's adapter NOW so the pin is a
+                # hit the moment a pool slot frees
+                self.adapters.prefetch(head.adapter_id)
+        else:
+            self._head_block_uid = None
+            self._head_block_age = 0
         admitted = []
         while self.waiting and self.free_slots:
-            req = self.waiting[0]
+            idx = self._pick_admissible()
+            if idx is None:
+                break
+            req = self.waiting[idx]
             if pages_for(req.prompt_len, self.page_size) > self.free_pages:
                 break
-            self.waiting.popleft()
+            del self.waiting[idx]
+            adapter_slot = 0
+            if self.adapters is not None and req.adapter_id:
+                adapter_slot, swapped = self.adapters.pin(req.adapter_id)
+                if swapped:
+                    self.events.append(("swap", req.adapter_id, adapter_slot))
+            if idx > 0:
+                self.events.append(("bypass", req.uid, self.waiting[0].uid))
             slot = self.free_slots.pop(0)
-            self.slots[slot] = SlotState(req, self._admit_counter)
+            self.slots[slot] = SlotState(req, self._admit_counter,
+                                         adapter_slot=adapter_slot)
             self._admit_counter += 1
             admitted.append(slot)
             self.events.append(("admit", req.uid, slot))
@@ -242,6 +326,11 @@ class ContinuousBatchingScheduler:
         self.free_pages += pages_for(st.seq_len, self.page_size)
         self.free_slots.append(slot)
         self.free_slots.sort()
+        if self.adapters is not None:
+            # drop THIS request's hold only — the adapter itself stays hot
+            # while other in-flight requests share it (refcount pinning:
+            # evicting a request never evicts a shared hot adapter)
+            self.adapters.unpin(st.request.adapter_id)
         self.requeue_front(st.request)
         self.events.append(("evict", st.request.uid, slot))
         return st.request
@@ -266,6 +355,8 @@ class ContinuousBatchingScheduler:
         self.free_pages += pages_for(st.seq_len, self.page_size)
         self.free_slots.append(slot)
         self.free_slots.sort()
+        if self.adapters is not None:
+            self.adapters.unpin(st.request.adapter_id)
         self.events.append(("finish", st.request.uid, slot))
         return st
 

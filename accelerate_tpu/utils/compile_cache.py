@@ -18,34 +18,138 @@ compilation-cache management):
   (the exact shape of the documented corruption).
 
 ``ACCELERATE_JAX_CACHE_ROOT`` moves the whole tree off ``/tmp``.
+
+**Prewarm distribution** (the remaining slice of ROADMAP item 4):
+:func:`export_prewarm` packs a warmed scoped cache into one
+toolchain-keyed archive, and :func:`load_prewarm` unpacks it on a deploy
+host BEFORE the preflight/warmup — so production startup pays zero cold
+compiles even on a fresh machine.  Loads are **version-keyed**: a pack
+from a different jax/Python build is refused (its entries could never
+hit), and every stale-version directory under the cache root is swept on
+load, so upgraded toolchains never accumulate dead weight.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import shutil
 import sys
+import tarfile
 from pathlib import Path
 from typing import Optional
+
+PREWARM_MANIFEST = "prewarm_manifest.json"
+
+
+def toolchain_version_key() -> str:
+    """The cache-keying toolchain tag: jax + Python version.  An entry
+    compiled by one toolchain is dead weight (at best) to another."""
+    import jax
+
+    return f"jax{jax.__version__}-py{sys.version_info.major}.{sys.version_info.minor}"
+
+
+def _cache_root(root: Optional[str] = None) -> Path:
+    return Path(root or os.environ.get(
+        "ACCELERATE_JAX_CACHE_ROOT", "/tmp/accelerate_tpu_jax_cache"
+    ))
 
 
 def scoped_cache_dir(tag: str = "run", root: Optional[str] = None) -> str:
     """The scoped cache directory for this (toolchain, tag, scope) — created
     if missing, returned as a string path."""
-    import jax
-
-    root = root or os.environ.get(
-        "ACCELERATE_JAX_CACHE_ROOT", "/tmp/accelerate_tpu_jax_cache"
-    )
-    version_key = (
-        f"jax{jax.__version__}-py{sys.version_info.major}.{sys.version_info.minor}"
-    )
     scope = os.environ.get("ACCELERATE_JAX_CACHE_SCOPE") or os.environ.get(
         "PYTEST_XDIST_WORKER", ""
     )
     leaf = f"{tag}-{scope}" if scope else tag
-    path = Path(root) / version_key / leaf
+    path = _cache_root(root) / toolchain_version_key() / leaf
     path.mkdir(parents=True, exist_ok=True)
     return str(path)
+
+
+def export_prewarm(dest: str, tag: str = "run", *, root: Optional[str] = None) -> str:
+    """Pack the scoped compilation cache into one distributable archive.
+
+    The archive carries a manifest keyed by :func:`toolchain_version_key`
+    and ``tag``; ship it to deploy hosts and :func:`load_prewarm` it before
+    ``preflight``/``warmup`` — the whole bucket ladder then compiles from
+    cache hits.  Returns the archive path."""
+    src = Path(scoped_cache_dir(tag, root))
+    dest_path = Path(dest)
+    dest_path.parent.mkdir(parents=True, exist_ok=True)
+    entries = sorted(p.name for p in src.iterdir() if p.is_file())
+    manifest = {
+        "version_key": toolchain_version_key(),
+        "tag": tag,
+        "entries": entries,
+    }
+    manifest_file = src / PREWARM_MANIFEST
+    manifest_file.write_text(json.dumps(manifest, indent=1))
+    try:
+        with tarfile.open(dest_path, "w") as tar:
+            tar.add(manifest_file, arcname=PREWARM_MANIFEST)
+            for name in entries:
+                tar.add(src / name, arcname=f"cache/{name}")
+    finally:
+        manifest_file.unlink(missing_ok=True)
+    return str(dest_path)
+
+
+def sweep_stale_versions(root: Optional[str] = None) -> list[str]:
+    """Remove every cache-root subdirectory keyed by a DIFFERENT toolchain
+    than the current one (the version-keyed eviction: an upgraded jax or
+    Python never reads — or pays disk for — a stale cache).  Returns the
+    swept directory names."""
+    root_path = _cache_root(root)
+    current = toolchain_version_key()
+    swept = []
+    if not root_path.is_dir():
+        return swept
+    for child in sorted(root_path.iterdir()):
+        if child.is_dir() and child.name != current:
+            shutil.rmtree(child, ignore_errors=True)
+            swept.append(child.name)
+    return swept
+
+
+def load_prewarm(archive: str, tag: str = "run", *,
+                 root: Optional[str] = None) -> dict:
+    """Unpack a prewarm archive into this host's scoped cache directory.
+
+    Version-keyed: an archive built by a different toolchain is REFUSED
+    (``{"loaded": 0, "stale": True}`` — its entries could never hit and a
+    deserialized foreign executable is exactly the corruption class the
+    scoped dirs retired).  Either way, stale-version directories under the
+    cache root are swept.  Never raises on a bad archive — a broken
+    prewarm pack degrades to a cold start, not a failed deploy."""
+    report = {"loaded": 0, "stale": False, "swept": [], "version_key": toolchain_version_key()}
+    try:
+        with tarfile.open(archive, "r") as tar:
+            try:
+                member = tar.extractfile(PREWARM_MANIFEST)
+            except KeyError:  # no manifest member at all (foreign tar)
+                member = None
+            manifest = json.loads(member.read().decode()) if member else {}
+            if manifest.get("version_key") != toolchain_version_key():
+                report["stale"] = True
+            else:
+                dest = Path(scoped_cache_dir(tag, root))
+                for m in tar.getmembers():
+                    name = m.name
+                    if not (m.isfile() and name.startswith("cache/")):
+                        continue
+                    leaf = Path(name).name  # flatten: no traversal, ever
+                    src = tar.extractfile(m)
+                    if src is None:  # pragma: no cover - malformed member
+                        continue
+                    (dest / leaf).write_bytes(src.read())
+                    report["loaded"] += 1
+    except (OSError, tarfile.TarError, json.JSONDecodeError) as e:
+        report["stale"] = True
+        report["error"] = str(e)
+    report["swept"] = sweep_stale_versions(root)
+    return report
 
 
 def enable_scoped_compilation_cache(

@@ -524,6 +524,92 @@ class ServingPlugin(KwargsHandler):
 
 
 @dataclass
+class LoraPlugin(KwargsHandler):
+    """Multi-tenant batched-LoRA knobs (engine:
+    ``accelerate_tpu/serving/adapters.py`` + ``ops/lora.py`` — see the
+    multi-tenant section of docs/serving.md).
+
+    One base model serves/fine-tunes thousands of LoRA adapters: a
+    fixed-size **device pool** holds the hot adapters as stacked A/B
+    factors (slot 0 reserved for the null adapter = base model), cold
+    adapters hot-swap in from host memmaps, and every batch row routes
+    through its adapter by id in ONE gathered einsum (S-LoRA/BGMV
+    discipline — no recompile per tenant mix).  Every knob reads an
+    ``ACCELERATE_LORA_*`` environment default in ``__post_init__``
+    (explicit arguments win — the plugin contract).
+    """
+
+    rank: Optional[int] = None               # LoRA rank r
+                                             # (env ACCELERATE_LORA_RANK, default 8)
+    alpha: Optional[float] = None            # scaling numerator; alpha/rank is folded
+                                             # into stored B factors at adapter
+                                             # creation (env ACCELERATE_LORA_ALPHA,
+                                             # default 16.0)
+    pool_slots: Optional[int] = None         # device-resident adapters (excl. the
+                                             # null slot) — the hot-swap LRU pool
+                                             # size (env ACCELERATE_LORA_POOL_SLOTS,
+                                             # default 4)
+    targets: Optional[tuple] = None          # module names that carry adapters
+                                             # (env ACCELERATE_LORA_TARGETS,
+                                             # comma-separated; default q_proj,v_proj)
+    kernel: str = ""                         # "auto" (Pallas BGMV gather-matmul on
+                                             # TPU T=1 decode, gathered einsum
+                                             # elsewhere) | "native" | "bgmv"
+                                             # (env ACCELERATE_LORA_KERNEL)
+    max_bypass_age: Optional[int] = None     # admission fairness bound: how many
+                                             # engine ticks a head-of-line request
+                                             # blocked on an adapter swap tolerates
+                                             # younger zero-swap requests admitting
+                                             # past it before admission holds the
+                                             # line (env ACCELERATE_LORA_BYPASS_AGE,
+                                             # default 16; 0 = strict FIFO)
+    optimizer: str = ""                      # make_optimizer recipe for per-adapter
+                                             # fine-tuning state — the int8-SR
+                                             # recipes keep per-tenant state tiny
+                                             # (env ACCELERATE_LORA_OPTIMIZER,
+                                             # default lion-sr8)
+
+    def __post_init__(self):
+        env = os.environ
+        if self.rank is None:
+            self.rank = int(env.get("ACCELERATE_LORA_RANK", 8))
+        if self.alpha is None:
+            self.alpha = float(env.get("ACCELERATE_LORA_ALPHA", 16.0))
+        if self.pool_slots is None:
+            self.pool_slots = int(env.get("ACCELERATE_LORA_POOL_SLOTS", 4))
+        if self.targets is None:
+            raw = env.get("ACCELERATE_LORA_TARGETS", "q_proj,v_proj")
+            self.targets = tuple(t.strip() for t in raw.split(",") if t.strip())
+        elif isinstance(self.targets, str):
+            self.targets = tuple(t.strip() for t in self.targets.split(",") if t.strip())
+        else:
+            self.targets = tuple(self.targets)
+        if not self.kernel:
+            self.kernel = env.get("ACCELERATE_LORA_KERNEL", "auto")
+        from ..ops.lora import normalize_lora_kernel
+
+        self.kernel = normalize_lora_kernel(self.kernel)
+        if self.max_bypass_age is None:
+            self.max_bypass_age = int(env.get("ACCELERATE_LORA_BYPASS_AGE", 16))
+        if not self.optimizer:
+            self.optimizer = env.get("ACCELERATE_LORA_OPTIMIZER", "lion-sr8")
+        if self.rank < 1:
+            raise ValueError(f"LoraPlugin.rank must be >= 1, got {self.rank}")
+        if self.alpha <= 0:
+            raise ValueError(f"LoraPlugin.alpha must be > 0, got {self.alpha}")
+        if self.pool_slots < 1:
+            raise ValueError(
+                f"LoraPlugin.pool_slots must be >= 1, got {self.pool_slots}"
+            )
+        if self.max_bypass_age < 0:
+            raise ValueError(
+                f"LoraPlugin.max_bypass_age must be >= 0, got {self.max_bypass_age}"
+            )
+        if not self.targets:
+            raise ValueError("LoraPlugin.targets must name at least one module")
+
+
+@dataclass
 class PreflightConfig(KwargsHandler):
     """Deploy-preflight knobs (``commands/preflight.py`` — AOT-compile every
     production program and audit the executables; see the "Deploy

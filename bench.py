@@ -397,17 +397,31 @@ def serve_report(args) -> dict:
     when the trace is empty, so BENCH_*.json tracks them across rounds.
     The static-batching twin re-counts the SAME measured per-request work
     under the fixed-batch schedule — the CPU-measurable proxy continuous
-    batching must beat on padding waste and scheduled-token efficiency."""
+    batching must beat on padding waste and scheduled-token efficiency.
+
+    ``--adapters N``: multi-tenant mode — N LoRA tenants share the base
+    model through the segment-batched adapter matmul (ops/lora.py), cold
+    adapters hot-swap from OffloadStore memmaps through a fixed device
+    pool, and the report adds the adapter fields (ALWAYS emitted, zeros
+    without adapters): pool hit rate (predicted+measured twins), swap
+    count/bytes, the predicted pool ladder, and the **per-adapter-loop
+    twin** — the same trace re-served one tenant at a time, which the
+    batched einsum must beat on tokens/s (the S-LoRA win, CPU-measurable
+    as slot occupancy)."""
+    import dataclasses as _dc
+    import tempfile
+    import time as _time
+
     import jax
     import jax.numpy as jnp
 
     from accelerate_tpu.generation import GenerationConfig
     from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
     from accelerate_tpu.serving import (
-        ServingEngine, kv_pool_accounting, replay, static_batching_report,
-        synthesize_trace,
+        AdapterStore, ServingEngine, adapter_pool_accounting,
+        kv_pool_accounting, replay, static_batching_report, synthesize_trace,
     )
-    from accelerate_tpu.utils.dataclasses import ServingPlugin
+    from accelerate_tpu.utils.dataclasses import LoraPlugin, ServingPlugin
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
@@ -434,14 +448,74 @@ def serve_report(args) -> dict:
         prompt_range, new_range = (4, 24), (4, 24)
     model = LlamaForCausalLM(cfg)
     params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+    n_adapters = getattr(args, "adapters", 0) or 0
     trace = synthesize_trace(
         args.serve_seed, args.serve_requests, vocab_size=cfg.vocab_size,
         mean_interarrival_steps=0.5, prompt_len_range=prompt_range,
-        new_tokens_range=new_range,
+        new_tokens_range=new_range, adapters=n_adapters,
     )
     gen_cfg = GenerationConfig(max_new_tokens=new_range[1])
-    engine = ServingEngine(model, params, plugin, gen_cfg)
+    store = store_dir = None
+    lora_plugin = None
+    if n_adapters > 0:
+        lora_plugin = LoraPlugin(
+            rank=16 if on_tpu else 4,
+            # undersized on purpose: the pool must hot-swap on the seeded
+            # trace so the hit-rate/swap-bytes fields measure something
+            pool_slots=max(2, (n_adapters + 1) // 2),
+            kernel="auto" if on_tpu else "native",
+        )
+        store_dir = tempfile.TemporaryDirectory(prefix="bench_adapters_")
+        store = AdapterStore(params, lora_plugin, dtype=cfg.dtype,
+                             offload_dir=store_dir.name)
+        for t in range(1, n_adapters + 1):
+            store.publish_random(t, jax.random.PRNGKey(1000 + t))
+    engine = ServingEngine(model, params, plugin, gen_cfg, adapters=store)
     rep = replay(engine, trace)
+    # per-adapter-loop twin: the same requests served one tenant at a time
+    # (what a per-adapter matmul loop forces) — the batched einsum keeps
+    # every tenant in one fixed-shape program and must win on tokens/s
+    loop_twin = {"tokens_per_sec_per_chip": 0.0, "wall_s": 0.0, "groups": 0}
+    speedup = 0.0
+    if n_adapters > 0:
+        groups: dict = {}
+        for r in trace:
+            groups.setdefault(r.adapter_id, []).append(r)
+        wall, toks = 0.0, 0
+        for tid in sorted(groups):
+            s = AdapterStore(params, lora_plugin, dtype=cfg.dtype,
+                             offload_dir=store_dir.name)
+            if tid:
+                # only this group's tenant is ever pinned — same seeded
+                # weights as the batched store, published once per group
+                s.publish_random(tid, jax.random.PRNGKey(1000 + tid))
+            eng_t = ServingEngine(model, params, plugin, gen_cfg, adapters=s)
+            eng_t.warmup()
+            t0 = _time.perf_counter()
+            res = eng_t.run([_dc.replace(r, arrival_step=0) for r in groups[tid]])
+            wall += _time.perf_counter() - t0
+            toks += sum(len(v) for v in res.values())
+        loop_twin = {
+            "tokens_per_sec_per_chip": round(
+                toks / wall / jax.device_count(), 2) if wall > 0 else 0.0,
+            "wall_s": round(wall, 4),
+            "groups": len(groups),
+        }
+        if loop_twin["tokens_per_sec_per_chip"] > 0:
+            speedup = round(
+                rep["tokens_per_sec_per_chip"] / loop_twin["tokens_per_sec_per_chip"], 3
+            )
+    rep["per_adapter_loop"] = loop_twin
+    rep["batched_speedup_vs_loop"] = speedup
+    if n_adapters > 0:
+        rep["adapter_pool"] = adapter_pool_accounting(
+            store.spec, rank=lora_plugin.rank, pool_slots=lora_plugin.pool_slots,
+            dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+        )
+        store_dir.cleanup()
+    else:
+        rep["adapter_pool"] = {"pool_slots": 0, "pool_bytes": 0,
+                               "swap_s_pred": 0.0, "kind": "predicted"}
     results = rep.pop("results")
     per_request = [(len(r.prompt), len(results.get(r.uid, ()))) for r in trace]
     rep["static_baseline"] = static_batching_report(per_request, plugin.num_slots)
@@ -559,6 +633,16 @@ def main():
     ap.add_argument("--serve-seed", type=int, default=0,
                     help="trace seed for --serve (same seed -> same trace "
                          "-> same schedule, pinned by the determinism test)")
+    ap.add_argument("--adapters", type=int, default=0, metavar="N",
+                    help="with --serve: multi-tenant batched LoRA — N tenants' "
+                         "adapters share the base model via one gathered einsum "
+                         "over per-slot adapter ids (ops/lora.py), hot-swapping "
+                         "through an (undersized on purpose) device pool from "
+                         "OffloadStore memmaps.  Adds the adapter fields to the "
+                         "report (pool hit rate predicted+measured, swap bytes, "
+                         "predicted pool ladder) plus the per-adapter-loop twin "
+                         "the batched path must beat (fields always present, "
+                         "zeros when N=0)")
     ap.add_argument("--plan", type=int, default=None, metavar="N",
                     help="print the abstract per-device memory plan for an N-chip mesh and exit")
     ap.add_argument("--plan-task", choices=["train", "infer"], default="train",

@@ -56,9 +56,17 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
     ]),
     "serving": ("accelerate_tpu.serving", [
         "ServingEngine", "ContinuousBatchingScheduler", "Request", "SlotState",
+        "AdapterStore", "LoraTrainer", "adapter_pool_accounting",
+        "predicted_adapter_hit_rate",
         "allocate", "release", "pages_for", "kv_pool_accounting",
         "synthesize_trace", "replay", "static_batching_report",
         "predicted_pool_utilization",
+    ]),
+    "lora": ("accelerate_tpu.ops.lora", [
+        "lora_apply", "lora_apply_sequential", "bgmv", "lora_spec",
+        "init_lora_pool", "init_adapter_params", "adapter_param_count",
+        "adapter_state_accounting", "set_lora_kernel", "lora_kernel",
+        "lora_kernel_mode", "normalize_lora_kernel",
     ]),
     "tracking": ("accelerate_tpu.tracking", [
         "GeneralTracker", "JSONLTracker", "TensorBoardTracker", "WandBTracker",
@@ -104,7 +112,7 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
     "dataclasses": ("accelerate_tpu.utils.dataclasses", [
         "GradSyncKwargs", "ProfileKwargs", "GradientAccumulationPlugin",
         "FullyShardedDataParallelPlugin", "ResiliencePlugin", "ServingPlugin",
-        "ProjectConfiguration", "DataLoaderConfiguration",
+        "LoraPlugin", "ProjectConfiguration", "DataLoaderConfiguration",
         "InitProcessGroupKwargs",
     ]),
     "memory": ("accelerate_tpu.utils.memory", None),

@@ -1,0 +1,45 @@
+"""CLEAN multi-tenant LoRA twins — the pool discipline the real
+AdapterStore uses (``serving/adapters.py``).
+
+Each function mirrors one in ``planted_lora.py`` with the hazard retired:
+the insert returns the updated pool (every donated stack aliases an
+output in place), and the iota width is a static argument fed from the
+fixed pool geometry — one compile regardless of the tenant census.
+graft-lint must stay quiet on every function here.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def insert_drops_pool(pool, staged, slot):
+    """Returns the updated pool: the donated stacks alias the outputs in
+    place (the AdapterStore rebinds ``self.pool`` to the result — the
+    donated name is dead after the call)."""
+    a = pool["a"].at[slot].set(staged["a"])
+    b = pool["b"].at[slot].set(staged["b"])
+    return {"a": a, "b": b}, jnp.sum(a) + jnp.sum(b)
+
+
+@partial(jax.jit, static_argnames=("pool_width",))
+def adapter_count_iota(x, pool_width):
+    """GL305 fixed: the width is the fixed pool geometry passed static —
+    the tenant census routes through per-row ids instead of reshaping the
+    program."""
+    return x + jnp.arange(pool_width)
+
+
+def example_args():
+    pool = {
+        "a": jnp.zeros((4, 16, 4), jnp.float32),
+        "b": jnp.zeros((4, 4, 16), jnp.float32),
+    }
+    staged = {
+        "a": jnp.ones((16, 4), jnp.float32),
+        "b": jnp.ones((4, 16), jnp.float32),
+    }
+    return {
+        "insert_drops_pool": (pool, staged, jnp.asarray(1, jnp.int32)),
+    }

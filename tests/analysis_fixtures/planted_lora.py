@@ -1,0 +1,47 @@
+"""PLANTED multi-tenant LoRA hazards — the two ways the adapter-pool
+contract breaks (corrected twins: ``clean_lora.py``).
+
+The serving AdapterStore's hot-swap insert donates the device pool (the
+stacks alias in place, like the paged-KV cache); ``insert_drops_pool``
+carries the dropped-donation shape (GL101 — the test jits it with
+``donate_argnums=(0,)``).  ``adapter_count_iota`` carries the
+adapter-count-dependent trace (GL305): a program keyed on ``len(pool)``
+recompiles every time the tenant census changes — exactly what the
+fixed-width pool + id routing exist to prevent.  Excluded from repo-wide
+sweeps like the rest of this directory.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def insert_drops_pool(pool, staged, slot):
+    """GL101 (jitted with ``donate_argnums=(0,)`` by the test): the updated
+    a/b stacks never come back — no output can alias the donated pool, so
+    the donation frees nothing and the caller loses the resident adapters."""
+    a = pool["a"].at[slot].set(staged["a"])
+    b = pool["b"].at[slot].set(staged["b"])
+    return jnp.sum(a) + jnp.sum(b)
+
+
+@jax.jit
+def adapter_count_iota(a_stack, x):
+    """GL305: ``a_stack.shape[0]`` flows straight into ``jnp.arange`` and
+    the stack is not static — the program re-specializes per resident
+    adapter count (the per-tenant-mix recompile the segment-batched pool
+    removes)."""
+    return x + jnp.arange(a_stack.shape[0])
+
+
+def example_args():
+    pool = {
+        "a": jnp.zeros((4, 16, 4), jnp.float32),
+        "b": jnp.zeros((4, 4, 16), jnp.float32),
+    }
+    staged = {
+        "a": jnp.ones((16, 4), jnp.float32),
+        "b": jnp.ones((4, 16), jnp.float32),
+    }
+    return {
+        "insert_drops_pool": (pool, staged, jnp.asarray(1, jnp.int32)),
+    }

@@ -55,6 +55,9 @@ def _reset_singletons():
     PartialState._reset_state()
     set_collective_matmul(None)  # clear any ambient ring-matmul override
     install_fault_plan(None)     # no fault plan may leak across tests
+    from accelerate_tpu.ops.lora import set_lora_kernel
+
+    set_lora_kernel(None)        # clear any ambient LoRA kernel override
 
 
 @pytest.fixture

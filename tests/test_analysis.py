@@ -487,6 +487,35 @@ def test_fixture_serving_clean_twin_quiet():
     assert not rep.unsuppressed(), rep.render()
 
 
+def test_fixture_lora_planted_gl305_adapter_count_trace():
+    """A program keyed on the adapter-stack width re-specializes per tenant
+    census — the AST recompile rule flags it; the clean twin (static pool
+    width, id routing) stays quiet."""
+    rep = lint_paths([FIXTURES / "planted_lora.py"], excludes=())
+    assert "GL305" in _rules_of(rep), rep.render()
+
+
+def test_fixture_lora_planted_gl101_dropped_pool_donation():
+    """An adapter-pool insert that donates the stacks but returns only a
+    scalar wastes the donation (the hot-swap analog of the dropped-KV-pool
+    shape) — the jaxpr auditor flags it; the corrected twin (updated pool
+    returned) is quiet."""
+    planted = _load_fixture("planted_lora")
+    args = planted.example_args()["insert_drops_pool"]
+    rep = audit_fn(planted.insert_drops_pool, *args, donate_argnums=(0,))
+    assert "GL101" in _rules_of(rep), rep.render()
+
+    clean = _load_fixture("clean_lora")
+    args = clean.example_args()["insert_drops_pool"]
+    rep = audit_fn(clean.insert_drops_pool, *args, donate_argnums=(0,))
+    assert not rep.unsuppressed(), rep.render()
+
+
+def test_fixture_lora_clean_twin_quiet():
+    rep = lint_paths([FIXTURES / "clean_lora.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
+
+
 def test_gl205_one_hop_name_resolution_and_scope():
     # the live path reaches the write through a local assignment — still hit
     src = (

@@ -1,14 +1,24 @@
 """Scoped compilation-cache management (utils/compile_cache.py): every
 harness gets a cache directory keyed by toolchain + tag + scope, retiring
 the documented shared-/tmp corruption flake (concurrent jax processes) and
-stale-version reuse."""
+stale-version reuse — plus the prewarm pack distribution + version-keyed
+eviction (the closing slice of ROADMAP item 4)."""
+
+import json
+import tarfile
+from pathlib import Path
 
 import jax
 import pytest
 
 from accelerate_tpu.utils.compile_cache import (
+    PREWARM_MANIFEST,
     enable_scoped_compilation_cache,
+    export_prewarm,
+    load_prewarm,
     scoped_cache_dir,
+    sweep_stale_versions,
+    toolchain_version_key,
 )
 
 
@@ -48,3 +58,96 @@ def test_enable_points_jax_at_scoped_dir(tmp_path, monkeypatch):
         assert d.startswith(str(tmp_path))
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
+
+
+# ---------------------------------------------------------------------------
+# prewarm pack + version-keyed eviction
+# ---------------------------------------------------------------------------
+
+
+def _fake_warm_cache(root, tag, entries):
+    d = Path(scoped_cache_dir(tag, root=str(root)))
+    for name, payload in entries.items():
+        (d / name).write_bytes(payload)
+    return d
+
+
+def test_prewarm_export_load_roundtrip(tmp_path, monkeypatch):
+    """A warmed cache packs into one toolchain-keyed archive; loading it on
+    a fresh host (root) reproduces every entry byte-for-byte."""
+    monkeypatch.delenv("ACCELERATE_JAX_CACHE_SCOPE", raising=False)
+    monkeypatch.delenv("PYTEST_XDIST_WORKER", raising=False)
+    entries = {"prog_a.bin": b"\x01\x02xla", "prog_b.bin": b"\x03serving"}
+    _fake_warm_cache(tmp_path / "src", "deploy", entries)
+    pack = export_prewarm(str(tmp_path / "prewarm.tar"), "deploy",
+                          root=str(tmp_path / "src"))
+    with tarfile.open(pack) as tar:
+        manifest = json.loads(tar.extractfile(PREWARM_MANIFEST).read())
+    assert manifest["version_key"] == toolchain_version_key()
+    assert manifest["entries"] == sorted(entries)
+
+    report = load_prewarm(pack, "deploy", root=str(tmp_path / "dst"))
+    assert report["loaded"] == 2 and not report["stale"]
+    dst = Path(scoped_cache_dir("deploy", root=str(tmp_path / "dst")))
+    for name, payload in entries.items():
+        assert (dst / name).read_bytes() == payload
+
+
+def test_prewarm_refuses_foreign_toolchain(tmp_path, monkeypatch):
+    """A pack built by a different jax/Python build is refused (its entries
+    could never hit) — loaded=0, stale=True, nothing extracted; a broken
+    archive degrades the same way instead of failing the deploy."""
+    monkeypatch.delenv("ACCELERATE_JAX_CACHE_SCOPE", raising=False)
+    monkeypatch.delenv("PYTEST_XDIST_WORKER", raising=False)
+    _fake_warm_cache(tmp_path / "src", "deploy", {"prog.bin": b"x"})
+    pack = export_prewarm(str(tmp_path / "p.tar"), "deploy",
+                          root=str(tmp_path / "src"))
+    # rewrite the manifest to a foreign toolchain
+    foreign = str(tmp_path / "foreign.tar")
+    with tarfile.open(pack) as tar, tarfile.open(foreign, "w") as out:
+        for m in tar.getmembers():
+            data = tar.extractfile(m).read()
+            if m.name == PREWARM_MANIFEST:
+                data = json.dumps({"version_key": "jax0.0.1-py2.7",
+                                   "tag": "deploy", "entries": ["prog.bin"]}).encode()
+            m.size = len(data)
+            import io
+
+            out.addfile(m, io.BytesIO(data))
+    report = load_prewarm(foreign, "deploy", root=str(tmp_path / "dst"))
+    assert report["stale"] and report["loaded"] == 0
+    dst = Path(scoped_cache_dir("deploy", root=str(tmp_path / "dst")))
+    assert not (dst / "prog.bin").exists()
+    # truncated/garbage archive: same degrade, never a raise
+    bad = tmp_path / "bad.tar"
+    bad.write_bytes(b"not a tar")
+    rep2 = load_prewarm(str(bad), "deploy", root=str(tmp_path / "dst"))
+    assert rep2["stale"] and rep2["loaded"] == 0
+    # a valid tar with NO manifest member (foreign pack): refused, no raise
+    noman = tmp_path / "nomanifest.tar"
+    with tarfile.open(noman, "w") as out:
+        import io
+
+        info = tarfile.TarInfo("cache/prog.bin")
+        info.size = 1
+        out.addfile(info, io.BytesIO(b"x"))
+    rep3 = load_prewarm(str(noman), "deploy", root=str(tmp_path / "dst"))
+    assert rep3["stale"] and rep3["loaded"] == 0
+
+
+def test_load_prewarm_sweeps_stale_version_dirs(tmp_path, monkeypatch):
+    """Version-keyed eviction: loading (or sweeping directly) removes every
+    cache-root subdir keyed by a different toolchain, and ONLY those."""
+    monkeypatch.delenv("ACCELERATE_JAX_CACHE_SCOPE", raising=False)
+    monkeypatch.delenv("PYTEST_XDIST_WORKER", raising=False)
+    root = tmp_path / "cache"
+    _fake_warm_cache(root, "deploy", {"prog.bin": b"x"})
+    stale = root / "jax0.3.0-py3.8" / "deploy"
+    stale.mkdir(parents=True)
+    (stale / "dead.bin").write_bytes(b"stale")
+    pack = export_prewarm(str(tmp_path / "p.tar"), "deploy", root=str(root))
+    report = load_prewarm(pack, "deploy", root=str(root))
+    assert report["swept"] == ["jax0.3.0-py3.8"]
+    assert not stale.exists()
+    assert (root / toolchain_version_key()).is_dir()  # current survives
+    assert sweep_stale_versions(str(root)) == []      # idempotent

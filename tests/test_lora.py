@@ -1,0 +1,489 @@
+"""Multi-tenant batched LoRA (ISSUE 9 / ROADMAP item 2): the segment-batched
+adapter matmul (ops/lora.py), the hot-swap adapter pool
+(serving/adapters.py), per-request routing in the serving engine, and the
+per-adapter fine-tuning path.
+
+The acceptance pins live here: batched multi-adapter decode is
+BITWISE-identical to applying each request's adapter sequentially (mixed
+ids in one batch, id-0 "no adapter" rows included, and under
+eviction/hot-swap pressure), while the decode step stays ONE fixed-shape
+donation-clean compiled program for any tenant mix (the replay harness
+raises on any post-warmup compile)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import GenerationConfig, generate_paged
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.ops.lora import (
+    adapter_param_count,
+    adapter_state_accounting,
+    bgmv,
+    init_adapter_params,
+    init_lora_pool,
+    lora_apply,
+    lora_apply_sequential,
+    lora_spec,
+)
+from accelerate_tpu.serving import (
+    AdapterPoolFullError,
+    AdapterStore,
+    ContinuousBatchingScheduler,
+    LoraTrainer,
+    Request,
+    ServingEngine,
+    adapter_pool_accounting,
+    predicted_adapter_hit_rate,
+    replay,
+    synthesize_trace,
+)
+from accelerate_tpu.utils.dataclasses import LoraPlugin, ServingPlugin
+
+GEN_CFG = GenerationConfig(max_new_tokens=6)
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _lplug(**kw):
+    kw.setdefault("rank", 4)
+    kw.setdefault("pool_slots", 2)
+    kw.setdefault("kernel", "native")
+    return LoraPlugin(**kw)
+
+
+def _splug(**kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pages_per_slot", 8)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("decode_kernel", "native")
+    return ServingPlugin(**kw)
+
+
+def _store(params, plugin, tenants, offload_dir=None):
+    store = AdapterStore(params, plugin, offload_dir=offload_dir)
+    for t in tenants:
+        store.publish_random(t, jax.random.PRNGKey(100 + t))
+    return store
+
+
+# ---------------------------------------------------------------------------
+# the op: batched == sequential, bitwise
+# ---------------------------------------------------------------------------
+
+
+def test_lora_apply_batched_bitwise_equals_sequential():
+    """The tentpole pin at the op level: one gathered einsum over mixed
+    adapter ids reproduces the per-row sequential schedule BITWISE — id-0
+    rows come back as the untouched base output (a where-select, so even a
+    negative zero survives), eagerly and under jit."""
+    rng = np.random.default_rng(0)
+    B, T, d, r, o, P = 6, 3, 16, 4, 24, 3
+    x = jnp.asarray(rng.normal(size=(B, T, d)), jnp.bfloat16)
+    y = jnp.asarray(rng.normal(size=(B, T, o)), jnp.bfloat16)
+    y = y.at[0, 0, 0].set(jnp.bfloat16(-0.0))  # the sign-bit witness
+    a = jnp.asarray(rng.normal(size=(P + 1, d, r)), jnp.bfloat16).at[0].set(0)
+    b = jnp.asarray(rng.normal(size=(P + 1, r, o)), jnp.bfloat16).at[0].set(0)
+    ids = jnp.asarray([0, 1, 3, 1, 2, 0], jnp.int32)
+
+    out = lora_apply(x, y, a, b, ids, kernel="native")
+    ref = lora_apply_sequential(x, y, a, b, ids)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # id-0 rows: bitwise the base output, sign bit of -0.0 included
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(y[0]))
+    assert np.signbit(np.asarray(out, np.float32))[0, 0, 0]
+    # under jit (the serving path): same bits
+    out_jit = jax.jit(lambda *a_: lora_apply(*a_, kernel="native"))(x, y, a, b, ids)
+    np.testing.assert_array_equal(np.asarray(out_jit), np.asarray(out))
+    # 2-D rows (LMHead / per-token routing shape)
+    out2 = lora_apply(x[:, 0], y[:, 0], a, b, ids, kernel="native")
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(out[:, 0]))
+
+
+def test_bgmv_kernel_matches_native(tiny_model):
+    """The Pallas gather-matmul decode kernel (interpret mode off-TPU) ==
+    the gathered-einsum math, fp32-accumulated, mixed ids included."""
+    rng = np.random.default_rng(1)
+    S, d, r, o, P = 5, 32, 4, 48, 3
+    x = jnp.asarray(rng.normal(size=(S, d)), jnp.float32)
+    a = jnp.asarray(rng.normal(size=(P + 1, d, r)), jnp.float32).at[0].set(0)
+    b = jnp.asarray(rng.normal(size=(P + 1, r, o)), jnp.float32).at[0].set(0)
+    ids = np.asarray([0, 2, 1, 3, 2], np.int32)
+    out = np.asarray(bgmv(x, a, b, jnp.asarray(ids)))
+    ref = np.stack([
+        (np.asarray(x)[i] @ np.asarray(a)[ids[i]]) @ np.asarray(b)[ids[i]]
+        for i in range(S)
+    ])
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+    # dispatch through lora_apply(kernel="bgmv") keeps id-0 rows bitwise
+    y = jnp.asarray(rng.normal(size=(S, 1, o)), jnp.float32)
+    full = lora_apply(x[:, None], y, a, b, jnp.asarray(ids), kernel="bgmv")
+    np.testing.assert_array_equal(np.asarray(full[0]), np.asarray(y[0]))
+
+
+def test_lora_model_mixed_batch_bitwise(tiny_model):
+    """Through the real model: a mixed-id batch row is bitwise-identical to
+    the same row in a single-tenant (all-one-id) pass, and id-0 rows are
+    bitwise the base forward."""
+    model, params = tiny_model
+    spec = lora_spec(params)
+    pool = init_lora_pool(spec, pool_slots=3, rank=4, dtype=model.config.dtype)
+    ad = init_adapter_params(jax.random.PRNGKey(1), spec, 4, init_b="normal",
+                             dtype=model.config.dtype)
+    pool = jax.tree_util.tree_map(lambda p, a: p.at[2].set(a), pool, ad)
+    x = jnp.asarray(np.random.default_rng(0).integers(1, 255, (3, 8)), jnp.int32)
+    base = model.apply(params, x)
+    mixed = model.apply({**params, "lora": pool}, x,
+                        adapter_ids=jnp.asarray([0, 2, 0], jnp.int32))
+    solo = model.apply({**params, "lora": pool}, x,
+                       adapter_ids=jnp.asarray([2, 2, 2], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(mixed[0]), np.asarray(base[0]))
+    np.testing.assert_array_equal(np.asarray(mixed[2]), np.asarray(base[2]))
+    np.testing.assert_array_equal(np.asarray(mixed[1]), np.asarray(solo[1]))
+    assert not np.array_equal(np.asarray(mixed[1]), np.asarray(base[1]))
+
+
+# ---------------------------------------------------------------------------
+# the pool: LRU hot-swap, refcount pinning, donation
+# ---------------------------------------------------------------------------
+
+
+def test_adapter_pool_lru_and_refcount_pinning(tiny_model):
+    """Pool pressure evicts the LRU *unpinned* adapter only: a slot held by
+    an in-flight request survives any number of swaps around it, the
+    swapped-in stack holds exactly the published factors, and a fully
+    pinned pool refuses (AdapterPoolFullError) instead of evicting."""
+    model, params = tiny_model
+    store = _store(params, _lplug(pool_slots=2), (1, 2, 3))
+    published2 = store._host_tree(2)
+
+    s1, sw1 = store.pin(1)
+    s2, sw2 = store.pin(2)
+    assert sw1 and sw2 and {s1, s2} == {1, 2}
+    # the resident stack row IS the published adapter
+    flat_pool = {}
+
+    def collect(path, leaf):
+        flat_pool["/".join(str(getattr(k, "key", k)) for k in path)] = leaf
+
+    jax.tree_util.tree_map_with_path(collect, store.pool)
+    for key, host in published2.items():
+        np.testing.assert_array_equal(np.asarray(flat_pool[key][s2]),
+                                      np.asarray(host))
+    # the null slot stays zeros through every swap (the id-0 invariant)
+    assert all(not np.asarray(leaf[0]).any() for leaf in flat_pool.values())
+    # both pinned: nothing evictable
+    assert not store.can_pin(3)
+    with pytest.raises(AdapterPoolFullError):
+        store.pin(3)
+    # unpin 1 -> it becomes the LRU victim; 2 (still pinned) survives
+    store.unpin(1)
+    s3, sw3 = store.pin(3)
+    assert sw3 and s3 == s1
+    assert not store.resident(1) and store.resident(2)
+    # re-pin of a resident adapter is a hit, not a swap
+    s2b, sw2b = store.pin(2)
+    assert s2b == s2 and not sw2b
+    assert store.hits == 1 and store.swaps == 3
+    assert store.swap_bytes == 3 * sum(
+        leaf.size * leaf.dtype.itemsize for leaf in published2.values()
+    )
+    # shared-adapter refcount: tenant 2 holds TWO in-flight requests — one
+    # retire leaves it pinned, so only re-unpinning frees it for LRU
+    store.unpin(2)
+    assert store.refcount.get(2, 0) == 1
+    assert store._evictable() is None  # 2 and 3 both still pinned
+    store.unpin(2)
+    store.unpin(3)
+    assert store._evictable() == 3  # LRU order: 2 was used (re-pinned) last
+
+    # RE-publish of a resident tenant refreshes its slot in place (and
+    # never serves a stale staged prefetch): continuous fine-tuning must
+    # not keep decoding with the old weights until LRU luck evicts them
+    from accelerate_tpu.serving.adapters import _flatten as _flat
+
+    fresh = init_adapter_params(jax.random.PRNGKey(99), store.spec, 4,
+                                init_b="normal", dtype=store.dtype)
+    store.publish(2, fresh)
+    jax.tree_util.tree_map_with_path(collect, store.pool)
+    for key, leaf in _flat(fresh).items():
+        np.testing.assert_array_equal(np.asarray(flat_pool[key][s2]),
+                                      np.asarray(leaf))
+
+
+def test_adapter_prefetch_streams_before_pin(tiny_model):
+    """Explicit prefetch (the scheduler's waiting-queue lookahead) stages
+    the H2D upload early; the later pin is a prefetch HIT in the stream
+    stats — the hot-swap analog of the layer-prefetch double buffer."""
+    model, params = tiny_model
+    store = _store(params, _lplug(), (1, 2))
+    assert store.prefetch(1)
+    assert not store.prefetch(1)   # already in flight
+    store.pin(1)
+    assert store.stats.prefetch_hits == 1
+    # resident adapters never re-stage
+    assert not store.prefetch(1)
+
+
+def test_predicted_hit_rate_lru_replay():
+    assert predicted_adapter_hit_rate([], 2) == 0.0
+    assert predicted_adapter_hit_rate([0, 0], 2) == 0.0
+    # 1,2 miss; 1 hit; 3 miss evicts 2; 2 miss again
+    assert predicted_adapter_hit_rate([1, 2, 1, 3, 2], 2) == 0.2
+    # pool >= tenants: only compulsory misses
+    assert predicted_adapter_hit_rate([1, 2, 1, 2, 1], 2) == 0.6
+
+
+def test_adapter_accounting_ladders(tiny_model):
+    model, params = tiny_model
+    spec = lora_spec(params)
+    n = adapter_param_count(spec, 4)
+    assert n == sum(4 * (di + do) for di, do in spec.values())
+    acct = adapter_state_accounting(spec, 4, 10_000, optimizer="lion-sr8")
+    assert acct["params_per_adapter"] == n
+    assert acct["state_bytes_per_adapter"] == int(n * 8.1)  # the -sr8 ladder row
+    assert acct["adapters_per_host"]["256GiB"] > acct["adapters_per_host"]["64GiB"]
+    pool = adapter_pool_accounting(spec, rank=4, pool_slots=8, decode_step_s=0.005)
+    assert pool["pool_bytes"] == pool["bytes_per_slot"] * 9
+    assert 0.0 <= pool["swap_overlap_frac_pred"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# serving: routing, parity under pressure, one compiled program
+# ---------------------------------------------------------------------------
+
+
+def test_serve_with_adapters_matches_per_request_reference(tiny_model, tmp_path):
+    """THE acceptance pin: a mixed-tenant trace served batched — WITH
+    hot-swap pressure (pool smaller than the tenant set) AND page-pressure
+    evictions — emits per request exactly the tokens of a dedicated
+    single-request pass through ``generate_paged`` with that adapter (the
+    sequential reference), while the whole replay runs zero post-warmup
+    compiles (``strict_compiles`` raises otherwise) and the decode step
+    audits donation-clean."""
+    model, params = tiny_model
+    lplug = _lplug(pool_slots=2)
+    store = _store(params, lplug, (1, 2, 3), offload_dir=str(tmp_path / "cold"))
+    splug = ServingPlugin(num_slots=4, page_size=2, pages_per_slot=10,
+                          num_pages=14, prefill_chunk=8, decode_kernel="native")
+    trace = synthesize_trace(3, 7, vocab_size=255, prompt_len_range=(3, 9),
+                             new_tokens_range=(3, 6), adapters=3)
+    assert len({r.adapter_id for r in trace if r.adapter_id}) >= 2
+    eng = ServingEngine(model, params, splug, GEN_CFG, adapters=store)
+    rep = replay(eng, trace)  # strict_compiles=True: raises on any recompile
+    assert rep["completed"] == len(trace)
+    assert rep["adapter_swaps"] > 0          # hot-swap pressure was real
+    assert rep["evictions"] > 0              # page-pressure eviction too
+    assert rep["compiles_measured"] == 0
+    assert eng.free_page_mirror_in_sync()
+
+    ref_store = _store(params, lplug, (1, 2, 3))
+    for r in trace:
+        out = generate_paged(
+            model, params, jnp.asarray([r.prompt], jnp.int32),
+            GenerationConfig(max_new_tokens=r.max_new_tokens),
+            serving_plugin=_splug(), adapters=ref_store,
+            adapter_ids=[r.adapter_id],
+        )
+        ref = [int(x) for x in np.asarray(out[0])][: len(rep["results"][r.uid])]
+        assert rep["results"][r.uid] == ref, f"request {r.uid} (tenant {r.adapter_id})"
+
+    audit = eng.audit_decode_step(default_memory_kind="device")
+    assert not audit.unsuppressed(), audit.render()
+
+
+def test_adapter_trace_determinism(tiny_model):
+    """Same seed -> same multi-tenant trace -> identical schedule
+    (swap/bypass events included) and identical tokens."""
+    model, params = tiny_model
+
+    def run():
+        store = _store(params, _lplug(pool_slots=2), (1, 2, 3))
+        trace = synthesize_trace(5, 6, vocab_size=255, prompt_len_range=(3, 8),
+                                 new_tokens_range=(2, 5), adapters=3)
+        eng = ServingEngine(model, params, _splug(), GEN_CFG, adapters=store)
+        results = eng.run(trace)
+        return eng.sched.events, results
+
+    ev_a, res_a = run()
+    ev_b, res_b = run()
+    assert ev_a == ev_b and res_a == res_b
+    assert any(e[0] == "swap" for e in ev_a)
+
+
+def test_unpublished_adapter_rejected(tiny_model):
+    model, params = tiny_model
+    store = _store(params, _lplug(), (1,))
+    eng = ServingEngine(model, params, _splug(), GEN_CFG, adapters=store)
+    with pytest.raises(ValueError, match="never published"):
+        eng.add_request(Request(uid=0, prompt=(3, 4), max_new_tokens=2,
+                                adapter_id=9))
+    eng2 = ServingEngine(model, params, _splug(), GEN_CFG)
+    with pytest.raises(ValueError, match="no AdapterStore"):
+        eng2.add_request(Request(uid=0, prompt=(3, 4), max_new_tokens=2,
+                                 adapter_id=1))
+
+
+# ---------------------------------------------------------------------------
+# admission fairness: bounded-age bypass (the satellite, pinned)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_bounded_age_bypass_prevents_starvation(tiny_model):
+    """Deterministic trace: a head-of-line tenant blocked on adapter-pool
+    contention is bypassed by zero-swap arrivals for EXACTLY
+    ``max_bypass_age`` ticks, then admission holds the line until the
+    starved tenant's pin succeeds — with strict FIFO (age 0) no bypass
+    ever happens.  Pinned event-for-event."""
+    model, params = tiny_model
+    store = _store(params, _lplug(pool_slots=1, max_bypass_age=2), (1, 2))
+    sched = ContinuousBatchingScheduler(
+        num_slots=2, num_pages=64, page_size=4, pages_per_slot=8,
+        prefill_chunk=8, prefill_buckets=(8,), adapters=store,
+        max_bypass_age=2,
+    )
+    # tenant 1 occupies the single pool slot via an in-flight request
+    sched.submit(Request(uid=0, prompt=(1, 2), max_new_tokens=2, adapter_id=1))
+    assert sched.admit() == [0]
+    # head-of-line: tenant 2 (needs the pinned slot) + zero-swap arrivals
+    sched.submit(Request(uid=1, prompt=(1, 2), max_new_tokens=2, adapter_id=2))
+    for uid in (2, 3, 4):
+        sched.submit(Request(uid=uid, prompt=(1, 2), max_new_tokens=2))
+
+    admitted_uids = []
+    for tick in range(4):
+        new = sched.admit()
+        admitted_uids.extend(sched.slots[s].request.uid for s in new)
+        for s in new:  # retire the bypasser: frees its slot for the next tick
+            if sched.slots[s].request.adapter_id == 0:
+                sched.slots[s].prefilled = 2
+                sched.slots[s].tokens = [0, 0]
+                sched.finish(s)
+    # ticks 1..2: bypass allowed (uid 2 then 3); tick 3+: line held for uid 1
+    assert admitted_uids == [2, 3]
+    assert [e for e in sched.events if e[0] == "bypass"] == \
+        [("bypass", 2, 1), ("bypass", 3, 1)]
+    # the head is starving no longer once tenant 1's request retires
+    sched.slots[0].prefilled = 2
+    sched.slots[0].tokens = [0, 0]
+    sched.finish(0)
+    new = sched.admit()
+    uids = [sched.slots[s].request.uid for s in new]
+    assert uids[0] == 1  # the starved tenant admits FIRST
+    assert ("swap", 2, 1) in sched.events
+
+    # strict FIFO (max_bypass_age=0): zero bypass events, ever
+    store2 = _store(params, _lplug(pool_slots=1, max_bypass_age=0), (1, 2))
+    sched2 = ContinuousBatchingScheduler(
+        num_slots=2, num_pages=64, page_size=4, pages_per_slot=8,
+        prefill_chunk=8, prefill_buckets=(8,), adapters=store2,
+        max_bypass_age=0,
+    )
+    sched2.submit(Request(uid=0, prompt=(1, 2), max_new_tokens=2, adapter_id=1))
+    sched2.admit()
+    sched2.submit(Request(uid=1, prompt=(1, 2), max_new_tokens=2, adapter_id=2))
+    sched2.submit(Request(uid=2, prompt=(1, 2), max_new_tokens=2))
+    for _ in range(3):
+        assert sched2.admit() == []
+    assert not [e for e in sched2.events if e[0] == "bypass"]
+
+
+# ---------------------------------------------------------------------------
+# fine-tuning: batched grads, host state, verified checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_lora_trainer_batched_step_and_verified_checkpoint(tiny_model, tmp_path):
+    """One batched mixed-tenant step: loss matches the per-adapter
+    sequential schedule, only the gathered tenants' adapters move, the
+    per-adapter int8-SR optimizer state round-trips BIT-EXACTLY through
+    the verified-checkpoint path (manifest + tmp-stage + os.replace), a
+    restored trainer continues bit-identically, and a torn save raises
+    instead of resuming wrong tenants."""
+    from accelerate_tpu.checkpointing import CheckpointCorruptError
+
+    model, params = tiny_model
+    trainer = LoraTrainer(model, params, _lplug(pool_slots=3, optimizer="lion-sr8"))
+    for t in (1, 2, 3):
+        trainer.add_adapter(t)
+    untouched_before = jax.tree_util.tree_leaves(trainer.adapters[3])
+    rng = np.random.default_rng(2)
+    toks = jnp.asarray(rng.integers(1, 255, (4, 8)), jnp.int32)
+    batch = {"input_ids": toks, "labels": toks}
+    seq_loss = trainer.sequential_loss(batch, [1, 2, 0, 1])
+    loss = trainer.step(batch, [1, 2, 0, 1])
+    assert np.isclose(loss, seq_loss, rtol=1e-2)
+    # tenant 3 took no rows: its adapter and state must be untouched
+    for before, after in zip(untouched_before,
+                             jax.tree_util.tree_leaves(trainer.adapters[3])):
+        np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+    rep = trainer.host_state_report()
+    assert rep["n_adapters"] == 3 and rep["state_bytes"] > 0
+
+    ck = tmp_path / "adapters_ck"
+    trainer.save(str(ck))
+    assert not (tmp_path / "adapters_ck.tmp").exists()  # atomic publish
+    restored = LoraTrainer(model, params, _lplug(pool_slots=3, optimizer="lion-sr8"))
+    assert restored.load(str(ck)) == [1, 2, 3]
+    for t in (1, 2, 3):
+        for a, b in zip(jax.tree_util.tree_leaves(trainer.adapters[t]),
+                        jax.tree_util.tree_leaves(restored.adapters[t])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(trainer.opt_states[t]),
+                        jax.tree_util.tree_leaves(restored.opt_states[t])):
+            np.testing.assert_array_equal(
+                np.asarray(LoraTrainer._npz_safe(a)),
+                np.asarray(LoraTrainer._npz_safe(b)))
+    # bitwise-identical continuation
+    assert trainer.step(batch, [1, 2, 0, 1]) == restored.step(batch, [1, 2, 0, 1])
+    # periodic checkpointing: a SECOND save to the same directory
+    # republishes cleanly (os.replace cannot overwrite a non-empty dir —
+    # the finalize discipline clears it first), and still verifies
+    trainer.save(str(ck))
+    assert LoraTrainer(model, params,
+                       _lplug(pool_slots=3, optimizer="lion-sr8")).load(str(ck)) == [1, 2, 3]
+
+    # torn save: truncate a shard -> the crc32 manifest gate raises
+    shard = sorted(ck.glob("adapter_*.npz"))[0]
+    shard.write_bytes(shard.read_bytes()[:-16])
+    with pytest.raises(CheckpointCorruptError):
+        LoraTrainer(model, params, _lplug(pool_slots=3)).load(str(ck))
+
+
+# ---------------------------------------------------------------------------
+# plugin knobs
+# ---------------------------------------------------------------------------
+
+
+def test_lora_plugin_env_defaults(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_LORA_RANK", "16")
+    monkeypatch.setenv("ACCELERATE_LORA_POOL_SLOTS", "7")
+    monkeypatch.setenv("ACCELERATE_LORA_TARGETS", "q_proj, o_proj")
+    monkeypatch.setenv("ACCELERATE_LORA_KERNEL", "bgmv")
+    monkeypatch.setenv("ACCELERATE_LORA_BYPASS_AGE", "5")
+    p = LoraPlugin()
+    assert (p.rank, p.pool_slots, p.kernel, p.max_bypass_age) == (16, 7, "bgmv", 5)
+    assert p.targets == ("q_proj", "o_proj")
+    # explicit arguments always win over env
+    assert LoraPlugin(rank=2).rank == 2
+    with pytest.raises(ValueError):
+        LoraPlugin(kernel="mystery")
+    with pytest.raises(ValueError):
+        LoraPlugin(rank=0)
+    with pytest.raises(ValueError):
+        LoraPlugin(pool_slots=0)
+    with pytest.raises(ValueError):
+        LoraPlugin(max_bypass_age=-1)
+    with pytest.raises(ValueError):
+        LoraPlugin(targets=())

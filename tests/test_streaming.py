@@ -133,6 +133,27 @@ def test_layer_prefetcher_dispatch_order():
     assert fetch.calls == [0, 1, 2]
 
 
+def test_layer_prefetcher_depth0_explicit_prefetch():
+    """depth=0 disables the sequential lookahead; the caller drives the
+    double buffer through prefetch() — the adapter hot-swap contract
+    (serving/adapters.py), where "next" is a scheduler decision, not i+1."""
+    fetch = _CountingFetch(4)
+    stats = StreamStats()
+    pf = LayerPrefetcher(fetch, 4, depth=0, stats=stats)
+    pf.get(0)
+    assert fetch.calls == [0]          # no i+1 lookahead at depth 0
+    assert pf.prefetch(2)              # explicit, non-blocking dispatch
+    assert not pf.prefetch(2)          # already in flight: no re-issue
+    out = pf.get(2)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full((4,), 2.0))
+    assert fetch.calls == [0, 2]       # the get() consumed the staged slot
+    assert stats.prefetch_hits == 1
+    with pytest.raises(IndexError):
+        pf.prefetch(9)
+    with pytest.raises(ValueError):
+        LayerPrefetcher(fetch, 4, depth=-1)
+
+
 def test_layer_prefetcher_wrap_prefetches_layer0_for_next_pass():
     fetch = _CountingFetch(3)
     pf = LayerPrefetcher(fetch, 3, wrap=True)
