@@ -577,8 +577,52 @@ class Accelerator:
 
     def _compression_axes(self) -> list:
         """Mesh axes the gradient compression reduces over (the data-parallel
-        plane; every other axis must be trivial for DDP-style compression)."""
-        return [a for a in ("dp_replicate", "dp_shard") if a in self.mesh.shape]
+        plane; every other axis must be trivial for DDP-style compression).
+        Includes the cross-slice ``dcn`` axis — it is data parallelism too,
+        just on the slow network tier."""
+        return [a for a in ("dcn", "dp_replicate", "dp_shard") if a in self.mesh.shape]
+
+    def _resolve_hierarchical(self) -> tuple[bool, Optional[str]]:
+        """``(engage, incompatibility)`` for the ICI->DCN hierarchical
+        gradient-sync path: engage when the mesh has a non-trivial ``dcn``
+        axis and the config is DDP-shaped (same constraints as PowerSGD
+        compression — replicated params, pure data parallelism).
+        ``incompatibility`` names the blocker when the dcn axis exists but
+        the path cannot replace the flat psum."""
+        gsk = self.grad_sync_kwargs
+        if gsk.hierarchical is False:
+            return False, None
+        if int(self.mesh.shape.get("dcn", 1)) <= 1:
+            if gsk.hierarchical:
+                return False, "mesh has no dcn axis (ParallelismConfig.dcn_size <= 1)"
+            return False, None
+        pc = self.parallelism_config
+        bad = {k: v for k, v in
+               {"tp": pc.tp_size, "pp": pc.pp_size, "cp": pc.cp_size,
+                "sp": pc.sp_size, "ep": pc.ep_size}.items() if v > 1}
+        from .parallel.sharding import param_fsdp_axes, resolve_sharding_strategy
+
+        strategy = resolve_sharding_strategy(self.fsdp_plugin, pc)
+        params_sharded = bool(param_fsdp_axes(self.mesh, pc, strategy))
+        offload_opt, _ = self._offload_flags()
+        blockers = []
+        if bad:
+            blockers.append(f"non-dp axes {bad}")
+        if params_sharded:
+            blockers.append(f"params sharded ({strategy})")
+        if offload_opt:
+            blockers.append("cpu_offload")
+        if self.gradient_state.num_steps > 1:
+            blockers.append("gradient accumulation > 1")
+        if self.policy.needs_loss_scaling:
+            blockers.append("fp16 loss scaling")
+        if gsk.comm_dtype or gsk.grad_dtype:
+            blockers.append("comm_dtype/grad_dtype")
+        if gsk.compression:
+            blockers.append("compression='powersgd' (the flat DDP codec owns the step)")
+        if blockers:
+            return False, "; ".join(blockers)
+        return True, None
 
     def _default_batch_spec(self):
         cfg = self.parallelism_config
@@ -771,6 +815,29 @@ class Accelerator:
                 # Qs replicated; each rank owns its residual slice
                 rep = NamedSharding(self.mesh, PartitionSpec())
                 err_sh = NamedSharding(self.mesh, PartitionSpec(tuple(axes) or None))
+                qs = jax.tree_util.tree_map(lambda q: jax.device_put(q, rep), qs)
+                errs = jax.tree_util.tree_map(lambda e: jax.device_put(e, err_sh), errs)
+            comm_state = (qs, errs)
+        elif (self.grad_sync_kwargs.dcn_compression == "powersgd"
+              and self._resolve_hierarchical()[0]):
+            # DCN codec state for the hierarchical path: per-leaf slab error
+            # buffers (one [rows, cols] residual per dp rank, sharded over
+            # the joint dp axes) + replicated warm-start Qs.  Only built
+            # when the hierarchical path will actually engage — prepare_
+            # train_step raises on incompatible configs before a None
+            # comm_state could silently drop the codec.
+            from .parallel.hierarchical import init_dcn_powersgd_state
+
+            axes = self._compression_axes()
+            ici_axes = [a for a in axes if a != "dcn"]
+            ici = int(np.prod([self.mesh.shape[a] for a in ici_axes])) if ici_axes else 1
+            dcn = int(self.mesh.shape.get("dcn", 1))
+            qs, errs = init_dcn_powersgd_state(
+                params, self.grad_sync_kwargs.rank, dcn * ici, ici
+            )
+            if sharded:
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                err_sh = NamedSharding(self.mesh, PartitionSpec(tuple(axes)))
                 qs = jax.tree_util.tree_map(lambda q: jax.device_put(q, rep), qs)
                 errs = jax.tree_util.tree_map(lambda e: jax.device_put(e, err_sh), errs)
             comm_state = (qs, errs)
@@ -1253,6 +1320,51 @@ class Accelerator:
         compression = self.grad_sync_kwargs.compression
         if compression not in (None, "powersgd"):
             raise ValueError(f"unknown GradSyncKwargs.compression {compression!r}; options: 'powersgd'")
+        dcn_codec = self.grad_sync_kwargs.dcn_compression
+        if dcn_codec not in (None, "powersgd"):
+            raise ValueError(
+                f"unknown GradSyncKwargs.dcn_compression {dcn_codec!r}; options: 'powersgd'"
+            )
+        # Hierarchical ICI->DCN reduction (parallel/hierarchical.py): engages
+        # when the mesh carries a non-trivial cross-slice `dcn` axis and the
+        # config is DDP-shaped — then the shard_map below replaces the flat
+        # joint-axis psum with reduce-scatter(ICI) -> slab all-reduce(DCN,
+        # optionally PowerSGD-compressed) -> all-gather(ICI).
+        hier_engage, hier_why = self._resolve_hierarchical()
+        if hier_engage and has_aux:
+            hier_engage, hier_why = False, "has_aux"
+        dcn_size_mesh = int(self.mesh.shape.get("dcn", 1))
+        if self.grad_sync_kwargs.hierarchical and not hier_engage:
+            raise ValueError(
+                "GradSyncKwargs.hierarchical=True but the ICI->DCN path cannot "
+                f"engage: {hier_why}. The hierarchical reduction is the DDP "
+                "comm-hook shape: a dcn mesh axis > 1 plus pure data "
+                "parallelism with replicated params (sharding_strategy "
+                "NO_SHARD or SHARD_GRAD_OP), no cpu_offload, accumulation of "
+                "1, no fp16 scaling, no aux outputs, no comm_dtype/grad_dtype."
+            )
+        if dcn_codec and not hier_engage:
+            raise ValueError(
+                f"GradSyncKwargs.dcn_compression={dcn_codec!r} rides the "
+                f"hierarchical ICI->DCN path, which cannot engage: "
+                f"{hier_why or 'mesh has no dcn axis'}"
+            )
+        if not hier_engage and hier_why and dcn_size_mesh > 1:
+            logger.warning(
+                "mesh has a dcn axis (size %d) but the hierarchical gradient "
+                "sync cannot engage (%s): falling back to the flat joint-axis "
+                "reduction, whose cross-slice hop carries ici_size redundant "
+                "full-gradient copies over DCN", dcn_size_mesh, hier_why,
+            )
+        _hier_axes = tuple(self._compression_axes())
+        self._dcn_sync = {
+            "enabled": bool(hier_engage),
+            "dcn_size": dcn_size_mesh,
+            "ici_size": int(np.prod([self.mesh.shape[a] for a in _hier_axes
+                                     if a != "dcn"])) if _hier_axes else 1,
+            "compression": dcn_codec if hier_engage else None,
+            "why_not": None if hier_engage else hier_why,
+        }
         if compression == "powersgd":
             pc = self.parallelism_config
             bad = {k: v for k, v in
@@ -1342,6 +1454,103 @@ class Accelerator:
                     state.replace(rng=rng, comm_state=(new_qs, new_errs)), g_hat, loss
                 )
                 return new_state, metrics
+
+        elif hier_engage:
+            from .parallel.hierarchical import hierarchical_sync
+
+            psgd_rank = self.grad_sync_kwargs.rank
+            # trivial (size-1) axes are dropped from the collective calls:
+            # reducing over them is a no-op, and joint-axis reduce-scatter
+            # thunks carrying dead axes proved crash-prone on the CPU backend
+            hier_axes = tuple(a for a in _hier_axes
+                              if int(self.mesh.shape.get(a, 1)) > 1)
+            ici_axes = tuple(a for a in hier_axes if a != "dcn")
+            err_spec = PartitionSpec(hier_axes)
+            try:
+                from jax import shard_map as _shard_map
+
+                _no_check = {"check_vma": False}
+            except ImportError:  # older jax: check_vma was still check_rep
+                from jax.experimental.shard_map import shard_map as _shard_map
+
+                _no_check = {"check_rep": False}
+
+            def _hier_grads(params, mb, use_rng, qs, errs):
+                """Per-rank loss/grad + the three-phase reduction.  ``qs``/
+                ``errs`` are the DCN PowerSGD state (None trees = dense DCN
+                hop); returns world-MEAN grads like the flat pmean."""
+                def loss_only(p):
+                    p = policy.cast_to_compute(p)
+                    mb_args = (p, mb, use_rng) if wants_rng else (p, mb)
+                    return loss_fn(*mb_args).astype(jnp.float32)
+
+                loss, grads = jax.value_and_grad(loss_only)(params)
+                grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+                errs_local = jax.tree_util.tree_map(lambda e: e[0], errs)
+                g_hat, new_qs, new_errs = hierarchical_sync(
+                    grads, ici_axes, "dcn",
+                    qs=qs, errs=errs_local, rank=psgd_rank,
+                )
+                if grad_scale != 1:
+                    # sum semantics: the schedule reduces at mean scale (the
+                    # EF residual is self-consistent either way); the
+                    # optimizer sees the dp-sum like the dense path
+                    g_hat = jax.tree_util.tree_map(
+                        lambda g: g * jnp.asarray(grad_scale, g.dtype), g_hat
+                    )
+                new_errs = jax.tree_util.tree_map(lambda e: e[None], new_errs)
+                # loss averaged in the SAME two-stage order as the grads
+                # (ICI first, then the dcn hop): a flat joint-axis pmean
+                # leaves the reduction order to the backend, and the order
+                # differs between a single-process mesh and a launched gang
+                # — the one float-associativity leak in the bitwise
+                # process-count-parity contract
+                loss = jax.lax.pmean(loss, ici_axes) if ici_axes else loss
+                return jax.lax.pmean(loss, "dcn"), g_hat, new_qs, new_errs
+
+            if dcn_codec:
+
+                def step_fn(state: TrainState, batch):
+                    rng, use_rng = jax.random.split(state.rng)
+                    qs, errs = state.comm_state
+                    spec_of = self._default_batch_spec()
+                    batch_specs = jax.tree_util.tree_map(spec_of, batch)
+                    fn = _shard_map(
+                        _hier_grads, mesh=self.mesh,
+                        in_specs=(PartitionSpec(), batch_specs, PartitionSpec(),
+                                  PartitionSpec(), err_spec),
+                        out_specs=(PartitionSpec(), PartitionSpec(),
+                                   PartitionSpec(), err_spec),
+                        **_no_check,
+                    )
+                    loss, g_hat, new_qs, new_errs = fn(
+                        state.params, batch, use_rng, qs, errs
+                    )
+                    new_state, metrics = apply_update(
+                        state.replace(rng=rng, comm_state=(new_qs, new_errs)),
+                        g_hat, loss,
+                    )
+                    return new_state, metrics
+
+            else:
+
+                def _hier_dense(params, mb, use_rng):
+                    loss, g_hat, _, _ = _hier_grads(params, mb, use_rng, None, None)
+                    return loss, g_hat
+
+                def step_fn(state: TrainState, batch):
+                    rng, use_rng = jax.random.split(state.rng)
+                    spec_of = self._default_batch_spec()
+                    batch_specs = jax.tree_util.tree_map(spec_of, batch)
+                    fn = _shard_map(
+                        _hier_dense, mesh=self.mesh,
+                        in_specs=(PartitionSpec(), batch_specs, PartitionSpec()),
+                        out_specs=(PartitionSpec(), PartitionSpec()),
+                        **_no_check,
+                    )
+                    loss, g_hat = fn(state.params, batch, use_rng)
+                    new_state, metrics = apply_update(state.replace(rng=rng), g_hat, loss)
+                    return new_state, metrics
 
         elif mode == "in_step" and accum_steps > 1:
 
@@ -1521,10 +1730,14 @@ class Accelerator:
                 if bool(metrics["nan_skipped"]):
                     self.goodput.record_nan_skip()
                 _guard.check_abort(consecutive, guard_abort_after)
-            if self._preemption is not None and self._preemption.requested:
+            if self._preemption is not None and self._agreed_preemption():
                 # stop AT the step boundary: the post-step state is exactly
                 # consistent with the dataloader position and step counters,
-                # so the resumed run replays nothing and skips nothing
+                # so the resumed run replays nothing and skips nothing.
+                # Multi-process: the stop is AGREED (any-rank OR) so every
+                # rank reaches the emergency checkpoint's collectives — a
+                # single preempted rank exiting alone would deadlock the
+                # sharded save on its peers.
                 self._preemption_exit(new_state)
             return new_state, metrics
 
@@ -1532,6 +1745,35 @@ class Accelerator:
         wrapped._lint_report = None
         self._prepared_train_step = wrapped
         return wrapped
+
+    @property
+    def dcn_sync(self) -> Optional[dict]:
+        """How the last prepared train step resolved the ICI->DCN
+        hierarchical reduction (``None`` before ``prepare_train_step``):
+        ``{"enabled", "dcn_size", "ici_size", "compression", "why_not"}``."""
+        return getattr(self, "_dcn_sync", None)
+
+    def dcn_sync_accounting(self, params, step_compute_s: Optional[float] = None) -> dict:
+        """Predicted per-device DCN bytes for ``params``'s gradient sync on
+        this mesh (``parallel/hierarchical.dcn_comm_accounting``): the
+        hierarchical schedule vs the flat-reduce twin, with the PowerSGD
+        codec folded in when ``GradSyncKwargs.dcn_compression`` is set.
+        Zeros-clean on meshes without a ``dcn`` axis."""
+        from .parallel.hierarchical import dcn_comm_accounting
+
+        axes = self._compression_axes()
+        ici = int(np.prod([self.mesh.shape[a] for a in axes if a != "dcn"])) or 1
+        dcn = int(self.mesh.shape.get("dcn", 1))
+        sync = self.dcn_sync
+        compression = (
+            sync["compression"] if sync is not None
+            else self.grad_sync_kwargs.dcn_compression
+        )
+        return dcn_comm_accounting(
+            params, ici_size=ici, dcn_size=dcn,
+            compression=compression, rank=self.grad_sync_kwargs.rank,
+            step_compute_s=step_compute_s,
+        )
 
     @property
     def compile_events(self) -> int:
@@ -1901,6 +2143,29 @@ class Accelerator:
     def preemption_requested(self) -> bool:
         return self._preemption is not None and self._preemption.requested
 
+    def _agreed_preemption(self) -> bool:
+        """Cross-process agreement on the graceful stop: True when ANY rank's
+        handler saw the signal.  A cloud preemption notice lands on one host;
+        the whole gang must stop at the SAME step boundary because the
+        emergency checkpoint (and the next run's resume point) is a
+        collective.  A tiny host-blocking all-gather, only in multi-process
+        runs with the handler installed — throttled by
+        ``ResiliencePlugin.preemption_check_every`` for long runs (the
+        predicate must depend only on the lockstep ``step_count``, never on
+        the local flag: ranks disagreeing on whether to enter the
+        collective would deadlock the gang)."""
+        requested = self._preemption.requested
+        if self.num_processes <= 1:
+            return requested
+        every = max(1, int(getattr(self.resilience_plugin,
+                                   "preemption_check_every", 1)))
+        if self.step_count % every != 0:
+            return False
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.int32(bool(requested)))
+        return bool(np.asarray(flags).sum() > 0)
+
     def _preemption_exit(self, train_state=None):
         """The graceful-stop tail: drain the in-flight async save, write an
         emergency checkpoint of the boundary state through the verified
@@ -1934,6 +2199,17 @@ class Accelerator:
         finally:
             self.goodput.record_preemption()
         raise SystemExit(rp.resume_exit_code)
+
+    @property
+    def resume_requested(self) -> bool:
+        """True when this process was launched with ``accelerate_tpu launch
+        --resume`` (the elastic-resume signal, transported as
+        ``ACCELERATE_AUTO_RESUME``): the training script should call
+        :meth:`maybe_resume` before its first step — the newest verified
+        checkpoint then restores re-sharded onto THIS launch's mesh, which
+        may span a different process/chip count than the one that wrote
+        it."""
+        return parse_flag_from_env("ACCELERATE_AUTO_RESUME")
 
     def maybe_resume(self, train_state=None, **load_kwargs):
         """Auto-resume: restore the newest *valid* checkpoint under the

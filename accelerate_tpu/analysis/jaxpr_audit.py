@@ -31,6 +31,11 @@ invisible to a source-level linter:
   mirror: a ``dot_general`` whose result feeds exactly one
   ``reduce_scatter`` serializes the monolithic scatter behind the matmul
   that produced it (``ring_matmul_reduce_scatter`` is the decomposition).
+- **GL108 hierarchical-reduction hint** (info) — a large psum spanning the
+  ``dcn`` mesh axis jointly with intra-slice axes: the flat reduction's
+  cross-slice leg carries one redundant full-size copy per intra-slice
+  device over DCN; ``parallel/hierarchical.py`` is the decomposition
+  (reduce-scatter over ICI, slab all-reduce over dcn, all-gather back).
 - **GL304 donated promotion drift** — a donated input whose only same-shape
   outputs differ in dtype or weak_type (a python/numpy scalar promoted the
   update): feeding the result back re-keys the jit cache every step, and
@@ -436,6 +441,48 @@ def _audit_collective_matmul(closed) -> list[Finding]:
     return findings
 
 
+def _audit_hierarchical_reduce(closed, threshold: int) -> list[Finding]:
+    """GL108 (hint): a large all-reduce whose named axes span ``dcn``
+    JOINTLY with intra-slice axes.  A flat joint-axis psum decomposes (in
+    XLA or in the runtime) into per-axis reductions where the cross-slice
+    leg operates on the FULL operand for every intra-slice device — p
+    redundant full-size copies over the slow DCN link.  A psum over
+    ``('dcn',)`` alone stays quiet: that is the hierarchical path's own
+    slab hop (reduce-scatter first, then the dcn-only all-reduce).  Walks
+    sub-jaxprs (shard_map/pjit/scan) via :func:`iter_eqns`."""
+    findings = []
+    for eqn in iter_eqns(closed):
+        if eqn.primitive.name != "psum":
+            continue
+        axes = eqn.params.get("axes") or ()
+        if isinstance(axes, str):
+            axes = (axes,)
+        named = tuple(a for a in axes if isinstance(a, str))
+        if "dcn" not in named or len(named) < 2:
+            continue
+        nbytes = sum(
+            int(np.prod(v.aval.shape)) * v.aval.dtype.itemsize
+            for v in eqn.invars
+            if hasattr(v.aval, "shape") and hasattr(v.aval, "dtype")
+        )
+        if nbytes < threshold:
+            continue
+        path, line = _eqn_location(eqn)
+        ici = tuple(a for a in named if a != "dcn")
+        findings.append(
+            _finding(
+                "GL108",
+                f"psum of {nbytes / 2**20:.1f} MiB over joint axes {named}: "
+                f"the cross-slice leg moves one full-size copy per "
+                f"{'x'.join(ici)} device over DCN — a hierarchical-reduction "
+                "candidate (reduce-scatter over ICI, slab all-reduce over "
+                "dcn, all-gather back; parallel/hierarchical.py)",
+                path=path, line=line,
+            )
+        )
+    return findings
+
+
 def _audit_output_sharding(jaxpr, threshold: int, path_hint) -> list[Finding]:
     """GL105: large outputs whose producing equation is not a sharding pin."""
     producer = {}
@@ -481,6 +528,7 @@ def audit_traced(
     donated: Optional[list[bool]] = None,
     const_bytes_threshold: int = 1 << 20,
     output_bytes_threshold: int = 1 << 20,
+    dcn_reduce_bytes_threshold: int = 1 << 20,
     default_memory_kind: Optional[str] = None,
     path_hint: Optional[tuple] = None,
 ) -> Report:
@@ -517,6 +565,7 @@ def audit_traced(
     findings += _audit_transfers(closed.jaxpr, default_memory_kind)
     findings += _audit_key_reuse(closed)
     findings += _audit_collective_matmul(closed)
+    findings += _audit_hierarchical_reduce(closed, dcn_reduce_bytes_threshold)
     findings += _audit_output_sharding(closed.jaxpr, output_bytes_threshold, path_hint)
     return Report(apply_suppressions(findings))
 

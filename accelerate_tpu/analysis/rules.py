@@ -107,6 +107,19 @@ RULES: dict[str, Rule] = {
             "FullyShardedDataParallelPlugin.collective_matmul",
         ),
         Rule(
+            "GL108", "hierarchical-reduction-hint", Severity.INFO, "jaxpr",
+            "a large (>= 1 MiB per-device operand) all-reduce spanning the "
+            "`dcn` mesh axis JOINTLY with intra-slice axes — a flat "
+            "reduction whose cross-slice hop carries one redundant "
+            "full-size copy per intra-slice device over the slow DCN link "
+            "(a hint, not a defect: suppressible, and never fails a run)",
+            "decompose it hierarchically: reduce-scatter over the ICI axes, "
+            "all-reduce only the sharded slab over `dcn`, all-gather back "
+            "(parallel/hierarchical.py hierarchical_sync — the prepared "
+            "train step does this automatically when the mesh has a dcn "
+            "axis and GradSyncKwargs.hierarchical is not disabled)",
+        ),
+        Rule(
             "GL105", "unsharded-output", Severity.WARNING, "jaxpr",
             "a large output with no sharding constraint on its producer: "
             "GSPMD may resolve it fully replicated, costing a full copy of "

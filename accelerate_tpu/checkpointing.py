@@ -346,6 +346,29 @@ def _release_async_checkpointer(accelerator, ckptr) -> None:
     ckptr.close()
 
 
+def wait_for_published_checkpoint(final_dir, verify: bool = True,
+                                  timeout_s: float = 120.0,
+                                  poll_s: float = 0.05) -> None:
+    """The non-main-rank half of the rank-0-coordinated publish: block until
+    ``final_dir`` is visible — with its manifest when verification is on
+    (the manifest is written last, so its presence asserts the complete
+    publish).  The collective barrier after the rename orders the publish on
+    rank 0's node; on a shared filesystem the directory entry can become
+    visible to peer hosts a beat later, and a resume racing that window
+    would miss the newest checkpoint."""
+    import time
+
+    target = Path(final_dir) / CHECKPOINT_MANIFEST_NAME if verify else Path(final_dir)
+    deadline = time.monotonic() + timeout_s
+    while not target.exists():
+        if time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"checkpoint publish {final_dir} not visible after "
+                f"{timeout_s}s (waiting on {target.name if verify else 'directory'})"
+            )
+        time.sleep(poll_s)
+
+
 def wait_for_pending_checkpoint(accelerator) -> None:
     """Block until this process's in-flight ``async_save`` train-state write
     has committed.
@@ -641,6 +664,11 @@ def save_accelerator_state(
         if accelerator.is_main_process:
             _finalize_checkpoint(output_dir, final_dir, manifest=verify)
         accelerator.wait_for_everyone()
+        if not accelerator.is_main_process:
+            # rank-0-only publish: non-zero ranks confirm the manifest (the
+            # last-written file) is visible before reporting the save done —
+            # a resume launched the next instant must find these exact bytes
+            wait_for_published_checkpoint(final_dir, verify=verify)
     return str(final_dir)
 
 

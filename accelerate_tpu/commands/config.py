@@ -55,6 +55,9 @@ class LaunchConfig:
     # local spawner; crashed state is recovered via checkpoint-resume)
     max_restarts: int = 0
     # -- parallelism axes (PARALLELISM_CONFIG_* transport) -----------------
+    # dcn: cross-slice data parallelism (the explicit DCN outer mesh axis);
+    # auto-filled from slice metadata (MEGASCALE_NUM_SLICES) when left at 1
+    dcn_size: int = 1
     dp_replicate_size: int = 1
     dp_shard_size: int = -1  # -1: infer remainder at runtime
     cp_size: int = 1
@@ -173,6 +176,10 @@ def interactive_config() -> LaunchConfig:
         if cfg.num_machines > 1:
             cfg.main_process_ip = _ask("Coordinator (process-0) IP?", "127.0.0.1")
             cfg.main_process_port = _ask_pos_int("Coordinator port?", 29500)
+            cfg.dcn_size = _ask_pos_int(
+                "How many slices (cross-slice DCN data-parallel axis; 1 = "
+                "one slice / auto-discover)?", 1
+            )
     cfg.use_cpu = _ask("Force CPU (debug runs without an accelerator)?", False, bool)
     cfg.debug = _ask("Enable debug mode (collective shape verification)?", False, bool)
     cfg.mixed_precision = _ask_choice(
@@ -198,7 +205,7 @@ def interactive_config() -> LaunchConfig:
     # device count per host is unknown at config time, so divisibility is
     # re-validated by ParallelismConfig at launch; surface the product here
     model_axes = (cfg.tp_size * cfg.cp_size * cfg.sp_size * cfg.ep_size
-                  * cfg.pp_size * cfg.dp_replicate_size)
+                  * cfg.pp_size * cfg.dp_replicate_size * cfg.dcn_size)
     print(f"  (model-axis product: {model_axes}; dp_shard fills the remainder)")
 
     cfg.use_fsdp = _ask("Shard parameters/optimizer state (FSDP/ZeRO)?", True, bool)
@@ -217,8 +224,8 @@ def interactive_config() -> LaunchConfig:
         )
     cfg.dp_shard_size = -1 if cfg.use_fsdp else 1
     print(
-        "Mesh: dp_replicate=%d x dp_shard=%s x pp=%d x cp=%d x sp=%d x tp=%d x ep=%d"
-        % (cfg.dp_replicate_size,
+        "Mesh: dcn=%d x dp_replicate=%d x dp_shard=%s x pp=%d x cp=%d x sp=%d x tp=%d x ep=%d"
+        % (cfg.dcn_size, cfg.dp_replicate_size,
            "auto" if cfg.dp_shard_size == -1 else cfg.dp_shard_size,
            cfg.pp_size, cfg.cp_size, cfg.sp_size, cfg.tp_size, cfg.ep_size)
     )

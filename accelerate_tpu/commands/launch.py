@@ -28,9 +28,11 @@ import sys
 from .config import LaunchConfig, load_config_or_default
 from ..utils.launch import (
     apply_cpu_device_flags,
+    discover_slice_topology,
     prepare_multiprocess_env,
     prepare_simple_launcher_cmd_env,
     prepare_tpu_pod_env,
+    topology_summary,
 )
 
 from ..parallelism_config import AXIS_SIZE_FIELDS as _PARALLEL_FLAGS
@@ -57,6 +59,11 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--max_restarts", type=int, default=None,
                         help="Restart the whole local worker gang up to N times after a "
                              "crash (workers resume from their last checkpoint).")
+    parser.add_argument("--resume", action="store_true",
+                        help="Elastic resume: signal workers (ACCELERATE_AUTO_RESUME) to "
+                             "restore the newest verified checkpoint — onto THIS launch's "
+                             "process/chip topology, which may differ from the one that "
+                             "wrote it (the checkpoint re-shards onto the new mesh).")
     # execution
     parser.add_argument("--cpu", action="store_true", help="Force CPU platform (fake-mesh testing).")
     parser.add_argument("--mixed_precision", default=None, choices=MIXED_PRECISION_CHOICES)
@@ -108,6 +115,11 @@ def _merge_args_into_config(args, config: LaunchConfig) -> LaunchConfig:
         # rides the free-form env passthrough (config_env forwards it);
         # PartialState consumes it at init (reference state.py:314)
         config.env["ACCELERATE_CPU_AFFINITY"] = "1"
+    if getattr(args, "resume", False):
+        # elastic-resume signal: worker code (Accelerator.resume_requested /
+        # maybe_resume) restores the newest verified checkpoint, re-sharded
+        # onto whatever mesh THIS launch builds
+        config.env["ACCELERATE_AUTO_RESUME"] = "true"
     return config
 
 
@@ -196,7 +208,15 @@ def _spawn_local_workers(cmd, args, config) -> int:
 
 def launch_command(args) -> None:
     config = _merge_args_into_config(args, load_config_or_default(args.config_file))
+    # Slice metadata fills a dcn axis the operator left unspecified (flag >
+    # file > metadata): the workers' meshes then carry the explicit
+    # cross-slice outer axis the hierarchical gradient sync keys off.
+    slices = discover_slice_topology()
+    if slices is not None and config.dcn_size == 1 and getattr(args, "dcn_size", None) is None:
+        config.dcn_size = slices["num_slices"]
     _validate(config)
+    if config.num_processes > 1 or config.dcn_size > 1:
+        print(f"launch topology: {topology_summary(config)}", file=sys.stderr)
     cmd, env = prepare_simple_launcher_cmd_env(args, config)
 
     # Multi-host if requested by flag/rank OR described by the merged config

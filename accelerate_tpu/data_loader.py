@@ -426,6 +426,87 @@ class IterableDatasetShard:
             yield from buffer[start : start + process_batch_size]
 
 
+# ---------------------------------------------------------------------------
+# per-host batch sharding — which rows of the GLOBAL batch this process feeds
+# ---------------------------------------------------------------------------
+
+
+def batch_rows_by_device(mesh: Mesh, spec, shape) -> dict:
+    """``{device: (start, stop)}`` — the global-batch-dim row range each mesh
+    device owns under ``spec``.  Derived from the sharding itself (never
+    assumed from mesh order), so it stays correct for any axis layout the
+    partition spec names."""
+    sharding = NamedSharding(mesh, spec)
+    out = {}
+    for dev, idx in sharding.devices_indices_map(tuple(shape)).items():
+        s0 = idx[0] if idx else slice(None)
+        out[dev] = (
+            s0.start if s0.start is not None else 0,
+            s0.stop if s0.stop is not None else shape[0],
+        )
+    return out
+
+
+def _rows_union(ranges, what: str) -> tuple[int, int]:
+    """Union of per-device row ranges, verified to tile ONE contiguous block
+    (ranges may repeat — replication over non-batch axes — but a gap means
+    the process would have to feed disjoint slices, which
+    ``make_array_from_process_local_data`` cannot express)."""
+    start = min(r[0] for r in ranges)
+    stop = max(r[1] for r in ranges)
+    cursor = start
+    for s, e in sorted(set(ranges)):
+        if s > cursor:
+            raise ValueError(
+                f"{what} owns non-contiguous global-batch rows "
+                f"{sorted(set(ranges))}: the mesh's batch axes do not map "
+                "this process to one block — keep the data-parallel axes "
+                "(dcn, dp_replicate, dp_shard) outermost in the mesh order"
+            )
+        cursor = max(cursor, e)
+    return start, stop
+
+
+def process_local_rows(mesh: Mesh, spec, shape, process_index: Optional[int] = None) -> slice:
+    """The contiguous ``[start, stop)`` block of the global batch dimension
+    that ``process_index``'s addressable devices own under ``(mesh, spec)``.
+
+    This is the per-host dataloader-sharding contract: every launched
+    process reads the same deterministic global batch stream and feeds only
+    its own block — process-disjoint by construction, identical global
+    coverage at ANY process count (the launcher may re-shard the same mesh
+    over 1, 2 or N hosts and the union of blocks is always the full batch),
+    which is what makes mid-epoch resume exact across an elastic
+    process-count change."""
+    pid = jax.process_index() if process_index is None else process_index
+    ranges = [
+        r for dev, r in batch_rows_by_device(mesh, spec, shape).items()
+        if dev.process_index == pid
+    ]
+    if not ranges:
+        raise ValueError(f"process {pid} owns no devices of mesh {mesh}")
+    start, stop = _rows_union(ranges, f"process {pid}")
+    return slice(start, stop)
+
+
+def shard_global_batch(batch, mesh: Mesh, spec):
+    """Slice this process's rows out of a host-replicated GLOBAL batch and
+    assemble the global sharded ``jax.Array`` (explicit ``global_shape`` —
+    nothing inferred).  The single-process case degenerates to the whole
+    batch, so a stream consumed this way is bit-identical at any process
+    count."""
+
+    def _make(x):
+        x = np.asarray(x)
+        s = NamedSharding(mesh, spec(x) if callable(spec) else spec)
+        rows = process_local_rows(mesh, s.spec, x.shape)
+        return jax.make_array_from_process_local_data(
+            s, np.ascontiguousarray(x[rows.start:rows.stop]), tuple(x.shape)
+        )
+
+    return recursively_apply(_make, batch, error_on_other_type=True)
+
+
 class DataLoaderStateMixin:
     """end-of-dataloader / remainder signaling into ``GradientState``
     (reference data_loader.py:365-405)."""
@@ -475,12 +556,20 @@ class DataLoaderShard(DataLoaderStateMixin):
         _loader_batch_size: Optional[int] = None,
         transfer_retry_policy=None,
         on_transfer_retry=None,
+        shard_across_processes: bool = False,
     ):
         self.inner = inner
         self.device = device
         self.mesh = mesh
         self.batch_spec = batch_spec
         self.even_batches = even_batches
+        # per-host sharding (multi-process launch): the inner iterable yields
+        # the same deterministic GLOBAL batch on every process and each host
+        # feeds only its sharding-derived contiguous block
+        # (process_local_rows) — process-disjoint coverage, resume positions
+        # counted in global batches so a checkpoint restores exactly at any
+        # process count
+        self.shard_across_processes = shard_across_processes
         self.rng_types = rng_types
         self.synchronized_generator = synchronized_generator
         self.skip_batches = skip_batches
@@ -535,6 +624,8 @@ class DataLoaderShard(DataLoaderStateMixin):
                 # injected-fault hook + bounded retry: a transient H2D
                 # staging failure costs a backoff, not the training run
                 maybe_fail_transfer("transfer")
+                if self.shard_across_processes:
+                    return shard_global_batch(batch, self.mesh, self.batch_spec)
                 return host_local_to_global(batch, self.mesh, self.batch_spec)
 
             return with_retries(_place, site="dataloader-h2d",
@@ -844,6 +935,7 @@ def prepare_data_loader(
     prefetch_size: int = 0,
     transfer_retry_policy=None,
     on_transfer_retry=None,
+    shard_across_processes: Optional[bool] = None,
 ):
     """Re-wrap a dataloader (torch DataLoader or any batch iterable) for
     per-rank sharding + global-array device placement.
@@ -852,6 +944,15 @@ def prepare_data_loader(
     process grid used for sharding is the **data-parallel** sub-grid — TP/CP/
     SP ranks are collapsed so they receive identical data
     (``process_index //= non_data_parallel_size``, reference :1109-1145).
+
+    ``shard_across_processes`` (default auto) is the multi-process contract
+    for plain batch iterables: torch loaders shard at the sampler
+    (``BatchSamplerShard`` — each process READS only its share), while a
+    generic iterable is treated as the same deterministic GLOBAL stream on
+    every process and each host feeds only its sharding-derived block
+    (:func:`process_local_rows`) — process-disjoint, and exact to resume at
+    a different process count because positions are counted in global
+    batches.
     """
     state = PartialState()
     num_processes = num_processes if num_processes is not None else state.num_processes
@@ -894,6 +995,31 @@ def prepare_data_loader(
     synchronized_generator = None
     inner = dataloader
     loader_batch_size = getattr(dataloader, "batch_size", None)
+
+    if shard_across_processes is None:
+        shard_across_processes = (
+            not _is_torch_loader(dataloader)
+            and state.num_processes > 1
+            and put_on_device
+            and mesh is not None
+            and batch_spec is not None
+        )
+        if shard_across_processes:
+            # say it once, loudly: this flips the iterable's multi-process
+            # contract from "each process yields its LOCAL shard" to "every
+            # process yields the same GLOBAL batch and feeds only its
+            # sharding-derived block".  Pipelines that genuinely produce
+            # per-process shards must pass shard_across_processes=False.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "multi-process launch: treating the prepared iterable as the "
+                "same deterministic GLOBAL batch stream on every process — "
+                "each host feeds only its sharding-derived row block "
+                "(process-disjoint, resume-exact at any process count). "
+                "Pass shard_across_processes=False if the iterable yields "
+                "per-process local shards instead."
+            )
 
     if _is_torch_loader(dataloader):
         import torch.utils.data
@@ -955,6 +1081,7 @@ def prepare_data_loader(
         _loader_batch_size=loader_batch_size,
         transfer_retry_policy=transfer_retry_policy,
         on_transfer_retry=on_transfer_retry,
+        shard_across_processes=bool(shard_across_processes and not _is_torch_loader(dataloader)),
     )
 
 

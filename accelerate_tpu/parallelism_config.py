@@ -34,7 +34,18 @@ from jax.sharding import Mesh, PartitionSpec
 # sits next to ``dp_replicate`` at the outside: stage hand-offs are infrequent
 # point-to-point transfers, so like replicate traffic they can ride DCN while
 # dp_shard/cp/sp/tp stay on ICI.
-MESH_AXIS_ORDER = ("dp_replicate", "pp", "dp_shard", "cp", "sp", "tp", "ep")
+#
+# ``dcn`` is the OUTERMOST axis: the explicit cross-slice data-parallel
+# dimension of a multi-host/multi-slice launch (`accelerate_tpu launch`).
+# Devices that differ only in their dcn coordinate sit in different slices —
+# traffic across it rides the datacenter network, not ICI.  The hierarchical
+# gradient-sync path (parallel/hierarchical.py) keys off this axis name:
+# reduce-scatter inside the slice over ICI, one cross-slice all-reduce of the
+# sharded slab over DCN, all-gather back.  ``dcn`` is pure data parallelism
+# like ``dp_replicate`` (params replicate across it, batch shards over it);
+# the distinct name exists so the launcher, the mesh, the sync path and the
+# accounting twins all agree on which hops are expensive.
+MESH_AXIS_ORDER = ("dcn", "dp_replicate", "pp", "dp_shard", "cp", "sp", "tp", "ep")
 
 # The per-axis size fields / env vars are derived from the axis list so a new
 # axis cannot silently miss one of the transport surfaces (launcher flags,
@@ -52,6 +63,7 @@ class ParallelismConfig:
     (reference :120-130 behavior).
     """
 
+    dcn_size: int = 1
     dp_replicate_size: int = 1
     dp_shard_size: int = 1
     cp_size: int = 1
@@ -83,6 +95,7 @@ class ParallelismConfig:
 
     def _sizes(self) -> dict[str, int]:
         return {
+            "dcn": self.dcn_size,
             "dp_replicate": self.dp_replicate_size,
             "dp_shard": self.dp_shard_size,
             "cp": self.cp_size,
@@ -109,13 +122,19 @@ class ParallelismConfig:
 
     @property
     def data_parallel_size(self) -> int:
-        return self.dp_replicate_size * self.dp_shard_size
+        return self.dcn_size * self.dp_replicate_size * self.dp_shard_size
+
+    @property
+    def has_dcn(self) -> bool:
+        """True when the mesh carries a non-trivial cross-slice axis — the
+        trigger for the hierarchical ICI→DCN gradient-sync path."""
+        return self.dcn_size > 1
 
     # -- joint dims as PartitionSpec tuples (reference flattened mesh dims) --
 
     @property
     def dp_dim_names(self) -> tuple[str, ...]:
-        return self._enabled(("dp_replicate", "dp_shard"))
+        return self._enabled(("dcn", "dp_replicate", "dp_shard"))
 
     @property
     def dp_shard_cp_dim_names(self) -> tuple[str, ...]:
@@ -125,7 +144,7 @@ class ParallelismConfig:
     @property
     def dp_cp_dim_names(self) -> tuple[str, ...]:
         """Loss-averaging dims (reference ``dp_cp`` :146-155)."""
-        return self._enabled(("dp_replicate", "dp_shard", "cp"))
+        return self._enabled(("dcn", "dp_replicate", "dp_shard", "cp"))
 
     @property
     def fsdp_dim_names(self) -> tuple[str, ...]:
@@ -135,8 +154,10 @@ class ParallelismConfig:
 
     @property
     def batch_dim_names(self) -> tuple[str, ...]:
-        """Axes the batch dimension of input data shards over."""
-        return self._enabled(("dp_replicate", "dp_shard"))
+        """Axes the batch dimension of input data shards over.  ``dcn`` is
+        outermost so each slice's hosts feed a contiguous block of the
+        global batch (the per-host dataloader sharding contract)."""
+        return self._enabled(("dcn", "dp_replicate", "dp_shard"))
 
     @property
     def seq_dim_names(self) -> tuple[str, ...]:
@@ -166,8 +187,8 @@ class ParallelismConfig:
             raise ValueError("cp_size and sp_size cannot both be > 1 (pick ring CP or Ulysses SP)")
         if self.dp_shard_size == -1:
             rest = (
-                self.dp_replicate_size * self.cp_size * self.sp_size * self.tp_size
-                * self.ep_size * self.pp_size
+                self.dcn_size * self.dp_replicate_size * self.cp_size * self.sp_size
+                * self.tp_size * self.ep_size * self.pp_size
             )
             if num_devices % rest != 0:
                 raise ValueError(

@@ -69,6 +69,19 @@ def _maybe_init_jax_distributed(kwargs: Optional[InitProcessGroupKwargs]) -> Non
         process_id = None if process_id < 0 else process_id
     if coordinator is None:
         return
+    # CPU gangs need an explicit collectives backend: without it the CPU
+    # backend REJECTS any cross-process computation ("Multiprocess
+    # computations aren't implemented on the CPU backend"), which silently
+    # reduced every `launch --cpu` gang to collectives-free scripts.  Gloo
+    # ships in jaxlib; set it BEFORE initialize (it is read at client
+    # construction).  ACCELERATE_CPU_COLLECTIVES overrides ("none" opts
+    # out); harmless on TPU, where the TPU backend owns the collectives.
+    impl = os.environ.get("ACCELERATE_CPU_COLLECTIVES", "gloo")
+    if impl and impl != "none":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except (AttributeError, ValueError):  # jax without the knob/impl
+            pass
     init_kwargs: dict[str, Any] = {"coordinator_address": coordinator}
     if num_processes is not None:
         init_kwargs["num_processes"] = num_processes

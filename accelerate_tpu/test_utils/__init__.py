@@ -28,3 +28,10 @@ def test_script_path() -> Path:
     """Path to the bundled end-to-end sanity script run by
     ``accelerate-tpu test`` (reference test_utils/scripts/test_script.py)."""
     return Path(__file__).parent / "scripts" / "test_script.py"
+
+
+def launch_parity_script_path() -> Path:
+    """Path to the multi-host launch parity / elastic-resume worker script
+    (hierarchical ICI->DCN sync over a real ``accelerate_tpu launch`` gang;
+    consumed by __graft_entry__._launch_leg and tests/test_launch.py)."""
+    return Path(__file__).parent / "scripts" / "launch_parity.py"

@@ -1,0 +1,134 @@
+"""Worker script for the multi-host launch parity / elastic-resume legs.
+
+Launched by ``accelerate_tpu launch`` (any process count).  Trains a small
+MLP on a deterministic global batch stream over a ``dcn x dp_shard`` mesh
+with the hierarchical ICI->DCN gradient sync, and prints the per-step loss
+trajectory as one JSON line (rank 0) — the callers (__graft_entry__
+``_launch_leg``, tests/test_launch.py) pin that trajectory bitwise across:
+
+- process counts (2-proc x 2-dev vs 1-proc x 4-dev virtual mesh: SAME
+  global mesh, so the compiled program — and therefore every float — is
+  identical; the per-host dataloader sharding feeds each process its
+  sharding-derived block of the same global stream);
+- a preemption boundary (SIGTERM injected on ONE rank mid-run -> agreed
+  stop -> emergency checkpoint -> exit 75 -> ``launch --resume`` onto a
+  different process count continues the trajectory exactly).
+
+Env contract (all optional):
+  LAUNCH_LEG_DIR         project dir for checkpoints (enables resume)
+  LAUNCH_LEG_STEPS       total steps to train (default 6)
+  LAUNCH_LEG_DCN         dcn axis size (default 2)
+  LAUNCH_LEG_COMPRESS    "1" -> PowerSGD on the DCN hop
+  LAUNCH_LEG_PREEMPT_AT  1-based step call on which rank 1 (or rank 0 in a
+                         single-process run) receives a real SIGTERM
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.utils.dataclasses import (
+        FullyShardedDataParallelPlugin,
+        GradSyncKwargs,
+        ProjectConfiguration,
+        ResiliencePlugin,
+        ShardingStrategy,
+    )
+
+    steps = int(os.environ.get("LAUNCH_LEG_STEPS", "6"))
+    work = os.environ.get("LAUNCH_LEG_DIR")
+    dcn = int(os.environ.get("LAUNCH_LEG_DCN", "2"))
+    compress = os.environ.get("LAUNCH_LEG_COMPRESS") == "1"
+    preempt_at = os.environ.get("LAUNCH_LEG_PREEMPT_AT")
+
+    handlers = [GradSyncKwargs(dcn_compression="powersgd", rank=2)] if compress else []
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dcn_size=dcn, dp_shard_size=-1),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            sharding_strategy=ShardingStrategy.NO_SHARD
+        ),
+        kwargs_handlers=handlers,
+        resilience_plugin=ResiliencePlugin(handle_preemption=True),
+        project_config=(
+            ProjectConfiguration(project_dir=work, automatic_checkpoint_naming=True)
+            if work else None
+        ),
+    )
+    sync = None
+
+    def loss_fn(p, b):
+        h = jax.nn.relu(b["x"] @ p["w1"])
+        return jnp.mean(((h @ p["w2"])[:, 0] - b["y"]) ** 2)
+
+    # deterministic GLOBAL stream — identical on every process; the prepared
+    # dataloader feeds each host only its sharding-derived block
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(8,)).astype(np.float32)
+    batches = []
+    for _ in range(steps):
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        batches.append({"x": x, "y": (x @ w_true).astype(np.float32)})
+
+    def source():
+        for b in batches:
+            yield b
+
+    dl = acc.prepare_data_loader(source())
+
+    k1, k2 = jax.random.split(jax.random.key(0))
+    params = {
+        "w1": np.asarray(jax.random.normal(k1, (8, 16))) * 0.3,
+        "w2": np.asarray(jax.random.normal(k2, (16, 1))) * 0.3,
+    }
+    state = acc.create_train_state(params, optax.sgd(0.05))
+    step = acc.prepare_train_step(loss_fn)
+    sync = acc.dcn_sync
+    assert sync and sync["enabled"], f"hierarchical sync did not engage: {sync}"
+
+    if acc.resume_requested:
+        restored = acc.maybe_resume(train_state=state)
+        if restored is not None:
+            state = restored
+    start = acc.step_count
+
+    if preempt_at is not None:
+        victim = 1 if acc.num_processes > 1 else 0
+        if acc.process_index == victim:
+            from accelerate_tpu.resilience import FaultEvent, FaultPlan
+            from accelerate_tpu.resilience.faults import install_fault_plan
+
+            install_fault_plan(FaultPlan([
+                FaultEvent("preempt", at=int(preempt_at) - start)
+            ]))
+
+    losses = []
+    for batch in dl:
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+
+    if acc.is_main_process:
+        print(json.dumps({
+            "start": start,
+            "losses": losses,
+            "num_processes": acc.num_processes,
+            "dcn_sync": {k: sync[k] for k in ("enabled", "dcn_size", "ici_size",
+                                              "compression")},
+        }))
+    acc.end_training()
+    from accelerate_tpu import PartialState
+
+    PartialState().destroy_process_group()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,13 +56,38 @@ def _cache_root(root: Optional[str] = None) -> Path:
     ))
 
 
+def _process_scope() -> str:
+    """The multi-process scope component: launched workers never share a
+    cache directory (concurrent jax processes corrupt a shared cache — the
+    documented flake the scoped dirs retired, which a 2-process
+    ``accelerate_tpu launch`` would otherwise reintroduce).
+
+    Keyed by the launcher's ``ACCELERATE_PROCESS_ID`` env when present —
+    reading ``jax.process_index()`` here would *initialize* the backend and
+    make the worker's later ``jax.distributed.initialize`` impossible, so
+    jax is only consulted when the distributed runtime is already up
+    (state.py has initialized it)."""
+    pid = os.environ.get("ACCELERATE_PROCESS_ID")
+    if pid is not None:
+        return f"proc{pid}"
+    from ..state import _jax_distributed_initialized
+
+    if _jax_distributed_initialized:
+        import jax
+
+        if jax.process_count() > 1:
+            return f"proc{jax.process_index()}"
+    return ""
+
+
 def scoped_cache_dir(tag: str = "run", root: Optional[str] = None) -> str:
-    """The scoped cache directory for this (toolchain, tag, scope) — created
-    if missing, returned as a string path."""
+    """The scoped cache directory for this (toolchain, tag, scope, process)
+    — created if missing, returned as a string path."""
     scope = os.environ.get("ACCELERATE_JAX_CACHE_SCOPE") or os.environ.get(
         "PYTEST_XDIST_WORKER", ""
     )
-    leaf = f"{tag}-{scope}" if scope else tag
+    proc = _process_scope()
+    leaf = "-".join(part for part in (tag, scope, proc) if part)
     path = _cache_root(root) / toolchain_version_key() / leaf
     path.mkdir(parents=True, exist_ok=True)
     return str(path)

@@ -179,6 +179,22 @@ class GradSyncKwargs(KwargsHandler):
     # n*rank floats, the Q psum m*rank — matching wire_bytes_report).
     compression: Optional[str] = None
     rank: int = 4
+    # Hierarchical ICI->DCN reduction (parallel/hierarchical.py) for meshes
+    # with a non-trivial `dcn` (cross-slice) axis: reduce-scatter inside the
+    # slice over ICI, all-reduce only the sharded slab over DCN, all-gather
+    # back — replacing the flat joint-axis psum whose DCN hop would carry
+    # ici_size redundant full-gradient copies.  None = auto (engage when the
+    # mesh has dcn > 1 and the config is compatible: pure data parallelism
+    # with replicated params, like `compression`); False = never (flat psum
+    # even across slices); True = require (raise on incompatible configs
+    # instead of falling back).
+    hierarchical: Optional[bool] = None
+    # "powersgd": compress the hierarchical path's cross-slice (DCN) hop —
+    # each device's slab crosses as its rank-`rank` factors with per-device
+    # error feedback.  Requires the hierarchical path (dcn axis present and
+    # not disabled); ICI legs stay uncompressed (they are ~7x cheaper per
+    # byte, and the EF residual would have to survive two codecs).
+    dcn_compression: Optional[str] = None
 
 
 @dataclass
@@ -410,6 +426,15 @@ class ResiliencePlugin(KwargsHandler):
                                             # ACCELERATE_PREEMPTION, else
                                             # ACCELERATE_RESILIENCE.
     preemption_signals: tuple = ("SIGTERM",)
+    preemption_check_every: int = 1         # multi-process: agree the any-rank
+                                            # stop via a tiny host-blocking
+                                            # all-gather every N steps.  1 =
+                                            # stop at the very next boundary;
+                                            # raise it on long runs to keep
+                                            # the step pipeline async (the
+                                            # stop then lands within N steps
+                                            # of the notice — budget against
+                                            # the preemption grace window).
     emergency_checkpoint: bool = True       # write a checkpoint at the stop
                                             # boundary before exiting
     resume_exit_code: int = 75              # EX_TEMPFAIL: "re-run me" — what

@@ -99,6 +99,44 @@ def prepare_multiprocess_env(args, config, process_id: int) -> dict[str, str]:
     return env
 
 
+def discover_slice_topology() -> Optional[dict[str, int]]:
+    """Slice-level topology from the multi-slice runtime metadata env, if
+    present: ``{"num_slices": N, "slice_id": i}``.
+
+    On Cloud TPU multislice the MegaScale runtime exports
+    ``MEGASCALE_NUM_SLICES`` / ``MEGASCALE_SLICE_ID`` on every host; a
+    single-slice pod (or a laptop) has neither and returns ``None``.  The
+    launcher uses this to auto-fill ``ParallelismConfig.dcn_size`` — the
+    explicit cross-slice mesh axis the hierarchical gradient-sync path keys
+    off — when the operator left it unspecified."""
+    num = os.environ.get("MEGASCALE_NUM_SLICES")
+    if num is None:
+        return None
+    try:
+        num_slices = int(num)
+    except ValueError:
+        return None
+    if num_slices < 2:
+        return None
+    slice_id = os.environ.get("MEGASCALE_SLICE_ID")
+    return {
+        "num_slices": num_slices,
+        "slice_id": int(slice_id) if slice_id is not None else 0,
+    }
+
+
+def topology_summary(config) -> str:
+    """One-line slice×host topology description for launch-time logging."""
+    hosts = config.num_processes
+    slices = getattr(config, "dcn_size", 1) or 1
+    if slices > 1:
+        return (
+            f"{slices} slices x {max(hosts // slices, 1)} hosts/slice "
+            f"({hosts} processes; dcn axis size {slices})"
+        )
+    return f"1 slice x {hosts} host{'s' if hosts != 1 else ''}"
+
+
 def prepare_tpu_pod_env(args, config) -> Optional[dict[str, str]]:
     """Auto-derive multi-host topology from TPU pod metadata env, if present
     (reference ``prepare_tpu`` utils/launch.py:586 — but env-derived rather
@@ -113,6 +151,13 @@ def prepare_tpu_pod_env(args, config) -> Optional[dict[str, str]]:
     config.machine_rank = int(worker_id)
     config.main_process_ip = hosts[0]
     config.main_process_port = config.main_process_port or 8476  # TPU runtime default port range
+    # Multi-slice metadata fills the dcn axis the operator left unspecified:
+    # the worker's ParallelismConfig.from_env then builds the mesh with the
+    # explicit cross-slice outer axis (flag > file > metadata precedence —
+    # an explicit dcn_size is never overwritten).
+    slices = discover_slice_topology()
+    if slices is not None and getattr(config, "dcn_size", 1) == 1:
+        config.dcn_size = slices["num_slices"]
     env = _base_env(args, config)
     env["ACCELERATE_COORDINATOR_ADDRESS"] = f"{config.main_process_ip}:{config.main_process_port}"
     env["ACCELERATE_NUM_PROCESSES"] = str(config.num_processes)

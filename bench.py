@@ -605,6 +605,17 @@ def main():
                          "k-1's outputs write back while chunk k updates). 'off' "
                          "restores the fully serialized schedule — the A/B "
                          "baseline for the overlap accounting")
+    ap.add_argument("--dcn-slices", type=int, default=1, metavar="N",
+                    help="simulate an N-slice topology: the mesh gets an explicit "
+                         "dcn outer axis of size N (devices split N x dp_shard, "
+                         "params replicated across slices) and the hierarchical "
+                         "ICI->DCN gradient sync engages "
+                         "(parallel/hierarchical.py)")
+    ap.add_argument("--dcn-compress", choices=["on", "off"], default="off",
+                    help="PowerSGD-compress the cross-slice (DCN) hop of the "
+                         "hierarchical gradient sync "
+                         "(GradSyncKwargs.dcn_compression='powersgd'); needs "
+                         "--dcn-slices > 1")
     ap.add_argument("--collective-matmul", choices=["on", "off", "bidir"], default="off",
                     help="ring collective-matmul for the TP/SP hot path "
                          "(ops/collective_matmul.py): decompose the monolithic "
@@ -880,13 +891,44 @@ def main():
     # it is a straight step-time win (63.1% vs 62.5% MFU measured, batch
     # 10) from halved grad-tree HBM traffic.  fp16 needs fp32 unscaling,
     # and the CPU smoke mode keeps plain fp32 grads.
-    if args.grad_dtype != "fp32" and args.precision == "bf16" and on_tpu:
+    dcn_slices = max(1, args.dcn_slices)
+    if args.grad_dtype != "fp32" and args.precision == "bf16" and on_tpu \
+            and dcn_slices <= 1:
+        # (skipped under --dcn-slices: the hierarchical sync reduces in fp32
+        # — a grad_dtype knob would be silently ignored, so don't set one)
         from accelerate_tpu.utils.dataclasses import GradSyncKwargs
 
         handlers.append(GradSyncKwargs(grad_dtype="bf16"))
         extra_report["grad_dtype"] = "bf16"
+    if dcn_slices > 1:
+        # simulated multi-slice: dcn outer axis, params replicated across
+        # slices (NO_SHARD — the hierarchical path is the DDP comm-hook
+        # shape), dp_shard as the intra-slice ICI plane
+        if n_dev % dcn_slices:
+            raise SystemExit(
+                f"--dcn-slices {dcn_slices} does not divide {n_dev} devices"
+            )
+        if args.offload:
+            raise SystemExit("--dcn-slices is incompatible with --offload "
+                             "(the hierarchical sync needs resident replicated params)")
+        from accelerate_tpu.utils.dataclasses import (
+            FullyShardedDataParallelPlugin, GradSyncKwargs, ShardingStrategy,
+        )
+
+        fsdp_plugin = FullyShardedDataParallelPlugin(
+            sharding_strategy=ShardingStrategy.NO_SHARD
+        )
+        pcfg = ParallelismConfig(dcn_size=dcn_slices,
+                                 dp_shard_size=n_dev // dcn_slices)
+        if args.dcn_compress == "on":
+            handlers.append(GradSyncKwargs(dcn_compression="powersgd"))
+    else:
+        if args.dcn_compress == "on":
+            raise SystemExit("--dcn-compress on needs --dcn-slices > 1 "
+                             "(no dcn mesh axis, nothing crosses DCN)")
+        pcfg = ParallelismConfig(dp_shard_size=n_dev)
     acc = Accelerator(
-        parallelism_config=ParallelismConfig(dp_shard_size=n_dev),
+        parallelism_config=pcfg,
         mixed_precision=args.precision,
         fsdp_plugin=fsdp_plugin,
         kwargs_handlers=handlers,
@@ -1125,6 +1167,38 @@ def main():
         extra_report["tp_comm"] = tp_comm
     overlap_fields["tp_overlap_frac"] = tp_overlap
     extra_report["collective_matmul"] = cm_mode
+
+    # DCN plane: cross-slice gradient-sync accounting — dcn_bytes /
+    # dcn_bytes_flat / dcn_overlap_frac are ALWAYS emitted (zeros on meshes
+    # without a dcn axis) so BENCH_*.json tracks the multi-slice fields
+    # across rounds.  dcn_bytes is the per-device cross-slice wire cost of
+    # the path the step actually compiled (hierarchical slab — PowerSGD
+    # factors under --dcn-compress on — or the flat fallback);
+    # dcn_bytes_flat is the flat-reduce twin the hierarchical schedule is
+    # judged against (parallel/hierarchical.dcn_comm_accounting).
+    from accelerate_tpu.parallel.hierarchical import dcn_comm_accounting
+
+    dcn_sync = acc.dcn_sync or {}
+    step_s = dt / iters
+    dcn_acct = acc.dcn_sync_accounting(state.params, step_compute_s=step_s)
+    if dcn_sync.get("enabled"):
+        dcn_bytes, dcn_overlap = dcn_acct["dcn_bytes"], dcn_acct["dcn_overlap_frac"]
+    else:
+        # flat path (no dcn axis, or hierarchical fell back): the active
+        # schedule's DCN bytes ARE the flat bytes (ici_size=1 degenerates
+        # the slab model to the full tree; zeros when dcn_size == 1)
+        flat_acct = dcn_comm_accounting(
+            state.params, ici_size=1, dcn_size=dcn_acct["dcn_size"],
+            step_compute_s=step_s,
+        )
+        dcn_bytes, dcn_overlap = flat_acct["dcn_bytes"], flat_acct["dcn_overlap_frac"]
+    overlap_fields["dcn_bytes"] = dcn_bytes
+    overlap_fields["dcn_bytes_flat"] = dcn_acct["dcn_bytes_flat"]
+    overlap_fields["dcn_overlap_frac"] = dcn_overlap
+    extra_report["dcn_comm"] = {
+        **dcn_acct, "hierarchical": bool(dcn_sync.get("enabled")),
+        "fallback_reason": dcn_sync.get("why_not"),
+    }
 
     # Resilience accounting — nan_skips/restarts/goodput_frac are ALWAYS
     # emitted so BENCH_*.json tracks fault handling across rounds: a clean

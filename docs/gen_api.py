@@ -83,6 +83,11 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "quantize_params", "quantized_apply",
     ]),
     "powersgd": ("accelerate_tpu.parallel.powersgd", None),
+    "hierarchical": ("accelerate_tpu.parallel.hierarchical", [
+        "hierarchical_sync", "init_dcn_powersgd_state", "slab_geometry",
+        "slab_eligible", "dcn_comm_accounting", "measure_dcn_bytes",
+        "ring_reduce_factor",
+    ]),
     "streaming": ("accelerate_tpu.ops.streaming", [
         "StreamStats", "LayerPrefetcher", "chunk_groups", "slice_congruent",
         "merge_congruent", "stage_put", "tree_bytes", "predicted_overlap",

@@ -112,6 +112,32 @@ def collective_matmul_rs_hint_step(x, w):
                       out_specs=P(None, "x", None), **_no_check)(x, w)
 
 
+def flat_dcn_reduce_step(g):
+    """GL108 (hint): a >= 1 MiB gradient psum over the JOINT ('dcn',
+    'dp_shard') axes — the flat reduction whose cross-slice leg moves one
+    full-size copy per intra-slice device over the slow DCN link.  The
+    hierarchical decomposition (clean twin) reduce-scatters over ICI first
+    so only the 1/p slab crosses dcn."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+
+        _no_check = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        _no_check = {"check_rep": False}
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ("dcn", "dp_shard"))
+
+    def body(gl):
+        return jax.lax.psum(gl[0], ("dcn", "dp_shard"))
+
+    return _shard_map(body, mesh=mesh, in_specs=P(("dcn", "dp_shard")),
+                      out_specs=P(None, None), **_no_check)(g)
+
+
 def example_args():
     """Concrete example inputs for each planted function (tiny; tracing
     only reads shapes/dtypes)."""
@@ -124,4 +150,7 @@ def example_args():
         "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
         "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
         "collective_matmul_rs_hint_step": (jnp.ones((1, 8, 16)), jnp.ones((16, 4))),
+        # per-device operand after the leading world-axis index: 520*520*4
+        # ≈ 1.03 MiB — above the 1 MiB GL108 threshold
+        "flat_dcn_reduce_step": (jax.ShapeDtypeStruct((4, 520, 520), jnp.float32),),
     }

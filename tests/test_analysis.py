@@ -116,9 +116,9 @@ def test_report_counts_exit_code_and_json():
 def test_every_emitted_rule_is_in_the_catalog():
     # all three engines draw severities/hints from rules.RULES; ids must resolve
     for rule_id in ("GL001", "GL002", "GL101", "GL102", "GL103", "GL104",
-                    "GL105", "GL106", "GL107", "GL201", "GL202", "GL203",
-                    "GL204", "GL205", "GL301", "GL302", "GL303", "GL304",
-                    "GL305", "GL306"):
+                    "GL105", "GL106", "GL107", "GL108", "GL201", "GL202",
+                    "GL203", "GL204", "GL205", "GL301", "GL302", "GL303",
+                    "GL304", "GL305", "GL306"):
         assert rule_id in RULES
         assert RULES[rule_id].summary and RULES[rule_id].fix_hint
 
@@ -136,6 +136,7 @@ _JAXPR_CASES = [
     ("unsharded_output_step", "GL105", {}),
     ("collective_matmul_hint_step", "GL106", {}),
     ("collective_matmul_rs_hint_step", "GL107", {}),
+    ("flat_dcn_reduce_step", "GL108", {}),
 ]
 
 
@@ -197,6 +198,40 @@ def test_gl107_hint_severity_matches_gl106():
     hints = [f for f in rep.findings if f.rule == "GL107"]
     assert hints and all(f.severity == Severity.INFO for f in hints)
     assert rep.exit_code() == 0
+
+
+def test_gl108_hint_severity_and_slab_hop_quiet():
+    # GL108 is a hint like GL106/107: INFO severity, never fails a run —
+    # and a psum over ('dcn',) ALONE (the hierarchical path's own slab hop)
+    # must stay quiet even above the size threshold
+    mod = _load_fixture("planted_jaxpr")
+    fname = "flat_dcn_reduce_step"
+    rep = audit_fn(getattr(mod, fname), *mod.example_args()[fname])
+    hints = [f for f in rep.findings if f.rule == "GL108"]
+    assert hints and all(f.severity == Severity.INFO for f in hints)
+    assert rep.exit_code() == 0
+
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    try:
+        from jax import shard_map as _shard_map
+
+        _no_check = {"check_vma": False}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        _no_check = {"check_rep": False}
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ("dcn", "dp_shard"))
+
+    def dcn_only(gl):
+        return jax.lax.psum(gl[0], ("dcn",))  # the slab hop itself
+
+    fn = _shard_map(dcn_only, mesh=mesh, in_specs=P(("dcn", "dp_shard")),
+                    out_specs=P("dp_shard", None), **_no_check)
+    rep2 = audit_fn(fn, jax.ShapeDtypeStruct((4, 520, 520), jnp.float32))
+    assert not [f for f in rep2.findings if f.rule == "GL108"], rep2.render()
 
 
 def test_gl106_hint_severity_and_suppressible(tmp_path):

@@ -282,3 +282,35 @@ def test_t131k_probe_cpu_components_run():
         rep = _run(["benchmarks/t131k_probe.py", "--seq-len", "512",
                     "--component", comp, "--cpu"])
         assert rep["component"] == comp and "value" in rep
+
+
+@pytest.mark.slow
+def test_bench_dcn_fields_always_emitted():
+    """dcn_bytes / dcn_bytes_flat / dcn_overlap_frac ride EVERY train report
+    (the always-emitted-twins contract): zeros-clean on a mesh without a
+    dcn axis, and populated — with the hierarchical schedule strictly under
+    the flat twin, PowerSGD under the dense slab — in both --dcn-compress
+    states on a simulated 2-slice mesh."""
+    # no dcn axis: fields present, zeros-clean
+    rep = _run(["bench.py", "--iters", "2", "--batch", "8"])
+    extra = rep["extra"]
+    assert extra["dcn_bytes"] == 0 and extra["dcn_bytes_flat"] == 0
+    assert extra["dcn_overlap_frac"] == 0.0
+    assert extra["dcn_comm"]["hierarchical"] is False
+
+    # 2-slice mesh, dense DCN hop (--dcn-compress off)
+    rep_dense = _run(["bench.py", "--iters", "2", "--batch", "8",
+                      "--dcn-slices", "2", "--dcn-compress", "off"])
+    dense = rep_dense["extra"]
+    assert dense["dcn_comm"]["hierarchical"] is True
+    assert dense["dcn_comm"]["compression"] is None
+    assert 0 < dense["dcn_bytes"] < dense["dcn_bytes_flat"]
+    assert 0.0 <= dense["dcn_overlap_frac"] <= 1.0
+
+    # PowerSGD DCN codec (--dcn-compress on): strictly fewer bytes again
+    rep_c = _run(["bench.py", "--iters", "2", "--batch", "8",
+                  "--dcn-slices", "2", "--dcn-compress", "on"])
+    comp = rep_c["extra"]
+    assert comp["dcn_comm"]["compression"] == "powersgd"
+    assert 0 < comp["dcn_bytes"] < dense["dcn_bytes"]
+    assert comp["dcn_bytes_flat"] == dense["dcn_bytes_flat"]

@@ -325,3 +325,32 @@ def test_save_model_without_accelerator(tmp_path):
     assert files
     loaded = load_model_params(str(tmp_path / "model"))
     np.testing.assert_allclose(loaded["w"], np.arange(16.0).reshape(4, 4))
+
+
+def test_wait_for_published_checkpoint(tmp_path):
+    """The non-main-rank half of the rank-0 publish: the wait returns once
+    the manifest (written LAST) is visible, and times out loudly — never
+    silently — when the publish never lands."""
+    import threading
+    import time
+
+    from accelerate_tpu.checkpointing import wait_for_published_checkpoint
+    from accelerate_tpu.utils.constants import CHECKPOINT_MANIFEST_NAME
+
+    ckpt = tmp_path / "checkpoint_0"
+    with pytest.raises(TimeoutError, match="not visible"):
+        wait_for_published_checkpoint(ckpt, timeout_s=0.2, poll_s=0.02)
+
+    def publish():
+        time.sleep(0.15)
+        ckpt.mkdir()
+        (ckpt / CHECKPOINT_MANIFEST_NAME).write_text("{}")
+
+    t = threading.Thread(target=publish)
+    t.start()
+    wait_for_published_checkpoint(ckpt, timeout_s=5.0, poll_s=0.02)  # returns
+    t.join()
+    # verify=False (manifests disabled) waits on the directory alone
+    bare = tmp_path / "checkpoint_1"
+    bare.mkdir()
+    wait_for_published_checkpoint(bare, verify=False, timeout_s=0.2)

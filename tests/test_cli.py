@@ -261,6 +261,7 @@ def test_interactive_config_full_flow(monkeypatch, capsys):
         "2",          # num_machines
         "10.0.0.1",   # coordinator ip
         "",           # port (default)
+        "2",          # slices (dcn cross-slice axis)
         "",           # use_cpu
         "y",          # debug
         "fp4",        # invalid precision -> re-prompt
@@ -297,6 +298,7 @@ def test_interactive_config_full_flow(monkeypatch, capsys):
     assert cfg.fsdp_offload_params and cfg.fsdp_activation_checkpointing
     assert cfg.debug and cfg.num_machines == 2
     assert cfg.main_process_ip == "10.0.0.1" and cfg.main_process_port == 29500
+    assert cfg.dcn_size == 2
     assert cfg.cloud_backend == "gke" and cfg.cloud_tpu_type == "tpu-v5-lite-podslice"
     assert cfg.cloud_image == "eu.gcr.io/x/train:1"
     assert cfg.cloud_tpu_topology == "4x4" and cfg.cloud_chips_per_host == 4

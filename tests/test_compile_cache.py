@@ -151,3 +151,26 @@ def test_load_prewarm_sweeps_stale_version_dirs(tmp_path, monkeypatch):
     assert not stale.exists()
     assert (root / toolchain_version_key()).is_dir()  # current survives
     assert sweep_stale_versions(str(root)) == []      # idempotent
+
+
+def test_scoped_cache_dir_per_launched_process(tmp_path, monkeypatch):
+    """Concurrent launched processes never share a cache dir: the scope is
+    keyed by the launcher's ACCELERATE_PROCESS_ID (reading
+    jax.process_index() would initialize the backend before the worker's
+    jax.distributed.initialize)."""
+    monkeypatch.delenv("ACCELERATE_JAX_CACHE_SCOPE", raising=False)
+    monkeypatch.delenv("PYTEST_XDIST_WORKER", raising=False)
+    root = str(tmp_path)
+    monkeypatch.setenv("ACCELERATE_PROCESS_ID", "0")
+    d0 = scoped_cache_dir("tests", root=root)
+    monkeypatch.setenv("ACCELERATE_PROCESS_ID", "1")
+    d1 = scoped_cache_dir("tests", root=root)
+    assert d0 != d1
+    assert d0.endswith("tests-proc0") and d1.endswith("tests-proc1")
+    # unlaunched processes keep the bare tag (cache reuse across runs)
+    monkeypatch.delenv("ACCELERATE_PROCESS_ID", raising=False)
+    assert scoped_cache_dir("tests", root=root).endswith("/tests")
+    # the xdist/explicit scope composes with the process scope
+    monkeypatch.setenv("ACCELERATE_JAX_CACHE_SCOPE", "w3")
+    monkeypatch.setenv("ACCELERATE_PROCESS_ID", "2")
+    assert scoped_cache_dir("tests", root=root).endswith("tests-w3-proc2")

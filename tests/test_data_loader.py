@@ -341,3 +341,128 @@ def test_partial_batch_pads_to_device_multiple():
 
     with _pytest.raises(Exception):
         list(dl_strict)
+
+
+# ---------------------------------------------------------------------------
+# per-host sharding of the global batch (multi-process launch contract)
+# ---------------------------------------------------------------------------
+
+
+def _launch_mesh():
+    from accelerate_tpu.parallelism_config import ParallelismConfig
+
+    return ParallelismConfig(dcn_size=2, dp_shard_size=4).build_device_mesh()
+
+
+def test_batch_rows_process_disjoint_coverage():
+    """The sharding-derived row blocks of hypothetical process groups
+    (contiguous device groups, the launch topology) are disjoint, contiguous
+    and cover the whole global batch — at BOTH a 2-process and a 4-process
+    split of the same mesh (the elastic invariant: any process count
+    re-partitions the same stream identically)."""
+    from accelerate_tpu.data_loader import _rows_union, batch_rows_by_device
+
+    mesh = _launch_mesh()
+    spec = P(("dcn", "dp_replicate", "dp_shard"))
+    rows = batch_rows_by_device(mesh, spec, (16, 3))
+    devs = list(mesh.devices.flat)
+    for nproc in (2, 4):
+        per = len(devs) // nproc
+        blocks = [
+            _rows_union([rows[d] for d in devs[g * per:(g + 1) * per]], f"g{g}")
+            for g in range(nproc)
+        ]
+        assert blocks[0][0] == 0 and blocks[-1][1] == 16
+        for a, b in zip(blocks, blocks[1:]):
+            assert a[1] == b[0], blocks  # disjoint + gap-free
+
+
+def test_process_local_rows_single_process_full_block():
+    from accelerate_tpu.data_loader import process_local_rows
+
+    mesh = _launch_mesh()
+    sl = process_local_rows(mesh, P(("dcn", "dp_replicate", "dp_shard")), (16, 3))
+    assert (sl.start, sl.stop) == (0, 16)
+    # a replicated batch dim (tp-only spec) owns the whole batch everywhere
+    sl2 = process_local_rows(mesh, P(None), (16, 3))
+    assert (sl2.start, sl2.stop) == (0, 16)
+
+
+def test_shard_global_batch_roundtrip_and_values():
+    from accelerate_tpu.data_loader import shard_global_batch
+
+    mesh = _launch_mesh()
+    spec = lambda x: P(("dcn", "dp_replicate", "dp_shard")) if x.ndim else P()
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    arr = shard_global_batch({"x": x}, mesh, spec)["x"]
+    assert arr.shape == (16, 3)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # each device holds exactly its sharding-assigned rows
+    for shard in arr.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(shard.data),
+                                      x[shard.index[0]])
+
+
+def test_process_sharded_loader_resume_exact_in_global_batches():
+    """The shard_across_processes loader counts its resume position in
+    GLOBAL batches: a mid-epoch state_dict restores to the exact next
+    global batch — the process-count-independent coordinate that makes an
+    elastic resume land on the same stream position at any gang size."""
+    mesh = _launch_mesh()
+    spec = lambda x: P(("dcn", "dp_replicate", "dp_shard")) if x.ndim else P()
+    stream = [{"x": np.full((16, 3), float(i), np.float32)} for i in range(6)]
+
+    def loader():
+        return DataLoaderShard(list(stream), mesh=mesh, batch_spec=spec,
+                               shard_across_processes=True)
+
+    dl = loader()
+    it = iter(dl)
+    seen = [float(np.asarray(next(it)["x"])[0, 0]) for _ in range(3)]
+    assert seen == [0.0, 1.0, 2.0]
+    sd = dl.state_dict()
+    assert sd["batches_yielded"] == 3
+
+    dl2 = loader()
+    dl2.load_state_dict(sd)
+    rest = [float(np.asarray(b["x"])[0, 0]) for b in dl2]
+    assert rest == [3.0, 4.0, 5.0]
+
+
+def test_prepare_data_loader_auto_shard_flag():
+    """Auto resolution: generic iterables get shard_across_processes only in
+    multi-process worlds; torch loaders never do (BatchSamplerShard already
+    sharded at the sampler)."""
+    from accelerate_tpu.data_loader import prepare_data_loader
+
+    mesh = _launch_mesh()
+    spec = lambda x: P(("dcn", "dp_shard")) if getattr(x, "ndim", 0) else P()
+    # single-process world: off (slicing would be identity anyway)
+    dl = prepare_data_loader([{"x": np.zeros((16,), np.float32)}],
+                             mesh=mesh, batch_spec=spec)
+    assert isinstance(dl, DataLoaderShard) and not dl.shard_across_processes
+    # explicit opt-in survives
+    dl2 = prepare_data_loader([{"x": np.zeros((16,), np.float32)}],
+                              mesh=mesh, batch_spec=spec,
+                              shard_across_processes=True)
+    assert dl2.shard_across_processes
+
+    class _DS(tud.Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i)}
+
+    tl = prepare_data_loader(tud.DataLoader(_DS(), batch_size=4),
+                             num_processes=2, process_index=0,
+                             mesh=mesh, batch_spec=spec,
+                             shard_across_processes=True)
+    assert isinstance(tl, DataLoaderShard) and not tl.shard_across_processes
+
+
+def test_rows_union_rejects_non_contiguous():
+    from accelerate_tpu.data_loader import _rows_union
+
+    with pytest.raises(ValueError, match="non-contiguous"):
+        _rows_union([(0, 4), (8, 12)], "probe")

@@ -54,3 +54,14 @@ def test_plan_infer_report_70b():
     assert rep["per_device_GiB"]["total_hbm"] < 15
     # sanity: tp capped at the GQA kv-head count
     assert rep["mesh"]["tp"] == 8
+
+
+@pytest.mark.slow
+def test_launch_leg():
+    """The multi-host launch story across REAL process boundaries: 2-proc
+    bitwise loss parity vs the single-process mesh, SIGTERM on rank 1 →
+    agreed stop → exit 75 → `launch --resume` onto 1 process with exact
+    continuation parity (hierarchical ICI→DCN sync engaged throughout)."""
+    info = graft._launch_leg()
+    assert "bitwise parity ok" in info
+    assert "resume@1proc" in info and "exact" in info

@@ -1,0 +1,39 @@
+"""The ONE tier-1 multi-process launch smoke (the rest of the subprocess
+self-launch matrix lives in the slow tier, tests/test_launch.py): a minimal
+2-process CPU gang over ``jax.distributed`` with a REAL cross-process
+collective — pinning the launcher's coordinator wiring and the gloo CPU
+collectives backend (state.py enables it before initialize; without it the
+CPU backend rejects every multiprocess computation)."""
+
+import os
+
+from accelerate_tpu.test_utils import execute_subprocess, get_launch_command
+
+
+def _clean_env(**extra):
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_"))
+    }
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra)
+    return env
+
+
+def test_minimal_two_process_collective_smoke(tmp_path):
+    script = tmp_path / "smoke.py"
+    script.write_text(
+        "import numpy as np\n"
+        "from accelerate_tpu import PartialState\n"
+        "from accelerate_tpu.ops import operations as ops\n"
+        "state = PartialState()\n"
+        "assert state.num_processes == 2, state.num_processes\n"
+        "summed = np.asarray(ops.reduce(np.ones((3,), np.float32), reduction='sum'))\n"
+        "np.testing.assert_allclose(summed, np.full((3,), 2.0, np.float32))\n"
+        "state.print('SMOKE OK')\n"
+        "state.destroy_process_group()\n"
+    )
+    cmd = get_launch_command(num_processes=2, num_cpu_devices=1) + [str(script)]
+    result = execute_subprocess(cmd, env=_clean_env(), timeout=300)
+    assert "SMOKE OK" in result.stdout

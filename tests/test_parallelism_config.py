@@ -85,3 +85,39 @@ def test_mesh_canonical_order():
     cfg = ParallelismConfig(dp_shard_size=8)
     mesh = cfg.build_device_mesh()
     assert tuple(mesh.axis_names) == MESH_AXIS_ORDER
+
+
+def test_dcn_axis_outermost_and_transport():
+    """The explicit cross-slice axis: outermost in the canonical order so
+    slice boundaries land on the slowest network tier, included in every
+    data-parallel dim group, and riding the PARALLELISM_CONFIG_* env
+    transport like every other axis."""
+    import os
+
+    assert MESH_AXIS_ORDER[0] == "dcn"
+    cfg = ParallelismConfig(dcn_size=2, dp_shard_size=4)
+    assert cfg.has_dcn and cfg.data_parallel_size == 8
+    mesh = cfg.build_device_mesh()
+    assert mesh.shape["dcn"] == 2 and mesh.shape["dp_shard"] == 4
+    assert cfg.dp_dim_names == ("dcn", "dp_shard")
+    assert cfg.batch_dim_names == ("dcn", "dp_shard")
+    assert cfg.dp_cp_dim_names == ("dcn", "dp_shard")
+    # params replicate across slices: dcn is never an FSDP shard axis
+    assert "dcn" not in cfg.fsdp_dim_names
+
+    env = cfg.to_env()
+    assert env["PARALLELISM_CONFIG_DCN_SIZE"] == "2"
+    old = dict(os.environ)
+    try:
+        os.environ.update(env)
+        rt = ParallelismConfig.from_env()
+        assert rt.dcn_size == 2 and rt.dp_shard_size == 4
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+
+
+def test_dcn_dp_shard_inference_accounts_for_slices():
+    cfg = ParallelismConfig(dcn_size=2, dp_shard_size=-1)
+    cfg._validate(8)
+    assert cfg.dp_shard_size == 4
