@@ -30,6 +30,7 @@ from .utils.dataclasses import (
     SequenceParallelConfig,
     ServingPlugin,
     ShardingStrategy,
+    TelemetryPlugin,
     TensorParallelConfig,
 )
 
@@ -117,5 +118,15 @@ except ImportError:  # pragma: no cover
     pass
 try:
     from .ops.streaming import LayerPrefetcher, StreamStats
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from .telemetry import (
+        SLOMonitor,
+        SpanRecorder,
+        TrainTimeline,
+        TwinRegistry,
+        twin_registry,
+    )
 except ImportError:  # pragma: no cover
     pass

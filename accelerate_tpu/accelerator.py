@@ -32,6 +32,8 @@ import contextlib
 import inspect
 import math
 import os
+import time
+from pathlib import Path
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Optional, Sequence, Union
@@ -76,6 +78,7 @@ from .utils.dataclasses import (
     ProjectConfiguration,
     ResiliencePlugin,
     SequenceParallelConfig,
+    TelemetryPlugin,
     TensorParallelConfig,
 )
 from .logging import get_logger
@@ -257,6 +260,7 @@ class Accelerator:
         sp_config: Optional[SequenceParallelConfig] = None,
         gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
         resilience_plugin: Optional[ResiliencePlugin] = None,
+        telemetry_plugin: Optional[TelemetryPlugin] = None,
         rng_types: Optional[list] = None,
         log_with: Optional[Union[str, list]] = None,
         project_dir: Optional[str] = None,
@@ -364,6 +368,25 @@ class Accelerator:
         # (bench.py reads it unconditionally — zeros when the run is clean)
         self.resilience_plugin = resilience_plugin or ResiliencePlugin()
         self.goodput = GoodputTracker()
+        # unified telemetry (docs/observability.md): the training timeline
+        # + SLO monitor are host-side only — enabling them is bitwise-
+        # invisible to the loss (pinned by tests).  The twin registry is
+        # process-global (telemetry/twins.py); timeline/slo exist only when
+        # armed so the hot step wrapper pays one attribute check when off.
+        self.telemetry_plugin = telemetry_plugin or TelemetryPlugin()
+        self.timeline = None
+        self.slo_monitor = None
+        if self.telemetry_plugin.timeline:
+            from .telemetry import TrainTimeline
+
+            self.timeline = TrainTimeline(
+                capacity=self.telemetry_plugin.ring_capacity
+            )
+        if self.telemetry_plugin.slo is not None:
+            from .telemetry import SLOMonitor
+
+            self.slo_monitor = SLOMonitor(self.telemetry_plugin.slo)
+        self._slo_prev_step_t = None  # inter-step cadence anchor
         self._preemption = None
         if self.resilience_plugin.handle_preemption:
             self.install_preemption_handler()
@@ -667,6 +690,10 @@ class Accelerator:
             transfer_retry_policy=self._transfer_retry_policy(),
             on_transfer_retry=self.goodput.record_retry,
         )
+        if self.timeline is not None:
+            # data_wait / h2d_staging phase spans ride the existing loader
+            # hook points (data_loader.py) — host-side only
+            prepared._timeline = self.timeline
         self._dataloaders.append(prepared)
         return prepared
 
@@ -1718,7 +1745,17 @@ class Accelerator:
                 self.gradient_state._set_sync_gradients(
                     mode != "across_steps" or (self.step_count % accum_steps == 0)
                 )
-            new_state, metrics = jitted(state, batch)
+            # training timeline (telemetry/timeline.py): host-side phase
+            # spans only — jax dispatch is async, so step_dispatch measures
+            # host dispatch time, not device compute (docs/observability.md)
+            timeline = self.timeline
+            slo = self.slo_monitor
+            dispatch_cm = (
+                timeline.phase("step_dispatch", step=self.step_count)
+                if timeline is not None else contextlib.nullcontext()
+            )
+            with dispatch_cm:
+                new_state, metrics = jitted(state, batch)
             if nan_guard and isinstance(metrics, dict) \
                     and "consecutive_nan_skips" in metrics:
                 # one scalar host fetch per armed step: it keeps the goodput
@@ -1726,10 +1763,28 @@ class Accelerator:
                 # even with the abort disabled, and training loops fetch the
                 # loss scalar anyway so this rarely adds a real sync.  The
                 # zero-sync option is disabling the guard, not the abort.
-                consecutive = int(metrics["consecutive_nan_skips"])
+                if timeline is not None:
+                    with timeline.phase("guard_sync", step=self.step_count):
+                        consecutive = int(metrics["consecutive_nan_skips"])
+                else:
+                    consecutive = int(metrics["consecutive_nan_skips"])
                 if bool(metrics["nan_skipped"]):
                     self.goodput.record_nan_skip()
                 _guard.check_abort(consecutive, guard_abort_after)
+            if slo is not None:
+                # step_time_s is the INTER-STEP CADENCE (host wall time
+                # between consecutive wrapped-step calls, first step
+                # skipped) — a delta around the jitted call alone would
+                # measure async dispatch, not compute (the GL109 hazard);
+                # cadence tracks true steady-state step time with zero
+                # added syncs because training loops fetch the loss scalar
+                # between calls anyway
+                now = time.perf_counter()
+                prev = self._slo_prev_step_t
+                self._slo_prev_step_t = now
+                if prev is not None:
+                    slo.observe("step_time_s", now - prev)
+                slo.observe("goodput_frac", self.goodput.goodput_frac())
             if self._preemption is not None and self._agreed_preemption():
                 # stop AT the step boundary: the post-step state is exactly
                 # consistent with the dataloader position and step counters,
@@ -1745,6 +1800,15 @@ class Accelerator:
         wrapped._lint_report = None
         self._prepared_train_step = wrapped
         return wrapped
+
+    def reset_step_cadence(self) -> None:
+        """Re-anchor the SLO ``step_time_s`` cadence after a legitimate
+        non-step pause (an eval loop, a manual stall): the next wrapped
+        step starts a fresh gap instead of observing the pause as one giant
+        step time (the P² p99 marker never forgets a max, so a single
+        outlier could spuriously trip a healthy run's SLO).  Checkpoint
+        drains reset this automatically."""
+        self._slo_prev_step_t = None
 
     @property
     def dcn_sync(self) -> Optional[dict]:
@@ -2272,6 +2336,21 @@ class Accelerator:
         finally:
             # a failed checkpoint flush must not also drop the trackers'
             # buffered metrics
+            if self.timeline is not None and self.telemetry_plugin.export_dir \
+                    and self.is_main_process:
+                # end-of-run timeline export (Chrome trace-event JSON,
+                # Perfetto-loadable; docs/observability.md).  Best-effort: a
+                # bad export dir must not drop the trackers' flush below or
+                # desynchronize the wait_for_everyone barrier
+                try:
+                    export_dir = Path(self.telemetry_plugin.export_dir)
+                    export_dir.mkdir(parents=True, exist_ok=True)
+                    self.timeline.write_chrome_trace(
+                        export_dir / "train_timeline.json"
+                    )
+                except OSError as e:
+                    logger.warning("timeline export to %s failed: %s",
+                                   self.telemetry_plugin.export_dir, e)
             for tracker in self.trackers:
                 tracker.finish()
         self.wait_for_everyone()

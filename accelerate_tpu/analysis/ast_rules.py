@@ -84,6 +84,9 @@ class _ModuleIndex:
         self._parent: dict[int, Optional[ast.AST]] = {}
         # function name -> donated positional indices, for jax.jit bindings
         self.donated_callables: dict[str, tuple[int, ...]] = {}
+        # every name bound to a jax.jit/jax.pmap wrapper (donating or not) —
+        # calls of these are "jitted calls" for the timing rule (GL109)
+        self.jit_bound_names: set[str] = set()
         self._index()
         self.jit_contexts = self._close_jit_contexts()
 
@@ -127,6 +130,7 @@ class _ModuleIndex:
         if not (self._is_jit_call(value) and len(targets) == 1
                 and isinstance(targets[0], ast.Name)):
             return
+        self.jit_bound_names.add(targets[0].id)
         donated = _donate_positions(value)
         if donated:
             self.donated_callables[targets[0].id] = donated
@@ -668,6 +672,101 @@ def _rule_checkpoint_atomicity(index: _ModuleIndex, path: str) -> list[Finding]:
     return findings
 
 
+# GL109: host clocks whose deltas bracket async-dispatched work
+_TIMING_CLOCKS = frozenset({
+    "time.perf_counter", "time.monotonic", "time.time", "time.process_time",
+})
+# calls that force device execution to complete (or read a concrete value)
+_MATERIALIZE_FUNCS = frozenset({
+    "jax.block_until_ready", "jax.device_get", "float", "int", "bool",
+    "numpy.asarray", "numpy.array", "numpy.testing.assert_allclose",
+})
+_MATERIALIZE_METHODS = frozenset({"block_until_ready", "item", "tolist"})
+
+
+def _scope_nodes(scope):
+    """Every node in ``scope``'s own frame (module or one function body) —
+    nested function/lambda bodies excluded, they run when called."""
+    for stmt in scope.body:
+        yield from _walk_same_frame(stmt)
+
+
+def _rule_timing_without_block(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL109 (INFO hint): ``perf_counter()`` deltas bracketing a jitted
+    call with no ``block_until_ready()``/materialization in between — jax
+    dispatch is async, so the delta measured enqueue time, not compute.
+
+    Shape matched per frame: ``t0 = time.perf_counter()`` ... a call of a
+    ``jax.jit``-bound name (or a jit-decorated function, or a direct
+    ``jax.jit(f)(x)``) ... ``<expr> - t0`` with no materializing call
+    (``jax.block_until_ready``/``float``/``np.asarray``/``.item()``/...)
+    between the LAST jitted call and the delta.  The bench.py timed-loop
+    idiom (jitted steps, then ``float(loss)`` + ``block_until_ready``,
+    then the closing clock read) passes clean.  Known miss: timing through
+    a method call (``engine.run(...)``) or a helper bound outside the
+    module — only bare names the module itself jit-binds are tracked."""
+    findings: list[Finding] = []
+    jit_fn_names = {
+        fn.name for fn in index.functions if id(fn) in index.jit_contexts
+    }
+    scopes: list = [index.tree] + list(index.functions)
+    for scope in scopes:
+        clock_assigns: dict[str, list[int]] = {}
+        deltas: list[tuple[int, str]] = []
+        jit_lines: list[int] = []
+        mat_lines: list[int] = []
+        for node in _scope_nodes(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and index.canonical(node.value.func) in _TIMING_CLOCKS
+            ):
+                clock_assigns.setdefault(node.targets[0].id, []).append(node.lineno)
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and isinstance(node.right, ast.Name)
+            ):
+                deltas.append((node.lineno, node.right.id))
+            if isinstance(node, ast.Call):
+                canon = index.canonical(node.func)
+                if canon in _MATERIALIZE_FUNCS or (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MATERIALIZE_METHODS
+                ):
+                    mat_lines.append(node.lineno)
+                func = node.func
+                if (
+                    isinstance(func, ast.Name)
+                    and (func.id in index.jit_bound_names or func.id in jit_fn_names)
+                ) or (isinstance(func, ast.Call) and index._is_jit_call(func)):
+                    jit_lines.append(node.lineno)
+        for lineno, name in deltas:
+            starts = [l for l in clock_assigns.get(name, []) if l < lineno]
+            if not starts:
+                continue
+            t0_line = max(starts)
+            bracketed = [l for l in jit_lines if t0_line < l < lineno]
+            if not bracketed:
+                continue
+            last_jit = max(bracketed)
+            if any(last_jit <= l <= lineno for l in mat_lines):
+                continue
+            findings.append(
+                _finding(
+                    "GL109",
+                    f"clock delta over `{name}` brackets the jitted call at "
+                    f"line {last_jit} with no block_until_ready()/"
+                    "materialization in between: jax dispatch is async, so "
+                    "this measures host enqueue time, not device compute",
+                    path, lineno,
+                )
+            )
+    return findings
+
+
 _ALL_RULES = (
     _rule_donated_reuse,
     _rule_host_sync,
@@ -676,6 +775,7 @@ _ALL_RULES = (
     _rule_checkpoint_atomicity,
     _rule_shape_dependent_trace,
     _rule_jit_in_hot_loop,
+    _rule_timing_without_block,
 )
 
 

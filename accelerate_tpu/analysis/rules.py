@@ -2,12 +2,14 @@
 draw from.
 
 Numbering: GL0xx meta (the linter linting its own markers), GL1xx jaxpr
-rules (hazards visible only in the traced program), GL2xx AST rules
-(hazards visible only in the source — caller-side reuse, impure calls the
-trace would bake silently), GL3xx compiled/recompile rules (hazards visible
-only in the lowered XLA executable — did the donation actually alias, does
-the footprint fit — plus the trace- and source-level shapes that cause
-mid-traffic recompiles).  ``docs/static_analysis.md`` renders this table;
+rules (hazards visible only in the traced program; GL106-109 are the
+suppressible INFO *hints* — GL109 is source-level but rides the hint
+block), GL2xx AST rules (hazards visible only in the source — caller-side
+reuse, impure calls the trace would bake silently), GL3xx
+compiled/recompile rules (hazards visible only in the lowered XLA
+executable — did the donation actually alias, does the footprint fit —
+plus the trace- and source-level shapes that cause mid-traffic
+recompiles).  ``docs/static_analysis.md`` renders this table;
 ``tests/test_analysis.py`` pins that every finding any engine can emit
 carries an id registered here.
 """
@@ -118,6 +120,19 @@ RULES: dict[str, Rule] = {
             "(parallel/hierarchical.py hierarchical_sync — the prepared "
             "train step does this automatically when the mesh has a dcn "
             "axis and GradSyncKwargs.hierarchical is not disabled)",
+        ),
+        Rule(
+            "GL109", "timing-without-block", Severity.INFO, "ast",
+            "a perf_counter()/monotonic() delta bracketing a jitted call "
+            "with no block_until_ready()/materialization in between: jax "
+            "dispatch is async, so the delta measures host-side enqueue "
+            "time, not device compute — the resulting 'speedup' is a "
+            "measurement artifact (a hint, not a defect: suppressible, and "
+            "never fails a run)",
+            "materialize before reading the clock: "
+            "jax.block_until_ready(out) (or float(loss)/np.asarray) between "
+            "the jitted call and the closing perf_counter(), the bench.py "
+            "timed-loop idiom",
         ),
         Rule(
             "GL105", "unsharded-output", Severity.WARNING, "jaxpr",

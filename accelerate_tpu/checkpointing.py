@@ -37,6 +37,7 @@ safetensors — the ``accelerate merge-weights`` CLI capability
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
 import os
@@ -387,8 +388,19 @@ def wait_for_pending_checkpoint(accelerator) -> None:
     # clear first: a failed finalization should surface once, not wedge every
     # subsequent save/load behind the same broken checkpointer
     accelerator._pending_checkpointer = None
+    # training timeline (telemetry/timeline.py): the drain is the
+    # checkpoint_drain phase — the only blocking wait async saves keep
+    timeline = getattr(accelerator, "timeline", None)
+    drain_cm = timeline.phase("checkpoint_drain") if timeline is not None \
+        else contextlib.nullcontext()
+    # the drain is a legitimate non-step pause: re-anchor the SLO step
+    # cadence so the next step's gap doesn't read as one giant step_time_s
+    # (P² never forgets a max — a healthy run could spuriously trip)
+    if getattr(accelerator, "_slo_prev_step_t", None) is not None:
+        accelerator._slo_prev_step_t = None
     try:
-        ckptr.wait_until_finished()
+        with drain_cm:
+            ckptr.wait_until_finished()
     except BaseException:
         # a failed write poisons the checkpointer: release its threads and
         # drop it from the reuse cache rather than leaking them per retry.

@@ -18,6 +18,7 @@ so the dataloader collapses ``process_index`` by ``non_data_parallel_size``
 
 from __future__ import annotations
 
+import contextlib
 import math
 from typing import Any, Callable, Iterable, Iterator, Optional
 
@@ -584,6 +585,10 @@ class DataLoaderShard(DataLoaderStateMixin):
         # the Accelerator threads its ResiliencePlugin budget + goodput hook
         self._retry_policy = transfer_retry_policy or DEFAULT_POLICY
         self._on_transfer_retry = on_transfer_retry
+        # training timeline (telemetry/timeline.py): the Accelerator attaches
+        # its TrainTimeline here when armed — data_wait brackets the inner
+        # iterable, h2d_staging the device placement.  None = zero overhead.
+        self._timeline = None
 
     # -- device placement ---------------------------------------------------
 
@@ -613,6 +618,13 @@ class DataLoaderShard(DataLoaderStateMixin):
         return jax.tree_util.tree_map(_pad, batch)
 
     def _device_put_batch(self, batch):
+        timeline = self._timeline
+        cm = timeline.phase("h2d_staging") if timeline is not None \
+            else contextlib.nullcontext()
+        with cm:
+            return self._device_put_batch_inner(batch)
+
+    def _device_put_batch_inner(self, batch):
         batch = _to_numpy(batch)
         if not self.put_on_device:
             return batch
@@ -640,6 +652,20 @@ class DataLoaderShard(DataLoaderStateMixin):
                             policy=self._retry_policy,
                             on_retry=self._on_transfer_retry)
 
+    def _timed_data_wait(self, it):
+        """Yield from ``it``, bracketing each blocking ``next`` in the
+        timeline's ``data_wait`` phase when a timeline is attached."""
+        while True:
+            timeline = self._timeline
+            cm = timeline.phase("data_wait") if timeline is not None \
+                else contextlib.nullcontext()
+            try:
+                with cm:
+                    item = next(it)
+            except StopIteration:
+                return
+            yield item
+
     def __iter__(self):
         if self.rng_types is not None:
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
@@ -662,9 +688,12 @@ class DataLoaderShard(DataLoaderStateMixin):
                 prefetcher = _RingPrefetcher(
                     self.inner, self._device_put_batch, self.prefetch_size
                 )
-                source = iter(prefetcher)
+                source = self._timed_data_wait(iter(prefetcher))
             else:
-                source = (self._device_put_batch(b) for b in iter(self.inner))
+                # data_wait brackets ONLY the inner iterable (h2d_staging is
+                # its own phase inside _device_put_batch — no double count)
+                source = (self._device_put_batch(b)
+                          for b in self._timed_data_wait(iter(self.inner)))
             # one-batch lookahead: current batch transfers H2D while the
             # previous one is being consumed
             batch_idx = 0
@@ -786,10 +815,21 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self._skip_once = False    # skip_batches came from load_state_dict
         self._retry_policy = transfer_retry_policy or DEFAULT_POLICY
         self._on_transfer_retry = on_transfer_retry
+        # TrainTimeline hook, same contract as DataLoaderShard._timeline
+        self._timeline = None
 
     def _fetch_batches(self, iterator):
         """Rank 0 reads one global batch (split mode) or num_processes batches
-        (stride mode) and broadcasts them (reference _fetch_batches :786)."""
+        (stride mode) and broadcasts them (reference _fetch_batches :786).
+        With a timeline attached the read+broadcast is the ``data_wait``
+        phase."""
+        timeline = self._timeline
+        cm = timeline.phase("data_wait") if timeline is not None \
+            else contextlib.nullcontext()
+        with cm:
+            return self._fetch_batches_inner(iterator)
+
+    def _fetch_batches_inner(self, iterator):
         from .ops.operations import concatenate
 
         batches, batch = None, None
@@ -833,9 +873,13 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                     return send_to_device(local, self.device)
                 return local
 
-            return with_retries(_place, site="dataloader-h2d",
-                                policy=self._retry_policy,
-                                on_retry=self._on_transfer_retry)
+            timeline = self._timeline
+            cm = timeline.phase("h2d_staging") if timeline is not None \
+                else contextlib.nullcontext()
+            with cm:
+                return with_retries(_place, site="dataloader-h2d",
+                                    policy=self._retry_policy,
+                                    on_retry=self._on_transfer_retry)
 
         try:
             # one-batch lookahead, like DataLoaderShard: the NEXT batch's

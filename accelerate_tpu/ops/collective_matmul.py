@@ -400,6 +400,14 @@ def tp_comm_accounting(
     mm_s = mm_flops_per_step / peak_flops
     comm_s = bytes_per_hop / (ici_gibs * 2**30)
     overlap = 1.0 if comm_s <= 0 else min(1.0, mm_s / comm_s)
+    # twin registry: PREDICTED hideable fraction; measured side is
+    # xplane.ici_overlap_report off a captured trace
+    from ..telemetry import twin_registry
+
+    twin_registry().record_predicted(
+        "tp_comm.overlap_frac", overlap,
+        source="ops/collective_matmul.tp_comm_accounting",
+    )
     return {
         "ring_size": p,
         "steps": steps,

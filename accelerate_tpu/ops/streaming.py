@@ -180,6 +180,15 @@ def offload_transfer_accounting(
     host_b = n_params * HOST_BYTES_PER_PARAM.get(optimizer, 16.0)
     transfer_s = (d2h + h2d) / (pcie_rate_gibs * 2**30)
     host_s = host_b / (host_rate_gibs * 2**30)
+    # twin registry (telemetry/twins.py): this is the PREDICTED side; the
+    # measured side is xplane.streaming_overlap_report off a captured trace
+    from ..telemetry import twin_registry
+
+    twin_registry().record_predicted(
+        "offload_transfer.overlap_frac",
+        predicted_overlap(transfer_s, host_s),
+        source="ops/streaming.offload_transfer_accounting",
+    )
     return {
         "h2d_bytes": int(h2d),
         "d2h_bytes": int(d2h),

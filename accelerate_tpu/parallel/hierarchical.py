@@ -255,6 +255,14 @@ def dcn_comm_accounting(
         overlap = 1.0 if dcn_s <= 0 else 0.0
     else:
         overlap = min(1.0, step_compute_s / dcn_s)
+    # twin registry: PREDICTED per-device DCN bytes of the hierarchical
+    # schedule; measured side is measure_dcn_bytes off the traced program
+    from ..telemetry import twin_registry
+
+    twin_registry().record_predicted(
+        "dcn_comm.dcn_bytes", dcn_bytes,
+        source="parallel/hierarchical.dcn_comm_accounting",
+    )
     return {
         "dcn_size": d,
         "ici_size": p,
@@ -313,5 +321,11 @@ def measure_dcn_bytes(closed, *, dcn_axis: str = "dcn",
         total += cost
         rows.append({"primitive": name, "axes": axes, "operand_bytes": nbytes,
                      "dcn_bytes": int(cost)})
+    from ..telemetry import twin_registry
+
+    twin_registry().record_measured(
+        "dcn_comm.dcn_bytes", int(total),
+        source="parallel/hierarchical.measure_dcn_bytes",
+    )
     return {"dcn_bytes": int(total), "dcn_size": d, "collectives": rows,
             "kind": "measured"}

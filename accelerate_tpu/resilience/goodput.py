@@ -85,7 +85,15 @@ class GoodputTracker:
 
     def report(self) -> dict:
         """The JSON-able digest bench.py embeds (``kind: "measured"`` — the
-        predicted counterpart is :func:`goodput_accounting`)."""
+        predicted counterpart is :func:`goodput_accounting`).  Also records
+        the MEASURED side of the ``goodput.goodput_frac`` twin
+        (telemetry/twins.py)."""
+        from ..telemetry import twin_registry
+
+        twin_registry().record_measured(
+            "goodput.goodput_frac", self.goodput_frac(),
+            source="resilience/goodput.GoodputTracker",
+        )
         return {
             "steps": self.steps,
             "nan_skips": self.nan_skips,
@@ -127,6 +135,12 @@ def goodput_accounting(
     lost_s_per_preemption = interval_s / 2.0 + restart_overhead_s
     lost_frac = min(1.0, rate_per_s * lost_s_per_preemption)
     goodput = max(0.0, (1.0 - lost_frac) / (1.0 + ckpt_overhead_frac))
+    from ..telemetry import twin_registry
+
+    twin_registry().record_predicted(
+        "goodput.goodput_frac", goodput,
+        source="resilience/goodput.goodput_accounting",
+    )
     return {
         "step_time_s": step_time_s,
         "ckpt_interval_steps": ckpt_interval_steps,

@@ -38,7 +38,8 @@ from ..analysis.compiled_audit import install_global_compile_counter
 from ..generation import GenerationConfig, sample_logits
 from ..models.llama import init_paged_cache
 from ..resilience import faults as _faults
-from ..utils.dataclasses import ServingPlugin
+from ..telemetry import RequestTracer
+from ..utils.dataclasses import ServingPlugin, TelemetryPlugin
 from .paged_cache import allocate, pages_for, release
 from .scheduler import ContinuousBatchingScheduler, Request
 
@@ -215,7 +216,7 @@ class ServingEngine:
 
     def __init__(self, model, params, plugin: Optional[ServingPlugin] = None,
                  generation_config: Optional[GenerationConfig] = None, rng=None,
-                 adapters=None):
+                 adapters=None, telemetry: Optional[TelemetryPlugin] = None):
         self.plugin = plugin or ServingPlugin()
         self.gen_config = generation_config or GenerationConfig()
         if getattr(getattr(model, "config", None), "scan_layers", False):
@@ -253,6 +254,14 @@ class ServingEngine:
             adapters.plugin.kernel if adapters is not None else "auto",
         )
         self._base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # request-level trace spans (telemetry/spans.py): host-side only —
+        # zero added device syncs, no new compiled programs, tokens bitwise
+        # identical on or off (pinned by tests + the dryrun telemetry leg).
+        # A single attribute check per hook when off.
+        self.telemetry = telemetry or TelemetryPlugin()
+        self.trace: Optional[RequestTracer] = None
+        if self.telemetry.trace_requests:
+            self.enable_tracing()
         # recompile guard: compile events are counted process-wide (the
         # jax.monitoring backend-compile stream) and reported as a delta
         # from engine construction — after warmup() this must stay flat
@@ -276,6 +285,24 @@ class ServingEngine:
         }
         self.ttft_s: list[float] = []
         self.token_gaps_s: list[float] = []
+
+    # -- telemetry -----------------------------------------------------------
+
+    def enable_tracing(self, clock=None, capacity: Optional[int] = None) -> RequestTracer:
+        """Arm request-level trace spans (idempotent unless ``clock`` or
+        ``capacity`` is passed, which installs a fresh tracer).  ``clock``
+        injects a deterministic timestamp source
+        (:class:`~accelerate_tpu.telemetry.VirtualClock`) for tests; the
+        default is wall ``perf_counter``.  Host-side only — arming this
+        changes no token and compiles no program."""
+        if self.trace is None or clock is not None or capacity is not None:
+            self.trace = RequestTracer(
+                capacity=capacity or self.telemetry.ring_capacity, clock=clock,
+            )
+        return self.trace
+
+    def disable_tracing(self) -> None:
+        self.trace = None
 
     # -- request lifecycle ---------------------------------------------------
 
@@ -373,7 +400,14 @@ class ServingEngine:
         return self._compile_counter.count - self._compile_baseline
 
     def step(self) -> dict:
-        """One scheduler decision + at most one device program."""
+        """One scheduler decision + at most one device program.
+
+        With tracing on (:attr:`trace`) the tick records its phase spans —
+        ``schedule`` (admission + the scheduler decision), ``dispatch:*``
+        (the async device-program call) and ``host_sync`` (the token fetch)
+        — plus the per-request lifecycle spans derived from the scheduler's
+        event log.  All host-side: the device programs are identical."""
+        tr = self.trace
         for ev in _faults.fault_point("serve_step"):
             if ev.kind == "preempt":
                 # drain: stop taking work, hand every in-flight request back
@@ -381,8 +415,12 @@ class ServingEngine:
                 # boundary stop; resilience/preemption.py discipline)
                 self.interrupted = True
                 return {"type": "preempted", "step": self.steps}
+        t_sched = tr.stamp() if tr is not None else 0.0
         self.sched.admit()
         action = self.sched.next_action()
+        if tr is not None:
+            tr.phase("schedule", t_sched, action=action[0], step=self.steps)
+        window = None
         event: dict = {"type": action[0], "step": self.steps}
         if action[0] == "prefill":
             _, slot, start, chunk, bucket = action
@@ -392,12 +430,16 @@ class ServingEngine:
                 st = self.sched.slots[slot]
                 ids = np.zeros((bucket,), np.int32)
                 ids[:chunk] = st.request.prompt[start:start + chunk]
+                t_disp = tr.stamp() if tr is not None else 0.0
                 cache, last = self._run_prefill(
                     jnp.asarray(slot, jnp.int32),
                     jnp.asarray(ids), jnp.asarray(start, jnp.int32),
                     jnp.asarray(chunk, jnp.int32),
                     jnp.asarray(st.adapter_slot, jnp.int32),
                 )
+                if tr is not None:
+                    tr.phase("dispatch:prefill", t_disp, slot=slot,
+                             chunk=chunk, bucket=bucket, step=self.steps)
                 self.cache = cache
                 self.sched.note_prefill(slot, chunk)
                 m = self.metrics
@@ -409,9 +451,14 @@ class ServingEngine:
                 if st.prefill_done:
                     # the prompt's last-token logits seed the decode loop —
                     # the first generated token, exactly like generate()
+                    t_sync = tr.stamp() if tr is not None else 0.0
                     tok = int(self._sample(last, self._step_rng()))
+                    if tr is not None:
+                        tr.phase("host_sync", t_sync, step=self.steps)
                     m["generated_tokens"] += 1
                     self._record_token(slot, tok)
+                if tr is not None:
+                    window = (t_disp, tr.recorder.clock())
             else:
                 event["cancelled"] = True
         elif action[0] == "decode":
@@ -427,13 +474,21 @@ class ServingEngine:
                     tokens[s] = self.sched.slots[s].tokens[-1]
                     active[s] = True
                     adapter_slots[s] = self.sched.slots[s].adapter_slot
+                t_disp = tr.stamp() if tr is not None else 0.0
                 cache, next_tok = self._run_decode(
                     jnp.asarray(tokens), jnp.asarray(active),
                     jnp.asarray(adapter_slots), self._step_rng(),
                 )
+                if tr is not None:
+                    tr.phase("dispatch:decode", t_disp,
+                             slots=list(active_slots), step=self.steps)
                 self.cache = cache
                 self.sched.note_decode(needing)
+                t_sync = tr.stamp() if tr is not None else 0.0
                 next_np = np.asarray(next_tok)
+                if tr is not None:
+                    tr.phase("host_sync", t_sync, step=self.steps)
+                    window = (t_disp, tr.recorder.clock())
                 done_slots = []
                 for s in active_slots:
                     if self._record_token(s, int(next_np[s]), release=False):
@@ -454,6 +509,11 @@ class ServingEngine:
         used = self.sched.used_pages
         self.metrics["page_step_sum"] += used
         self.metrics["peak_used_pages"] = max(self.metrics["peak_used_pages"], used)
+        if tr is not None:
+            # lifecycle spans off the scheduler's deterministic event log
+            # (submit/admit/swap/bypass/prefill/evict/finish this tick)
+            tr.consume_scheduler_events(self.sched.events, self.steps,
+                                        window=window)
         self.steps += 1
         return event
 

@@ -125,7 +125,8 @@ def predicted_pool_utilization(trace: list[Request], *, num_slots: int,
     return round(page_step_sum / max(steps, 1) / num_pages, 4)
 
 
-def replay(engine, trace: list[Request], *, strict_compiles: bool = True) -> dict:
+def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
+           slo_monitor=None) -> dict:
     """Run the trace through the engine and compose the serving report.
     Every field is always present (zeros on an empty/idle trace).
 
@@ -137,11 +138,22 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True) -> dic
     eat under traffic.  With ``strict_compiles`` (default) the harness
     fails its report loudly in that case instead of publishing numbers a
     recompile stall just poisoned.
+
+    Telemetry: the serving twins (KV-pool utilization, adapter-pool hit
+    rate, steady-state compiles) are recorded into the central
+    :func:`~accelerate_tpu.telemetry.twin_registry`; with the engine's
+    request tracing on (``ServingEngine.trace``) the report's
+    ``telemetry_overhead_frac``/``trace_spans`` fields are measured (zeros
+    otherwise — tracing off costs nothing and changes no token).  Pass an
+    :class:`~accelerate_tpu.telemetry.SLOMonitor` as ``slo_monitor`` to
+    feed it the replay's per-token latency and TTFT samples.
     """
     import time
 
     compiles_warmup = engine.warmup() if not engine.warmed_up else 0
     compiles_before = engine.compile_events
+    tracer = getattr(engine, "trace", None)
+    overhead_before = tracer.recorder.overhead_s if tracer is not None else 0.0
     t0 = time.perf_counter()
     results = engine.run(trace)
     wall_s = time.perf_counter() - t0
@@ -169,6 +181,29 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True) -> dic
         page_size=p.page_size, pages_per_slot=p.pages_per_slot,
         prefill_chunk=p.prefill_chunk,
     )
+    measured_util = round(m["page_step_sum"] / max(total_steps, 1) / p.num_pages, 4)
+    # the serving rows of the central twin registry (telemetry/twins.py);
+    # bench --serve renders registry.drift_report() as the `twins` block
+    from ..telemetry import twin_registry
+
+    reg = twin_registry()
+    reg.record("kv_pool.utilization", predicted=predicted_util,
+               measured=measured_util, source="serving/harness.replay")
+    reg.record("compiles.steady_state", predicted=0,
+               measured=compiles_measured, source="serving/harness.replay")
+    if slo_monitor is not None:
+        slo_monitor.observe_many("token_latency_s", engine.token_gaps_s)
+        slo_monitor.observe_many("ttft_s", engine.ttft_s)
+    # overhead as THIS replay's recording cost over THIS replay's wall (a
+    # reused traced engine's earlier overhead must not inflate the ratio)
+    overhead_s = (tracer.recorder.overhead_s - overhead_before
+                  if tracer is not None else 0.0)
+    telemetry_fields = {
+        "telemetry_overhead_frac": (
+            round(min(1.0, overhead_s / wall_s), 6) if wall_s > 0 else 0.0
+        ),
+        "trace_spans": tracer.recorder.recorded if tracer is not None else 0,
+    }
     return {
         "requests": len(trace),
         "completed": len(results),
@@ -181,8 +216,7 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True) -> dic
         "p50_token_latency_ms": _percentile_ms(engine.token_gaps_s, 50),
         "p99_token_latency_ms": _percentile_ms(engine.token_gaps_s, 99),
         "ttft_p50_ms": _percentile_ms(engine.ttft_s, 50),
-        "kv_pool_utilization": round(
-            m["page_step_sum"] / max(total_steps, 1) / p.num_pages, 4),
+        "kv_pool_utilization": measured_util,
         "kv_pool_utilization_predicted": predicted_util,
         "kv_pool_peak_utilization": round(m["peak_used_pages"] / p.num_pages, 4),
         "padding_waste_frac": round(1.0 - useful / scheduled, 4) if scheduled else 0.0,
@@ -201,6 +235,7 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True) -> dic
         "compiles_measured": compiles_measured,
         "compiles_warmup": compiles_warmup,
         "programs_predicted": len(p.prefill_buckets) + 3,  # + decode/release/sampler
+        **telemetry_fields,
         # multi-tenant adapter fields — ALWAYS present (zeros without an
         # AdapterStore), with the predicted/measured pool-hit-rate twins
         **_adapter_fields(engine, trace),
@@ -223,17 +258,21 @@ def _adapter_fields(engine, trace: list[Request]) -> dict:
             "adapter_pool_hit_rate_predicted": 0.0,
             "adapter_swaps": 0, "adapter_swap_bytes": 0,
         }
+    from ..telemetry import twin_registry
     from .adapters import predicted_adapter_hit_rate
 
+    predicted_hit = predicted_adapter_hit_rate(tenant_ids, store.plugin.pool_slots)
+    twin_registry().record(
+        "adapter_pool.hit_rate", predicted=predicted_hit,
+        measured=store.hit_rate(), source="serving/harness._adapter_fields",
+    )
     return {
         "adapters": len({t for t in tenant_ids if t}),
         "adapter_requests": sum(1 for t in tenant_ids if t),
         "adapter_pool_slots": store.plugin.pool_slots,
         "lora_rank": store.plugin.rank,
         "adapter_pool_hit_rate": store.hit_rate(),
-        "adapter_pool_hit_rate_predicted": predicted_adapter_hit_rate(
-            tenant_ids, store.plugin.pool_slots
-        ),
+        "adapter_pool_hit_rate_predicted": predicted_hit,
         "adapter_swaps": store.swaps,
         "adapter_swap_bytes": store.swap_bytes,
     }

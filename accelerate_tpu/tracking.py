@@ -11,6 +11,7 @@ backend)."""
 
 from __future__ import annotations
 
+import atexit
 import functools
 import json
 import os
@@ -81,7 +82,18 @@ class GeneralTracker(ABC):
 
 
 class JSONLTracker(GeneralTracker):
-    """Dependency-free metrics log: one JSON object per line."""
+    """Dependency-free metrics log: one JSON object per line.
+
+    Torn-line hardening (the checkpointing atomicity discipline applied to
+    metrics): each record is serialized in full, then handed to the kernel
+    as ONE unbuffered ``write`` on a persistent append handle — never
+    streamed through a userspace buffer a crash could flush halfway.  An
+    ``atexit`` close backs the handle; a killed run's file therefore
+    contains only complete, parseable lines (pinned by the killed-
+    subprocess witness in tests/test_observability.py).  This is also the
+    always-available telemetry sink: ``Accelerator.log(
+    twin_registry().flat_metrics())`` lands the twin/SLO tables here with
+    no extra dependency."""
 
     name = "jsonl"
     requires_logging_directory = True
@@ -93,7 +105,25 @@ class JSONLTracker(GeneralTracker):
         self.dir = Path(logging_dir or ".") / run_name
         self.dir.mkdir(parents=True, exist_ok=True)
         self.path = self.dir / "metrics.jsonl"
+        # buffering=0: one os-level write per log line (whole-line or
+        # nothing under any kill signal for sane line sizes)
+        self._fh = open(self.path, "ab", buffering=0)
+        atexit.register(self._close)
         self._tracker = self
+
+    def _close(self):
+        # drop the atexit entry too: it holds a strong reference to this
+        # tracker, and a long-lived service creating per-run trackers must
+        # not accumulate dead ones until process exit
+        atexit.unregister(self._close)
+        fh, self._fh = getattr(self, "_fh", None), None
+        if fh is not None and not fh.closed:
+            try:
+                fh.flush()
+                os.fsync(fh.fileno())
+            except OSError:
+                pass  # close() below still runs; the write already hit the kernel
+            fh.close()
 
     @on_main_process
     def store_init_configuration(self, values: dict):
@@ -102,8 +132,16 @@ class JSONLTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         record = {"_step": step, "_time": time.time(), **values}
-        with open(self.path, "a") as f:
-            f.write(json.dumps(record, default=float) + "\n")
+        line = (json.dumps(record, default=float) + "\n").encode()
+        if self._fh is None or self._fh.closed:  # post-finish stragglers
+            with open(self.path, "ab", buffering=0) as f:
+                f.write(line)
+            return
+        self._fh.write(line)
+
+    @on_main_process
+    def finish(self):
+        self._close()
 
 
 class TensorBoardTracker(GeneralTracker):

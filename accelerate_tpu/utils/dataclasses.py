@@ -684,6 +684,69 @@ class PreflightConfig(KwargsHandler):
 
 
 @dataclass
+class TelemetryPlugin(KwargsHandler):
+    """Unified-telemetry knobs (engine: ``accelerate_tpu/telemetry/`` —
+    twin registry, request trace spans, training timeline, SLO monitors;
+    see docs/observability.md).
+
+    Telemetry is host-side only: on or off, serving tokens and training
+    loss are bitwise identical and no new program compiles (pinned by
+    tests and the multichip dryrun ``_telemetry_leg``); the measured
+    recording cost is reported as ``telemetry_overhead_frac``.  Every knob
+    reads an ``ACCELERATE_TELEMETRY*`` environment default in
+    ``__post_init__`` (explicit arguments win — the plugin contract).
+    """
+
+    enabled: Optional[bool] = None           # master switch: arms timeline +
+                                             # request tracing defaults (env
+                                             # ACCELERATE_TELEMETRY, default off)
+    trace_requests: Optional[bool] = None    # per-request lifecycle spans on the
+                                             # serving engine (env
+                                             # ACCELERATE_TELEMETRY_TRACE_REQUESTS,
+                                             # else `enabled`)
+    timeline: Optional[bool] = None          # training step timeline on the
+                                             # Accelerator (env
+                                             # ACCELERATE_TELEMETRY_TIMELINE,
+                                             # else `enabled`)
+    ring_capacity: Optional[int] = None      # span ring-buffer size per recorder
+                                             # (bounded memory; env
+                                             # ACCELERATE_TELEMETRY_RING, default 4096)
+    slo: Optional[dict] = None               # SLOMonitor thresholds, e.g.
+                                             # {"ttft_s": {"p99_warn": 0.5,
+                                             #  "p99_trip": 2.0}} — None: no monitor
+    export_dir: Optional[str] = None         # where end-of-run Chrome traces land
+                                             # (env ACCELERATE_TELEMETRY_DIR;
+                                             # None: export only on request)
+
+    def __post_init__(self):
+        env = os.environ
+        if self.enabled is None:
+            self.enabled = parse_flag_from_env("ACCELERATE_TELEMETRY")
+        if self.trace_requests is None:
+            self.trace_requests = parse_flag_from_env(
+                "ACCELERATE_TELEMETRY_TRACE_REQUESTS", default=self.enabled
+            )
+        if self.timeline is None:
+            self.timeline = parse_flag_from_env(
+                "ACCELERATE_TELEMETRY_TIMELINE", default=self.enabled
+            )
+        if self.ring_capacity is None:
+            self.ring_capacity = int(env.get("ACCELERATE_TELEMETRY_RING", 4096))
+        if self.ring_capacity < 1:
+            raise ValueError(
+                f"TelemetryPlugin.ring_capacity must be >= 1, got "
+                f"{self.ring_capacity}"
+            )
+        if self.export_dir is None:
+            self.export_dir = env.get("ACCELERATE_TELEMETRY_DIR") or None
+        if self.slo is not None and not isinstance(self.slo, dict):
+            raise ValueError(
+                f"TelemetryPlugin.slo must be a thresholds dict, got "
+                f"{type(self.slo).__name__}"
+            )
+
+
+@dataclass
 class TensorParallelConfig(KwargsHandler):
     """reference TorchTensorParallelConfig dataclasses.py:2264.
 

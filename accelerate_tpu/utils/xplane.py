@@ -301,6 +301,15 @@ def streaming_overlap_report(trace_dir: str, device_substr: str = "TPU",
     async_ms = br["_async_copy_ms"]
     denom = total or 1.0
     all_copy = copy_inline + async_ms
+    # twin registry: MEASURED overlap (predicted side:
+    # ops/streaming.offload_transfer_accounting)
+    from ..telemetry import twin_registry
+
+    twin_registry().record_measured(
+        "offload_transfer.overlap_frac",
+        async_ms / all_copy if all_copy else 0.0,
+        source="utils/xplane.streaming_overlap_report",
+    )
     return {
         "total_ms": total,
         "steps_ms": br["_steps_ms"],
@@ -352,6 +361,15 @@ def ici_overlap_report(trace_dir: str, device_substr: str = "TPU",
     async_ms = async_collective_ms(trace_dir, device_substr)
     denom = total or 1.0
     all_coll = inline + async_ms
+    # twin registry: MEASURED hidden fraction (predicted side:
+    # ops/collective_matmul.tp_comm_accounting)
+    from ..telemetry import twin_registry
+
+    twin_registry().record_measured(
+        "tp_comm.overlap_frac",
+        async_ms / all_coll if all_coll else 0.0,
+        source="utils/xplane.ici_overlap_report",
+    )
     return {
         "total_ms": total,
         "collective_ms_inline": round(inline, 3),

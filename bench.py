@@ -6,12 +6,33 @@ Metric is tokens/sec/chip for a bf16 Llama-family causal-LM train step
 (flash-attention Pallas kernel, donated buffers, fused optimizer under one
 jit).  ``vs_baseline`` is measured MFU / 0.45 — the BASELINE.json north-star
 MFU target for the reference's TPU path ("Llama fine-tune at >=45% MFU").
+
+Every report carries ``schema_version`` (bumped when field semantics
+change), the unified ``twins`` block (telemetry/twins.py: every registered
+predicted/measured pair with per-twin rel_err and drift status — the
+canonical seven are always present, zeros-clean when idle), and the
+measured ``telemetry_overhead_frac`` (0.0 with telemetry off; telemetry
+on/off never changes a token or the loss).
 """
 
 import json
 import time
 
 import numpy as np
+
+# bump when a report field's meaning changes (BENCH_*.json consumers key
+# their cross-round comparisons on this)
+BENCH_SCHEMA_VERSION = 1
+
+
+def _twins_block() -> dict:
+    """The unified twins block: declare the canonical seven (zeros-clean),
+    then render everything the run recorded."""
+    from accelerate_tpu.telemetry import twin_registry
+
+    reg = twin_registry()
+    reg.declare_standard_twins()
+    return reg.drift_report()
 
 # Per-chip peak bf16 FLOP/s by TPU generation (public spec sheets).
 _PEAK_FLOPS = {
@@ -471,7 +492,17 @@ def serve_report(args) -> dict:
         for t in range(1, n_adapters + 1):
             store.publish_random(t, jax.random.PRNGKey(1000 + t))
     engine = ServingEngine(model, params, plugin, gen_cfg, adapters=store)
+    trace_out = getattr(args, "trace_requests", None)
+    if trace_out is not None:
+        # request-level lifecycle + step-phase spans (telemetry/spans.py):
+        # host-side only — tokens bitwise identical, strict_compiles still
+        # enforced by the replay below, overhead measured into
+        # telemetry_overhead_frac
+        engine.enable_tracing()
     rep = replay(engine, trace)
+    if trace_out is not None and trace_out != "-":
+        engine.trace.write_chrome_trace(trace_out)
+        rep["trace_file"] = trace_out
     # per-adapter-loop twin: the same requests served one tenant at a time
     # (what a per-adapter matmul loop forces) — the batched einsum keeps
     # every tenant in one fixed-shape program and must win on tokens/s
@@ -528,6 +559,15 @@ def serve_report(args) -> dict:
     rep["backend"] = jax.default_backend()
     rep["device"] = getattr(jax.devices()[0], "device_kind", "?")
     rep["n_devices"] = jax.device_count()
+    rep["schema_version"] = BENCH_SCHEMA_VERSION
+    # the goodput twin's serve-side clean-run model: no faults injected, so
+    # the prediction is 1.0 (replay() recorded the kv/adapter/compiles rows)
+    from accelerate_tpu.telemetry import twin_registry
+
+    twin_registry().record_predicted(
+        "goodput.goodput_frac", 1.0, source="bench.serve clean-run model"
+    )
+    rep["twins"] = _twins_block()
     return {
         "metric": "serving_tokens_per_sec_per_chip",
         "value": rep["tokens_per_sec_per_chip"],
@@ -644,6 +684,23 @@ def main():
     ap.add_argument("--serve-seed", type=int, default=0,
                     help="trace seed for --serve (same seed -> same trace "
                          "-> same schedule, pinned by the determinism test)")
+    ap.add_argument("--trace-requests", nargs="?", const="-", default=None,
+                    metavar="FILE",
+                    help="with --serve: record request-level lifecycle spans "
+                         "(submit/admit/prefill-chunk/decode/evict/retire) + "
+                         "per-step phase spans into the engine's bounded "
+                         "ring (telemetry/spans.py) and, with FILE, export "
+                         "Chrome trace-event JSON (Perfetto-loadable).  "
+                         "Host-side only: tokens are bitwise identical and "
+                         "strict_compiles still passes; the measured cost "
+                         "lands in telemetry_overhead_frac")
+    ap.add_argument("--telemetry", choices=["on", "off"], default="off",
+                    help="train bench: arm the training step timeline "
+                         "(telemetry/timeline.py — data_wait/h2d_staging/"
+                         "step_dispatch/guard_sync/checkpoint_drain phase "
+                         "spans) and report its summary + measured "
+                         "telemetry_overhead_frac.  Loss is bitwise "
+                         "identical on or off")
     ap.add_argument("--adapters", type=int, default=0, metavar="N",
                     help="with --serve: multi-tenant batched LoRA — N tenants' "
                          "adapters share the base model via one gathered einsum "
@@ -680,6 +737,7 @@ def main():
                                      offload=args.offload,
                                      optimizer=args.optimizer or "lion-sr"),
             }
+        rep["extra"]["schema_version"] = BENCH_SCHEMA_VERSION
         if args.audit:
             from accelerate_tpu.analysis import Report, apply_suppressions
             from accelerate_tpu.commands.lint import audit_canonical_step
@@ -927,11 +985,17 @@ def main():
             raise SystemExit("--dcn-compress on needs --dcn-slices > 1 "
                              "(no dcn mesh axis, nothing crosses DCN)")
         pcfg = ParallelismConfig(dp_shard_size=n_dev)
+    from accelerate_tpu.utils.dataclasses import TelemetryPlugin
+
+    telemetry_on = args.telemetry == "on"
     acc = Accelerator(
         parallelism_config=pcfg,
         mixed_precision=args.precision,
         fsdp_plugin=fsdp_plugin,
         kwargs_handlers=handlers,
+        telemetry_plugin=TelemetryPlugin(
+            enabled=telemetry_on, timeline=telemetry_on, trace_requests=False,
+        ),
     )
     # ring collective-matmul mode: installed AFTER the accelerator so the
     # bench flag wins over the plugin/env default; trace-time — the train
@@ -1216,6 +1280,30 @@ def main():
     }
     extra_report["goodput"] = goodput
 
+    # Unified telemetry (telemetry/): schema_version + twins +
+    # telemetry_overhead_frac are ALWAYS emitted — zeros-clean when nothing
+    # recorded, measured when --telemetry on armed the training timeline.
+    # The accounting calls above already recorded their predicted sides;
+    # the twin registry renders them with per-twin rel_err/status.
+    from accelerate_tpu.telemetry import twin_registry
+
+    reg = twin_registry()
+    reg.record("compiles.steady_state", predicted=0,
+               measured=compiles_measured, source="bench.train steady-state")
+    # clean-run goodput model: no faults injected in a bench run, predicted
+    # retention is 1.0 (goodput_accounting covers cadence-model predictions)
+    reg.record_predicted("goodput.goodput_frac", 1.0,
+                         source="bench.train clean-run model")
+    telemetry_fields = {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "telemetry_overhead_frac": (
+            acc.timeline.overhead_frac(dt) if acc.timeline is not None else 0.0
+        ),
+        "twins": _twins_block(),
+    }
+    if acc.timeline is not None:
+        extra_report["timeline"] = acc.timeline.summary()
+
     print(json.dumps({
         "metric": "llama_bf16_train_tokens_per_sec_per_chip",
         "value": round(per_chip, 1),
@@ -1227,6 +1315,7 @@ def main():
             "grad_dtype": extra_report.pop("grad_dtype", "fp32"),
             **overlap_fields,
             **resilience_fields,
+            **telemetry_fields,
             **extra_report,
             "precision": args.precision,
             "optimizer": args.optimizer,

@@ -72,6 +72,12 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "GeneralTracker", "JSONLTracker", "TensorBoardTracker", "WandBTracker",
         "MLflowTracker", "filter_trackers",
     ]),
+    "telemetry": ("accelerate_tpu.telemetry", [
+        "Twin", "TwinRegistry", "twin_registry", "SpanRecorder",
+        "RequestTracer", "VirtualClock", "validate_chrome_trace",
+        "TrainTimeline", "StreamingQuantile", "SLOMonitor", "SLOStatus",
+        "prometheus_text",
+    ]),
     "operations": ("accelerate_tpu.ops.operations", [
         "gather", "gather_object", "broadcast", "broadcast_object_list",
         "reduce", "pad_across_processes", "recursively_apply", "map_pytree",

@@ -1,0 +1,57 @@
+"""PLANTED telemetry-timing fixtures — clock deltas that measure async
+dispatch, not compute (GL109, INFO hint).
+
+jax dispatch is asynchronous: a ``perf_counter()`` delta closed before the
+jitted call's outputs materialize times the host-side enqueue, and the
+"speedup" it reports is an artifact.  Corrected twins:
+``clean_telemetry.py``.  Excluded from repo-wide sweeps like the rest of
+this directory.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated_step(x):
+    return jnp.tanh(x @ x)
+
+
+jitted_step = jax.jit(lambda x: x * 2.0)
+
+
+def times_async_dispatch(x):
+    # GL109: the delta closes with no materialization after the jitted call
+    t0 = time.perf_counter()
+    y = decorated_step(x)
+    dt = time.perf_counter() - t0
+    return y, dt
+
+
+def times_bound_jit_wrapper(x):
+    # GL109 through a `name = jax.jit(...)` binding (not a decorator)
+    start = time.monotonic()
+    out = jitted_step(x)
+    elapsed = time.monotonic() - start
+    return out, elapsed
+
+
+def times_inline_jit_call(x):
+    # GL109 with the jit wrapper constructed and called inline
+    t0 = time.perf_counter()
+    y = jax.jit(lambda v: v + 1)(x)
+    dt = time.perf_counter() - t0
+    return y, dt
+
+
+def materializes_before_the_last_dispatch(x):
+    # GL109: the float() sync covers the FIRST call only — the second
+    # jitted call is still in flight when the clock closes
+    t0 = time.perf_counter()
+    y = decorated_step(x)
+    float(y.sum())
+    z = decorated_step(y)
+    dt = time.perf_counter() - t0
+    return z, dt

@@ -58,6 +58,9 @@ def _reset_singletons():
     from accelerate_tpu.ops.lora import set_lora_kernel
 
     set_lora_kernel(None)        # clear any ambient LoRA kernel override
+    from accelerate_tpu.telemetry import twin_registry
+
+    twin_registry().reset()      # no twin values may leak across tests
 
 
 @pytest.fixture

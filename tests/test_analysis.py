@@ -587,6 +587,46 @@ def test_gl205_one_hop_name_resolution_and_scope():
     assert {f.rule for f in lint_source(swallow, "pkg/checkpoint_utils.py")} == {"GL205"}
 
 
+def test_fixture_telemetry_planted_gl109_fires():
+    """Every planted timing-without-block shape is individually caught: the
+    decorated jit, the `name = jax.jit(...)` binding, the inline
+    `jax.jit(f)(x)` call, and the materialize-before-the-LAST-dispatch
+    variant (the float() covers only the first call)."""
+    rep = lint_paths([FIXTURES / "planted_telemetry.py"], excludes=())
+    assert _rules_of(rep) == {"GL109"}, rep.render()
+    hits = [f for f in rep.unsuppressed() if f.rule == "GL109"]
+    assert len(hits) == 4, rep.render()
+    # INFO hint: flags the delta line, never fails a run
+    assert all(f.severity == Severity.INFO for f in hits)
+    assert rep.exit_code() == 0
+
+
+def test_fixture_telemetry_clean_twin_quiet():
+    """The corrected twins (block_until_ready / float fetch / np.asarray
+    before the closing clock read, plain host timing, jit outside the
+    window) stay quiet — the bench.py timed-loop idiom passes clean."""
+    rep = lint_paths([FIXTURES / "clean_telemetry.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
+
+
+def test_gl109_suppressible_with_rationale(tmp_path):
+    f = tmp_path / "timed.py"
+    f.write_text(
+        "import time\n"
+        "import jax\n"
+        "f = jax.jit(lambda x: x)\n"
+        "def g(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    y = f(x)\n"
+        "    # graft-lint: disable=GL109 -- fixture: dispatch latency is what this micro-bench measures\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return y, dt\n"
+    )
+    rep = lint_paths([f])
+    assert not rep.unsuppressed(), rep.render()
+    assert any(x.rule == "GL109" and x.suppressed for x in rep.findings)
+
+
 def test_fixtures_are_excluded_from_repo_sweeps_by_default():
     rep = lint_paths([FIXTURES])
     assert rep.findings == []

@@ -314,3 +314,86 @@ def test_bench_dcn_fields_always_emitted():
     assert comp["dcn_comm"]["compression"] == "powersgd"
     assert 0 < comp["dcn_bytes"] < dense["dcn_bytes"]
     assert comp["dcn_bytes_flat"] == dense["dcn_bytes_flat"]
+
+
+STANDARD_TWIN_NAMES = (
+    "offload_transfer.overlap_frac", "tp_comm.overlap_frac",
+    "dcn_comm.dcn_bytes", "kv_pool.utilization", "adapter_pool.hit_rate",
+    "goodput.goodput_frac", "compiles.steady_state",
+)
+
+
+@pytest.mark.slow
+def test_bench_telemetry_fields_always_emitted():
+    """schema_version / telemetry_overhead_frac / the unified twins block
+    ride EVERY bench report (train, serve and idle flavors), zeros-clean
+    when nothing recorded — the always-emitted contract plus the canonical
+    seven twin rows with per-twin rel_err and drift status."""
+    rep = _run(["bench.py", "--iters", "2", "--batch", "8"])
+    extra = rep["extra"]
+    assert extra["schema_version"] == 1
+    assert extra["telemetry_overhead_frac"] == 0.0  # telemetry off: free
+    twins = extra["twins"]
+    for name in STANDARD_TWIN_NAMES:
+        assert name in twins, name
+        row = twins[name]
+        assert set(row) >= {"predicted", "measured", "rel_err", "status",
+                            "units", "tolerance"}, row
+        assert row["status"] in ("idle", "ok", "warn", "error")
+    # the clean train run: goodput + compiles twins agree exactly
+    assert twins["goodput.goodput_frac"]["status"] == "ok"
+    assert twins["compiles.steady_state"]["rel_err"] == 0.0
+    # subsystems the run never touched stay zeros-clean idle rows
+    assert twins["kv_pool.utilization"]["status"] == "idle"
+    assert twins["kv_pool.utilization"]["measured"] == 0.0
+
+    # --telemetry on: the timeline summary + a measured overhead fraction,
+    # and the loss is bitwise identical to the telemetry-off run
+    rep_t = _run(["bench.py", "--iters", "2", "--batch", "8",
+                  "--telemetry", "on"])
+    extra_t = rep_t["extra"]
+    assert extra_t["timeline"]["step_dispatch"]["count"] > 0
+    assert 0.0 <= extra_t["telemetry_overhead_frac"] < 0.5
+    assert extra_t["loss"] == extra["loss"]
+
+    # serve flavor: same contract, kv-pool twin populated by the replay
+    rep_s = _run(["bench.py", "--serve", "--batch", "8"])
+    extra_s = rep_s["extra"]
+    assert extra_s["schema_version"] == 1
+    assert extra_s["telemetry_overhead_frac"] == 0.0  # tracing off
+    assert extra_s["trace_spans"] == 0
+    s_twins = extra_s["twins"]
+    for name in STANDARD_TWIN_NAMES:
+        assert name in s_twins, name
+    assert s_twins["kv_pool.utilization"]["measured"] > 0
+    assert s_twins["compiles.steady_state"]["status"] == "ok"
+
+
+@pytest.mark.slow
+def test_bench_serve_trace_requests(tmp_path):
+    """--serve --trace-requests FILE: the exported Chrome trace validates,
+    spans were recorded, overhead is measured, and the serving numbers
+    (tokens, schedule, compiles) are identical to the untraced run of the
+    same seeded trace (telemetry is bitwise-invisible)."""
+    from accelerate_tpu.telemetry import validate_chrome_trace
+
+    trace_file = str(tmp_path / "serve_trace.json")
+    rep = _run(["bench.py", "--serve", "--batch", "8",
+                "--trace-requests", trace_file])
+    extra = rep["extra"]
+    assert extra["trace_spans"] > 0
+    assert extra["telemetry_overhead_frac"] > 0.0
+    assert extra["trace_file"] == trace_file
+    chrome = json.loads(Path(trace_file).read_text())
+    assert validate_chrome_trace(chrome) == []
+    names = {e["name"] for e in chrome["traceEvents"] if e["ph"] != "M"}
+    assert {"submit", "queued", "admit", "prefill_chunk", "retire",
+            "schedule", "host_sync"} <= names
+    # tracing never compiled a program mid-replay (strict_compiles held)
+    assert extra["compiles_measured"] == 0
+
+    rep_off = _run(["bench.py", "--serve", "--batch", "8"])
+    # same seeded trace, identical serving outcome fields
+    for field in ("generated_tokens", "prompt_tokens", "engine_steps",
+                  "decode_steps", "prefill_steps", "evictions", "completed"):
+        assert extra[field] == rep_off["extra"][field], field

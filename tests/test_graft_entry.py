@@ -65,3 +65,9 @@ def test_launch_leg():
     info = graft._launch_leg()
     assert "bitwise parity ok" in info
     assert "resume@1proc" in info and "exact" in info
+
+
+@pytest.mark.slow
+def test_telemetry_leg():
+    info = graft._telemetry_leg(np.random.default_rng(0))
+    assert "tokens bitwise" in info and "schema valid" in info

@@ -31,6 +31,74 @@ def test_jsonl_tracker_roundtrip(tmp_path):
     assert lines[1]["_step"] == 1
 
 
+def test_jsonl_tracker_survives_sigkill_without_torn_lines(tmp_path):
+    """The torn-line hardening witness: a writer subprocess is SIGKILLed
+    mid-stream, and EVERY line in the survivor file must still parse as a
+    complete JSON record (whole-line unbuffered writes + atexit close — the
+    checkpointing atomicity discipline applied to metrics).  Lines may be
+    missing at the tail; none may be torn."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    script = (
+        "import sys\n"
+        "from accelerate_tpu.tracking import JSONLTracker\n"
+        "t = JSONLTracker('killed', logging_dir=sys.argv[1])\n"
+        "print('ready', flush=True)\n"
+        "i = 0\n"
+        "while True:\n"
+        "    t.log({'step_metric': i, 'payload': 'x' * 200}, step=i)\n"
+        "    i += 1\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script, str(tmp_path)],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "ready"
+        path = tmp_path / "killed" / "metrics.jsonl"
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if path.exists() and path.stat().st_size > 20_000:
+                break
+            _time.sleep(0.01)
+        else:
+            raise AssertionError("writer never produced enough lines")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    raw = path.read_bytes()
+    lines = raw.split(b"\n")
+    assert len(lines) > 20
+    # a torn final line would fail json.loads; every line must be complete
+    for i, line in enumerate(lines):
+        if not line:
+            continue
+        rec = json.loads(line)
+        assert rec["step_metric"] == rec["_step"]
+    # the file ends ON a line boundary (the last byte written was a full
+    # record's newline — nothing half-flushed)
+    assert raw.endswith(b"\n")
+
+
+def test_jsonl_tracker_logs_after_finish(tmp_path):
+    """Stragglers after finish() still land (reopen-per-line fallback) —
+    end_training followed by a late log must not crash or tear."""
+    tracker = JSONLTracker("late", logging_dir=str(tmp_path))
+    tracker.log({"a": 1}, step=0)
+    tracker.finish()
+    tracker.log({"a": 2}, step=1)
+    lines = [json.loads(l) for l in
+             (tmp_path / "late" / "metrics.jsonl").read_text().splitlines()]
+    assert [l["a"] for l in lines] == [1, 2]
+
+
 def test_accelerator_tracker_glue(tmp_path):
     acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
     acc.init_trackers("proj", config={"bs": 8})
