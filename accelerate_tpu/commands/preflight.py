@@ -12,7 +12,9 @@ discipline that keeps them stable —
    train step through the real ``prepare_train_step`` machinery
    (``--train``), and the serving ladder (``--serve``): one prefill per
    ``ServingPlugin.prefill_buckets`` entry plus the decode and release
-   programs, exactly ``len(buckets) + 2`` executables;
+   programs, exactly ``len(buckets) + 2`` executables — plus one
+   speculative verify program per ``speculate_buckets`` entry when
+   ``ACCELERATE_SERVE_SPECULATE`` is on;
 3. the compiled audit of each executable: GL301 donation-not-aliased,
    GL302 HBM-over-budget (``--hbm-gb`` or the backend's measured limit),
    GL303 program count vs the predicted bucket ladder, plus the per-program
@@ -60,7 +62,8 @@ def preflight_command_parser(subparsers=None) -> argparse.ArgumentParser:
         help="preflight the serving ladder: one prefill program per "
              "ServingPlugin.prefill_buckets entry (ACCELERATE_SERVE_* env "
              "sets the geometry) + decode + release — exactly "
-             "len(buckets)+2 executables",
+             "len(buckets)+2 executables (+ one speculative verify program "
+             "per speculate bucket when ACCELERATE_SERVE_SPECULATE is on)",
     )
     parser.add_argument(
         "--train", action="store_true",
@@ -188,7 +191,11 @@ def _serve_setup():
 def preflight_serve(config: PreflightConfig, hbm_budget_bytes=None,
                     model=None, plugin=None, gen_config=None):
     """AOT-compile and audit the serving ladder: one prefill per bucket +
-    decode + release (exactly ``len(prefill_buckets) + 2`` programs).
+    decode + release (exactly ``len(prefill_buckets) + 2`` programs), plus
+    — when ``ServingPlugin.speculate`` is on (``ACCELERATE_SERVE_SPECULATE``)
+    — one speculative **verify** program per ``speculate_buckets`` entry, so
+    GL301-303 and the compile-count prediction hold for a speculative
+    deploy exactly as for a plain one.
 
     Everything compiles from ``ShapeDtypeStruct`` stand-ins — the params
     and the KV pool are never allocated, so a production-sized ladder
@@ -211,7 +218,7 @@ def preflight_serve(config: PreflightConfig, hbm_budget_bytes=None,
     # fresh wrappers on purpose: an engine-shared wrapper may hold an
     # executable deserialized from the persistent cache, which has no
     # donation alias table (every donation would read as GL301)
-    decode, prefill, release, _sample = fresh_engine_jits(
+    decode, prefill, release, _sample, verify = fresh_engine_jits(
         model, gen_config, p.page_size
     )
 
@@ -239,6 +246,15 @@ def preflight_serve(config: PreflightConfig, hbm_budget_bytes=None,
             (params_sds, cache_sds, sds((), jnp.int32), sds((bucket,), jnp.int32),
              sds((), jnp.int32), sds((), jnp.int32)),
         ))
+    expected = len(p.prefill_buckets) + 2
+    if p.speculate != "off":
+        for bucket in p.speculate_buckets:
+            specs.append((
+                f"verify[{bucket}]", verify,
+                (params_sds, cache_sds, sds((n, bucket + 1), jnp.int32),
+                 sds((n,), jnp.int32), sds((n,), jnp.bool_), rng_sds),
+            ))
+        expected += len(p.speculate_buckets)
 
     findings, rows, events = [], [], 0
     for label, jitted, args in specs:
@@ -248,7 +264,7 @@ def preflight_serve(config: PreflightConfig, hbm_budget_bytes=None,
         findings += f
         rows += r
     findings += audit_program_set(
-        rows, len(p.prefill_buckets) + 2, measured_compile_events=events
+        rows, expected, measured_compile_events=events
     )
     return findings, rows
 

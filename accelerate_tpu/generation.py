@@ -211,6 +211,10 @@ def generate_paged(
     rng=None,
     adapters=None,
     adapter_ids=None,
+    speculate=None,
+    speculate_k: Optional[int] = None,
+    draft_model=None,
+    draft_params=None,
 ):
     """:func:`generate`-shaped decoding through the **paged serving path**
     (``accelerate_tpu/serving/``): the batch rows become requests, decode
@@ -228,7 +232,17 @@ def generate_paged(
     decode each row through its LoRA adapter — the per-request reference
     path the serve-with-adapters parity test pins the batched engine
     against.
+
+    Speculative decode: ``speculate="ngram"`` (prompt-lookup self-drafting)
+    or ``"draft"`` (pass ``draft_model``/``draft_params``) emits up to
+    ``speculate_k + 1`` tokens per verify pass — greedy tokens stay BITWISE
+    identical to :func:`generate` (the acceptance pin extends:
+    tests/test_speculate.py pins it, including under eviction/recompute
+    pressure and mixed LoRA tenant traffic).  ``speculate=True`` means
+    ``"ngram"``.
     """
+    import dataclasses as _dc
+
     from .serving import Request, ServingEngine
     from .utils.dataclasses import ServingPlugin
 
@@ -244,6 +258,13 @@ def generate_paged(
     else:
         adapter_ids = [int(x) for x in np.asarray(adapter_ids)]
     n_new = generation_config.max_new_tokens
+    # None = "not provided" (plugin/env decide); False is an EXPLICIT
+    # opt-out that must win over an env- or plugin-armed default, exactly
+    # like ServingPlugin(speculate=False)
+    if speculate is True:
+        speculate = "ngram"
+    elif speculate is False:
+        speculate = "off"
     if serving_plugin is None:
         # provision for the offline case: every row resident at once
         page_size = 16
@@ -251,9 +272,19 @@ def generate_paged(
         serving_plugin = ServingPlugin(
             num_slots=b, page_size=page_size, pages_per_slot=pages,
             num_pages=b * pages, prefill_chunk=max(16, t_prompt),
+            **({"speculate": speculate} if speculate is not None else {}),
+            **({"speculate_k": speculate_k} if speculate_k else {}),
+        )
+    elif speculate is not None or speculate_k:
+        serving_plugin = _dc.replace(
+            serving_plugin,
+            **({"speculate": speculate} if speculate is not None else {}),
+            **({"speculate_k": speculate_k, "speculate_buckets": None}
+               if speculate_k else {}),
         )
     engine = ServingEngine(model, params, serving_plugin, generation_config,
-                           rng=rng, adapters=adapters)
+                           rng=rng, adapters=adapters,
+                           draft_model=draft_model, draft_params=draft_params)
     for i in range(b):
         engine.add_request(Request(
             uid=i, prompt=tuple(int(x) for x in input_ids[i, : prompt_lengths[i]]),

@@ -379,12 +379,19 @@ class LlamaAttention(nn.Module):
         if cache is not None and "k_pages" in cache:
             # paged serving path (serving/): write this chunk's K/V through
             # the block table, then attend ragged against the gathered pages.
-            # Works for both serving shapes — batched decode ([S, 1]) and a
-            # single sequence's chunked prefill ([1, C]); liveness stays the
-            # positional kv_pos <= q_pos comparison of the dense path.
+            # Works for all three serving shapes — batched decode ([S, 1]),
+            # a single sequence's chunked prefill ([1, C]), and the batched
+            # speculative verify pass ([S, k+1]: multi-token paged append,
+            # every lane's write routed through its own block-table column);
+            # liveness stays the positional kv_pos <= q_pos comparison of
+            # the dense path.
             page_size = cache["k_pages"].shape[2]
             pos_i32 = positions.astype(jnp.int32)
-            logical_page = pos_i32 // page_size
+            # masked lanes (dead slots, prefill padding, rejected-draft
+            # headroom past spec_len) may carry positions beyond the block
+            # table — clamp the gather; the write itself is dropped below
+            logical_page = jnp.clip(pos_i32 // page_size, 0,
+                                    cache["block_tables"].shape[1] - 1)
             page_ids = jnp.take_along_axis(cache["block_tables"], logical_page, axis=1)
             if cache_write_mask is not None:
                 # masked tokens (dead slots, prefill padding) write nowhere:

@@ -626,6 +626,15 @@ def paged_decode_attention(
     live (dead slots simply mask everything and return zeros).  GQA runs
     without repeating K/V, like :func:`flash_attention`.  Returns
     ``[S, H, D]``.
+
+    T = 1 only by design: the speculative verify pass (``[S, k+1]`` — the
+    multi-token draft-and-verify window) takes the native ragged path
+    (``paged_gather_kv`` + ``cached_attention``), which is bitwise-exact to
+    the dense cache — the property the greedy-prefix acceptance pin rests
+    on.  A multi-token Pallas verify kernel would need the same
+    block-tables-as-scalar-prefetch treatment with a ``k+1``-wide query
+    tile; measure on a chip before writing it — at small k the verify op
+    stays HBM-bound on the page reads, exactly like decode.
     """
     s_slots, h, d = q.shape
     hkv, num_pages, page_size, _ = k_pages.shape

@@ -14,7 +14,11 @@ The production-inference rebuild of the reference's
 - :mod:`.adapters` — multi-tenant batched LoRA (ROADMAP item 2): the
   fixed-size device adapter pool with hot-swap streaming + LRU behind the
   segment-batched adapter matmul (``ops/lora.py``), and the per-adapter
-  fine-tuning trainer with host-resident optimizer state.
+  fine-tuning trainer with host-resident optimizer state;
+- :mod:`.speculate` — speculative multi-token decode (draft-and-verify):
+  n-gram/prompt-lookup self-drafting and draft-model providers feeding the
+  engine's fixed-shape batched verify program, with the model-free
+  predicted acceptance replay (the accept-rate twin).
 """
 
 from .adapters import (
@@ -31,8 +35,16 @@ from .harness import (
     static_batching_report,
     synthesize_trace,
 )
-from .paged_cache import allocate, kv_pool_accounting, pages_for, release
+from .paged_cache import allocate, kv_pool_accounting, pages_for, push_pages, release
 from .scheduler import ContinuousBatchingScheduler, Request, SlotState
+from .speculate import (
+    DraftModelDraft,
+    NgramDraft,
+    Speculator,
+    make_draft_provider,
+    predicted_acceptance,
+    speculative_page_need,
+)
 
 __all__ = [
     "ServingEngine",
@@ -46,8 +58,15 @@ __all__ = [
     "predicted_adapter_hit_rate",
     "allocate",
     "release",
+    "push_pages",
     "pages_for",
     "kv_pool_accounting",
+    "NgramDraft",
+    "DraftModelDraft",
+    "Speculator",
+    "make_draft_provider",
+    "predicted_acceptance",
+    "speculative_page_need",
     "synthesize_trace",
     "replay",
     "static_batching_report",

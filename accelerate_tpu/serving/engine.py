@@ -40,8 +40,9 @@ from ..models.llama import init_paged_cache
 from ..resilience import faults as _faults
 from ..telemetry import RequestTracer
 from ..utils.dataclasses import ServingPlugin, TelemetryPlugin
-from .paged_cache import allocate, pages_for, release
+from .paged_cache import allocate, pages_for, push_pages, release
 from .scheduler import ContinuousBatchingScheduler, Request
+from .speculate import Speculator, make_draft_provider, speculative_page_need
 
 
 def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
@@ -139,6 +140,76 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
         }
         return new_cache, last
 
+    def verify_step(params, lora_pool, cache, tokens, spec_len, active,
+                    adapter_slots, rng):
+        # speculative draft-and-verify: ONE fixed-shape pass of width
+        # w = bucket + 1 per active slot — lane 0 is the slot's last sampled
+        # token (the plain decode input), lanes 1..spec_len its draft
+        # proposals.  The pass (1) pops worst-case fresh pages for every
+        # page-start among its candidate positions (multi-token paged
+        # append: up to ceil(w/page)+1 block-table scatters per slot),
+        # (2) writes K/V for the live lanes and computes the greedy target
+        # token per lane through the same ragged paged attention the decode
+        # step uses, (3) accepts the longest greedy-matching draft prefix,
+        # and (4) rolls the pages past the accepted frontier back onto the
+        # functional free-list — all inside the one donated jitted program.
+        # Accepted tokens are BITWISE what sequential decode would emit.
+        seq_lens = cache["seq_lens"]
+        n, w = tokens.shape
+        lane = jnp.arange(w, dtype=jnp.int32)
+        positions = seq_lens[:, None] + lane[None, :]
+        live = active[:, None] & (lane[None, :] <= spec_len[:, None])
+        logical = positions // page_size
+        need = live & (positions % page_size == 0)
+        block_tables, free_top = allocate(
+            cache["block_tables"], cache["free_stack"], cache["free_top"],
+            jnp.repeat(jnp.arange(n, dtype=jnp.int32), w),
+            logical.reshape(-1), need.reshape(-1),
+        )
+        layer_caches = [
+            {"k_pages": l["k_pages"], "v_pages": l["v_pages"],
+             "block_tables": block_tables}
+            for l in cache["layers"]
+        ]
+        variables = {**params, "lora": lora_pool} if lora else params
+        kwargs = {"adapter_ids": adapter_slots} if lora else {}
+        logits, new_layers = apply(
+            variables, tokens, positions=positions,
+            cache=layer_caches, cache_write_mask=live, **kwargs,
+        )
+        # the exact sampling path decode uses (greedy: argmax over fp32) —
+        # the token-parity pin is this shared code path, not a reimplementation
+        greedy = sample_logits(
+            logits.reshape(n * w, logits.shape[-1]), rng, gen_config
+        ).reshape(n, w)
+        # longest greedy-matching prefix: draft j accepted iff it equals the
+        # target's token after consuming drafts 1..j-1 (greedy[:, j-1])
+        match = (tokens[:, 1:] == greedy[:, :-1]) & \
+            (lane[None, 1:] <= spec_len[:, None])
+        m = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        new_seq_lens = seq_lens + jnp.where(active, m + 1, 0)
+        # rollback: pages grabbed for positions past the accepted frontier
+        # return to the stack (their stale K/V is unreadable — the next pass
+        # rewrites any position before the positional mask can admit it)
+        give_back = need & (positions >= new_seq_lens[:, None])
+        pages = jnp.take_along_axis(
+            block_tables, jnp.clip(logical, 0, block_tables.shape[1] - 1),
+            axis=1,
+        )
+        free_stack, free_top = push_pages(
+            cache["free_stack"], free_top, pages.reshape(-1),
+            give_back.reshape(-1),
+        )
+        new_cache = {
+            "layers": [{"k_pages": l["k_pages"], "v_pages": l["v_pages"]}
+                       for l in new_layers],
+            "block_tables": block_tables,
+            "seq_lens": new_seq_lens,
+            "free_stack": free_stack,
+            "free_top": free_top,
+        }
+        return new_cache, greedy, m
+
     def release_step(cache, mask):
         seq_lens, free_stack, free_top = release(
             cache["block_tables"], cache["seq_lens"], cache["free_stack"],
@@ -156,7 +227,7 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
         return sample_logits(last[None], rng, gen_config)[0]
 
     if lora:
-        return decode_step, prefill_step, release_step, sample_first
+        return decode_step, prefill_step, release_step, sample_first, verify_step
 
     # single-tenant mode keeps the original program arity (the preflight
     # and every existing caller compile these signatures)
@@ -167,7 +238,11 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
         return prefill_step(params, None, cache, slot, chunk_ids, start,
                             chunk_len, None)
 
-    return decode_legacy, prefill_legacy, release_step, sample_first
+    def verify_legacy(params, cache, tokens, spec_len, active, rng):
+        return verify_step(params, None, cache, tokens, spec_len, active,
+                           None, rng)
+
+    return decode_legacy, prefill_legacy, release_step, sample_first, verify_legacy
 
 
 def fresh_engine_jits(model, gen_config, page_size: int, lora: bool = False,
@@ -178,16 +253,20 @@ def fresh_engine_jits(model, gen_config, page_size: int, lora: bool = False,
     an executable deserialized from the persistent compilation cache, and
     deserialized executables LOSE their buffer-donation alias table
     (``memory_analysis().alias_size_in_bytes`` reads 0), which would turn
-    every healthy donation into a GL301 false positive."""
-    decode_step, prefill_step, release_step, sample_first = _engine_step_fns(
-        model, gen_config, page_size, lora, lora_kernel_mode
-    )
+    every healthy donation into a GL301 false positive.
+
+    Returns ``(decode, prefill, release, sample_first, verify)`` — one
+    jitted ``verify`` covers the whole speculative bucket ladder (width is
+    a trace-time shape, exactly like the prefill buckets)."""
+    decode_step, prefill_step, release_step, sample_first, verify_step = \
+        _engine_step_fns(model, gen_config, page_size, lora, lora_kernel_mode)
     cache_arg = 2 if lora else 1
     return (
         jax.jit(decode_step, donate_argnums=(cache_arg,)),
         jax.jit(prefill_step, donate_argnums=(cache_arg,)),
         jax.jit(release_step, donate_argnums=(0,)),
         jax.jit(sample_first),
+        jax.jit(verify_step, donate_argnums=(cache_arg,)),
     )
 
 
@@ -216,7 +295,8 @@ class ServingEngine:
 
     def __init__(self, model, params, plugin: Optional[ServingPlugin] = None,
                  generation_config: Optional[GenerationConfig] = None, rng=None,
-                 adapters=None, telemetry: Optional[TelemetryPlugin] = None):
+                 adapters=None, telemetry: Optional[TelemetryPlugin] = None,
+                 draft_model=None, draft_params=None):
         self.plugin = plugin or ServingPlugin()
         self.gen_config = generation_config or GenerationConfig()
         if getattr(getattr(model, "config", None), "scan_layers", False):
@@ -242,14 +322,34 @@ class ServingEngine:
         self.cache = init_paged_cache(
             cfg, p.num_pages, p.page_size, p.num_slots, p.pages_per_slot
         )
+        # speculative multi-token decode (serving/speculate.py): a draft
+        # provider proposes k tokens per slot and the verify program accepts
+        # the longest greedy-matching prefix — greedy only, because the
+        # acceptance rule IS the token-parity pin (a sampled verify would
+        # need rejection sampling, a different contract)
+        self._spec: Optional[Speculator] = None
+        if p.speculate != "off":
+            if self.gen_config.do_sample:
+                raise ValueError(
+                    "speculative decode supports greedy decoding only "
+                    "(do_sample=True breaks the greedy-prefix acceptance "
+                    "pin) — disable ServingPlugin.speculate or sampling"
+                )
+            provider = make_draft_provider(
+                p.speculate, draft_model=draft_model, draft_params=draft_params,
+                window=p.speculate_draft_window,
+            )
+            self._spec = Speculator(provider, p.speculate_k, p.speculate_buckets)
         self.sched = ContinuousBatchingScheduler(
             p.num_slots, p.num_pages, p.page_size, p.pages_per_slot,
             p.prefill_chunk, p.prefill_buckets,
             adapters=adapters,
             max_bypass_age=(adapters.plugin.max_bypass_age
                             if adapters is not None else 16),
+            speculate_k=p.speculate_k if self._spec is not None else 0,
         )
-        self._decode, self._prefill, self._release, self._sample = _engine_fns(
+        (self._decode, self._prefill, self._release, self._sample,
+         self._verify) = _engine_fns(
             self.model, self.gen_config, p.page_size, adapters is not None,
             adapters.plugin.kernel if adapters is not None else "auto",
         )
@@ -282,6 +382,13 @@ class ServingEngine:
             "prefill_scheduled_tokens": 0, "prefill_useful_tokens": 0,
             "evictions": 0, "page_step_sum": 0, "peak_used_pages": 0,
             "prompt_tokens": 0, "generated_tokens": 0,
+            # speculative decode (zeros-clean when speculation is off):
+            # verify passes, drafted/accepted lanes, per-lane pass count +
+            # emitted tokens (the tokens_per_step twin's numerator and
+            # denominator), and pages rolled back off rejected drafts
+            "verify_steps": 0, "draft_tokens": 0, "accepted_draft_tokens": 0,
+            "decode_lane_passes": 0, "decode_emitted_tokens": 0,
+            "speculative_rollbacks": 0,
         }
         self.ttft_s: list[float] = []
         self.token_gaps_s: list[float] = []
@@ -343,6 +450,13 @@ class ServingEngine:
         return self._prefill(self.params, self.adapters.pool, self.cache,
                              slot, chunk_ids, start, chunk_len, adapter_slot)
 
+    def _run_verify(self, tokens, spec_len, active, adapter_slots, rng):
+        if self.adapters is None:
+            return self._verify(self.params, self.cache, tokens, spec_len,
+                                active, rng)
+        return self._verify(self.params, self.adapters.pool, self.cache,
+                            tokens, spec_len, active, adapter_slots, rng)
+
     # -- the engine tick -----------------------------------------------------
 
     def warmup(self) -> int:
@@ -382,6 +496,20 @@ class ServingEngine:
             self.cache = cache
         if last is not None:
             self._sample(last, rng)
+        if self._spec is not None:
+            # every verify bucket is a production program: one no-op pass
+            # per width (zero active slots, zero spec depth), plus the draft
+            # provider's own program (the draft-model windowed forward; the
+            # n-gram provider compiles nothing)
+            for bucket in self.plugin.speculate_buckets:
+                cache, _, _ = self._run_verify(
+                    jnp.asarray(np.zeros((n, bucket + 1), np.int32)),
+                    jnp.asarray(np.zeros((n,), np.int32)),
+                    jnp.asarray(np.zeros((n,), bool)),
+                    jnp.asarray(np.zeros((n,), np.int32)), rng,
+                )
+                self.cache = cache
+            self._spec.provider.warmup(n, self.plugin.speculate_k)
         self.cache = self._release(
             self.cache, jnp.asarray(np.zeros((n,), bool))
         )
@@ -461,6 +589,9 @@ class ServingEngine:
                     window = (t_disp, tr.recorder.clock())
             else:
                 event["cancelled"] = True
+        elif action[0] == "decode" and self._spec is not None:
+            event["type"] = "verify"
+            window = self._verify_tick(action[1], tr, event)
         elif action[0] == "decode":
             active_slots, evicted = self.sched.plan_evictions(action[1])
             self._release_evicted(evicted)
@@ -501,6 +632,8 @@ class ServingEngine:
                 m["scheduled_decode_slots"] += n
                 m["useful_decode_tokens"] += len(active_slots)
                 m["generated_tokens"] += len(active_slots)
+                m["decode_lane_passes"] += len(active_slots)
+                m["decode_emitted_tokens"] += len(active_slots)
                 event.update(slots=tuple(active_slots))
             else:
                 event["cancelled"] = True
@@ -537,6 +670,124 @@ class ServingEngine:
         return self.results
 
     # -- internals -----------------------------------------------------------
+
+    def _verify_tick(self, candidate_slots, tr, event):
+        """One speculative draft-and-verify pass (the decode action with
+        speculation armed).  Draft first (the proposals size the page
+        reservation), evict for the WORST-CASE page demand, dispatch the
+        bucket-padded verify program, then settle the host mirror off the
+        device-accepted lengths.  Returns the tracing window (or None)."""
+        sp = self._spec
+        sched = self.sched
+        cand = list(candidate_slots)
+        n = self.plugin.num_slots
+        # the draft batch is padded to the FULL slot width like every other
+        # engine program: a draft-model provider jits per batch shape, and a
+        # shape that tracked the live candidate count would recompile
+        # mid-traffic the first time occupancy changed (strict_compiles).
+        # Contexts carry only the provider's trailing window — rebuilding
+        # the full prompt+generated history per pass would be quadratic in
+        # stream length — and the assembly counts as draft time (it exists
+        # only to feed the drafting layer).
+        t_ctx = time.perf_counter()
+        win = max(2, getattr(sp.provider, "window", 512))
+        contexts = [[1]] * n
+        remaining = [1] * n  # dummy rows clamp to depth 0
+        tenant_ids = [0] * n
+        for s in cand:
+            st = sched.slots[s]
+            toks = st.tokens
+            if len(toks) >= win:
+                contexts[s] = toks[-win:]
+            else:
+                contexts[s] = list(st.request.prompt[len(toks) - win:]) + toks
+            remaining[s] = st.request.max_new_tokens - len(toks)
+            tenant_ids[s] = st.request.adapter_id
+        sp.draft_time_s += time.perf_counter() - t_ctx
+        drafts, spec_lens = sp.draft(contexts, remaining, tenant_ids)
+        spec_by_slot = {s: int(spec_lens[s]) for s in cand}
+        active_slots, evicted = sched.plan_speculative_evictions(
+            cand, spec_by_slot
+        )
+        self._release_evicted(evicted)
+        if not active_slots:
+            event["cancelled"] = True
+            return None
+        worst_need = sched.verify_page_need(active_slots, spec_by_slot)
+        bucket = sp.bucket_for(max(spec_by_slot[s] for s in active_slots))
+        w = bucket + 1
+        tokens = np.zeros((n, w), np.int32)
+        spec_arr = np.zeros((n,), np.int32)
+        active = np.zeros((n,), bool)
+        adapter_slots = np.zeros((n,), np.int32)
+        for s in active_slots:
+            st = sched.slots[s]
+            d = spec_by_slot[s]
+            tokens[s, 0] = st.tokens[-1]
+            if d:
+                tokens[s, 1:1 + d] = drafts[s, :d]
+            spec_arr[s] = d
+            active[s] = True
+            adapter_slots[s] = st.adapter_slot
+        t_disp = tr.stamp() if tr is not None else 0.0
+        cache, greedy, m_dev = self._run_verify(
+            jnp.asarray(tokens), jnp.asarray(spec_arr), jnp.asarray(active),
+            jnp.asarray(adapter_slots), self._step_rng(),
+        )
+        if tr is not None:
+            tr.phase("dispatch:verify", t_disp, slots=list(active_slots),
+                     bucket=bucket, step=self.steps)
+        self.cache = cache
+        t_sync = tr.stamp() if tr is not None else 0.0
+        greedy_np = np.asarray(greedy)
+        m_np = np.asarray(m_dev)
+        if tr is not None:
+            tr.phase("host_sync", t_sync, step=self.steps)
+        window = (t_disp, tr.recorder.clock()) if tr is not None else None
+        accepted = {s: int(m_np[s]) for s in active_slots}
+        m = self.metrics
+        # rollback accounting against the PRE-pass kv lengths (note_verify
+        # advances them)
+        for s in active_slots:
+            kept = speculative_page_need(
+                sched.slots[s].kv_tokens, accepted[s], self.plugin.page_size
+            )
+            m["speculative_rollbacks"] += worst_need[s] - kept
+        sched.note_verify(accepted)
+        done_slots = []
+        recorded = 0
+        delivered_drafts = 0
+        for s in active_slots:
+            r = 0
+            for tok in greedy_np[s, :accepted[s] + 1]:
+                r += 1
+                if self._record_token(s, int(tok), release=False):
+                    # EOS (or max_new) inside the accepted window retires
+                    # the sequence; the remainder of the window is
+                    # discarded exactly as sequential decode never would
+                    # have produced it
+                    done_slots.append(s)
+                    break
+            recorded += r
+            # accepted drafts DELIVERED (each pass emits m+1 for m accepted
+            # drafts; an EOS truncation discards the tail, and discarded
+            # drafts must not inflate the measured accept-rate twin — the
+            # predicted replay caps at the stream end the same way)
+            delivered_drafts += r - 1
+        if done_slots:
+            self._release_slots(done_slots)
+            self._finish_decode_slots(done_slots)
+        m["verify_steps"] += 1
+        m["scheduled_decode_slots"] += n * w
+        m["useful_decode_tokens"] += recorded
+        m["generated_tokens"] += recorded
+        m["decode_lane_passes"] += len(active_slots)
+        m["decode_emitted_tokens"] += recorded
+        m["draft_tokens"] += sum(spec_by_slot[s] for s in active_slots)
+        m["accepted_draft_tokens"] += delivered_drafts
+        event.update(slots=tuple(active_slots), bucket=bucket,
+                     accepted=tuple(accepted[s] for s in active_slots))
+        return window
 
     def _step_rng(self):
         return jax.random.fold_in(self._base_rng, self.steps)
@@ -619,6 +870,40 @@ class ServingEngine:
             jax.ShapeDtypeStruct((n,), jnp.int32),
             jax.ShapeDtypeStruct((n,), jnp.bool_),
             jax.ShapeDtypeStruct((n,), jnp.int32),
+            self._base_rng, **audit_kwargs,
+        )
+
+    @property
+    def speculator(self) -> Optional["Speculator"]:
+        """The engine's speculative-decode state (None when off)."""
+        return self._spec
+
+    @property
+    def speculate_mode(self) -> str:
+        return self.plugin.speculate if self._spec is not None else "off"
+
+    def audit_verify_step(self, **audit_kwargs):
+        """graft-lint jaxpr audit of the speculative verify step at the
+        largest bucket width — the allocate + multi-token append +
+        page-rollback pytree must alias the donated cache exactly like the
+        decode step (no GL101 wasted donation, no in-trace transfers)."""
+        from ..analysis import audit_jitted
+
+        if self._spec is None:
+            raise RuntimeError("speculation is off: no verify program to audit")
+        n = self.plugin.num_slots
+        w = self._spec.buckets[-1] + 1
+        sds = jax.ShapeDtypeStruct
+        if self.adapters is None:
+            return audit_jitted(
+                self._verify, self.params, self.cache,
+                sds((n, w), jnp.int32), sds((n,), jnp.int32),
+                sds((n,), jnp.bool_), self._base_rng, **audit_kwargs,
+            )
+        return audit_jitted(
+            self._verify, self.params, self.adapters.pool, self.cache,
+            sds((n, w), jnp.int32), sds((n,), jnp.int32),
+            sds((n,), jnp.bool_), sds((n,), jnp.int32),
             self._base_rng, **audit_kwargs,
         )
 

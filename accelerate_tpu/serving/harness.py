@@ -154,6 +154,8 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
     compiles_before = engine.compile_events
     tracer = getattr(engine, "trace", None)
     overhead_before = tracer.recorder.overhead_s if tracer is not None else 0.0
+    sp = getattr(engine, "speculator", None)
+    draft_before = sp.draft_time_s if sp is not None else 0.0
     t0 = time.perf_counter()
     results = engine.run(trace)
     wall_s = time.perf_counter() - t0
@@ -173,7 +175,7 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
     n_chips = jax.device_count()
     scheduled = m["scheduled_decode_slots"] + m["prefill_scheduled_tokens"]
     useful = m["useful_decode_tokens"] + m["prefill_useful_tokens"]
-    work_steps = m["decode_steps"] + m["prefill_steps"]
+    work_steps = m["decode_steps"] + m["verify_steps"] + m["prefill_steps"]
     total_steps = work_steps + m["idle_steps"]
     gen = m["generated_tokens"]
     predicted_util = predicted_pool_utilization(
@@ -191,6 +193,8 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
                measured=measured_util, source="serving/harness.replay")
     reg.record("compiles.steady_state", predicted=0,
                measured=compiles_measured, source="serving/harness.replay")
+    spec_fields = _speculate_fields(engine, trace, results, wall_s,
+                                    draft_before=draft_before)
     if slo_monitor is not None:
         slo_monitor.observe_many("token_latency_s", engine.token_gaps_s)
         slo_monitor.observe_many("ttft_s", engine.ttft_s)
@@ -234,13 +238,90 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
         "compiles_predicted": 0,
         "compiles_measured": compiles_measured,
         "compiles_warmup": compiles_warmup,
-        "programs_predicted": len(p.prefill_buckets) + 3,  # + decode/release/sampler
+        # decode + release + first-token sampler, plus — with speculation —
+        # one verify program per bucket and the draft provider's own program
+        "programs_predicted": len(p.prefill_buckets) + 3 + (
+            len(p.speculate_buckets) + engine.speculator.provider.programs
+            if engine.speculator is not None else 0
+        ),
+        **spec_fields,
         **telemetry_fields,
         # multi-tenant adapter fields — ALWAYS present (zeros without an
         # AdapterStore), with the predicted/measured pool-hit-rate twins
         **_adapter_fields(engine, trace),
         "results": results,
     }
+
+
+def _speculate_fields(engine, trace: list[Request], results: dict,
+                      wall_s: float, draft_before: float = 0.0) -> dict:
+    """The always-emitted speculative-decode block of the serving report
+    (zeros-clean when speculation is off or the trace is idle):
+
+    - ``accept_rate`` — accepted drafts / drafted tokens (measured), with
+      the ``_predicted`` twin from the model-free trace replay
+      (:func:`~.speculate.predicted_acceptance` over the MEASURED streams —
+      the prediction error is the eviction/recompute re-decode traffic).
+      The replay only runs for host-side providers (``provider.programs ==
+      0``): replaying a draft MODEL would re-run the whole decode at batch
+      1 on device just to fill a report field, so the draft-model twin
+      stays idle (measured side only);
+    - ``tokens_per_step`` — decode tokens emitted per slot per
+      decode/verify pass (exactly 1.0 for plain decode; > 1.0 is the
+      speculative win), same predicted twin;
+    - ``draft_overhead_frac`` — THIS replay's host drafting time over its
+      wall clock (``draft_before`` anchors the delta: a reused warmed
+      engine's earlier drafting must not inflate the ratio);
+    - ``speculative_rollbacks`` — pages rolled back off rejected drafts.
+
+    Both twins are recorded into the central registry
+    (``speculate.accept_rate`` / ``speculate.tokens_per_step``)."""
+    m = engine.metrics
+    lanes = m["decode_lane_passes"]
+    measured_tps = round(m["decode_emitted_tokens"] / lanes, 4) if lanes else 0.0
+    drafted = m["draft_tokens"]
+    measured_accept = round(m["accepted_draft_tokens"] / drafted, 4) if drafted else 0.0
+    sp = engine.speculator
+    fields = {
+        "speculate": engine.speculate_mode,
+        "speculate_k": sp.k if sp is not None else 0,
+        "accept_rate": measured_accept,
+        "accept_rate_predicted": 0.0,
+        "tokens_per_step": measured_tps,
+        "tokens_per_step_predicted": 0.0,
+        "draft_overhead_frac": 0.0,
+        "speculative_rollbacks": m["speculative_rollbacks"],
+        "verify_steps": m["verify_steps"],
+        "drafted_tokens": drafted,
+        "accepted_draft_tokens": m["accepted_draft_tokens"],
+    }
+    if sp is None:
+        return fields
+    from ..telemetry import twin_registry
+
+    from .speculate import predicted_acceptance
+
+    draft_s = sp.draft_time_s - draft_before
+    fields["draft_overhead_frac"] = (
+        round(min(1.0, draft_s / wall_s), 6) if wall_s > 0 else 0.0
+    )
+    reg = twin_registry()
+    if sp.provider.programs == 0:  # model-free drafting: the replay is free
+        pred = predicted_acceptance(trace, results, sp.provider, sp.k)
+        fields["accept_rate_predicted"] = pred["accept_rate"]
+        fields["tokens_per_step_predicted"] = pred["tokens_per_step"]
+        reg.record("speculate.accept_rate", predicted=pred["accept_rate"],
+                   measured=measured_accept,
+                   source="serving/harness._speculate_fields")
+        reg.record("speculate.tokens_per_step",
+                   predicted=pred["tokens_per_step"], measured=measured_tps,
+                   source="serving/harness._speculate_fields")
+    else:
+        reg.record("speculate.accept_rate", measured=measured_accept,
+                   source="serving/harness._speculate_fields")
+        reg.record("speculate.tokens_per_step", measured=measured_tps,
+                   source="serving/harness._speculate_fields")
+    return fields
 
 
 def _adapter_fields(engine, trace: list[Request]) -> dict:

@@ -65,13 +65,29 @@ def release(block_tables, seq_lens, free_stack, free_top, release_mask, page_siz
     owned = release_mask[:, None] & (
         jnp.arange(n)[None, :] < pages_for(seq_lens, page_size)[:, None]
     )
-    flat_owned = owned.reshape(-1)
-    rank = jnp.cumsum(flat_owned.astype(jnp.int32)) - 1
-    dst = jnp.where(flat_owned, free_top + rank, free_stack.shape[0])  # OOB -> drop
-    free_stack = free_stack.at[dst].set(block_tables.reshape(-1), mode="drop")
-    free_top = free_top + jnp.sum(flat_owned.astype(jnp.int32))
+    free_stack, free_top = push_pages(
+        free_stack, free_top, block_tables.reshape(-1), owned.reshape(-1)
+    )
     seq_lens = jnp.where(release_mask, 0, seq_lens)
     return seq_lens, free_stack, free_top
+
+
+def push_pages(free_stack, free_top, pages, mask):
+    """Push an arbitrary masked set of physical pages back onto the free
+    stack — THE free-stack push primitive (:func:`release` and the
+    speculative verify pass's rollback both route through it).  A verify
+    pass allocates worst-case pages up front (every page-start among its
+    ``k + 1`` candidate positions), then returns the ones past the accepted
+    frontier through this scatter, all inside the same donated jitted
+    program.  ``pages``/``mask``: aligned ``[K]`` arrays; masked-out lanes
+    route their scatter out of bounds and drop (the shared write-mask
+    convention).  Returns ``(free_stack, free_top)``.
+    """
+    mask = mask.astype(bool)
+    rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+    dst = jnp.where(mask, free_top + rank, free_stack.shape[0])  # OOB -> drop
+    free_stack = free_stack.at[dst].set(pages, mode="drop")
+    return free_stack, free_top + jnp.sum(mask.astype(jnp.int32))
 
 
 def kv_pool_accounting(config, num_pages: int, page_size: int, dtype_bytes: int = 2) -> dict:

@@ -78,6 +78,11 @@ class SlotState:
     last_token: int = 0            # decode input for the next step
     finished: bool = False
     adapter_slot: int = 0          # device pool slot the request decodes with
+    kv_len: Optional[int] = None   # explicit device-side KV length (speculative
+                                   # decode: EOS inside an accepted window can
+                                   # retire the HOST stream short of the KV the
+                                   # verify pass already wrote — page accounting
+                                   # must follow the device, not len(tokens))
 
     def __post_init__(self):
         if self.tokens is None:
@@ -93,6 +98,14 @@ class SlotState:
         # the latest sampled token is written by the NEXT decode step)
         return self.prefilled + max(0, len(self.tokens) - 1)
 
+    @property
+    def kv_tokens(self) -> int:
+        """Tokens actually resident in the device KV cache — ``seq_len``
+        unless a verify pass pinned an explicit ``kv_len`` (speculative
+        mode).  ALL page arithmetic (evict/finish/need) keys off this, so
+        the host free-page mirror tracks the device allocator exactly."""
+        return self.kv_len if self.kv_len is not None else self.seq_len
+
 
 class ContinuousBatchingScheduler:
     """Deterministic admit/prefill/decode/evict policy over a fixed slot set.
@@ -104,7 +117,7 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, num_slots: int, num_pages: int, page_size: int,
                  pages_per_slot: int, prefill_chunk: int, prefill_buckets: tuple,
-                 adapters=None, max_bypass_age: int = 16):
+                 adapters=None, max_bypass_age: int = 16, speculate_k: int = 0):
         self.num_slots = num_slots
         self.num_pages = num_pages
         self.page_size = page_size
@@ -113,6 +126,7 @@ class ContinuousBatchingScheduler:
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.adapters = adapters             # AdapterStore (multi-tenant mode)
         self.max_bypass_age = max_bypass_age
+        self.speculate_k = speculate_k       # admission reserves verify pages
         self.waiting: deque[Request] = deque()
         self.slots: dict[int, SlotState] = {}
         self.free_slots: list[int] = list(range(num_slots))
@@ -216,7 +230,7 @@ class ContinuousBatchingScheduler:
             if idx is None:
                 break
             req = self.waiting[idx]
-            if pages_for(req.prompt_len, self.page_size) > self.free_pages:
+            if self.admission_page_need(req) > self.free_pages:
                 break
             del self.waiting[idx]
             adapter_slot = 0
@@ -233,6 +247,20 @@ class ContinuousBatchingScheduler:
             admitted.append(slot)
             self.events.append(("admit", req.uid, slot))
         return admitted
+
+    def admission_page_need(self, req: Request) -> int:
+        """Pages admission demands before scheduling ``req``: the prompt,
+        plus — in speculative mode — the worst-case pages of the request's
+        FIRST verify pass (positions ``prompt_len .. prompt_len + depth``,
+        depth clamped to the request's own token budget).  The clamp keeps
+        the demand within ``pages_for(prompt + max_new)``, which ``submit``
+        already guarantees the pool can offer — the speculative reservation
+        can never re-introduce the admit-vs-submit livelock."""
+        base = pages_for(req.prompt_len, self.page_size)
+        if not self.speculate_k:
+            return base
+        depth = min(self.speculate_k, req.max_new_tokens - 1)
+        return pages_for(req.prompt_len + 1 + depth, self.page_size)
 
     # -- the per-tick decision ----------------------------------------------
 
@@ -280,16 +308,71 @@ class ContinuousBatchingScheduler:
         fresh page this step)."""
         return [
             s for s in slots
-            if self.slots[s].seq_len % self.page_size == 0
+            if self.slots[s].kv_tokens % self.page_size == 0
         ]
 
-    def plan_evictions(self, slots: list[int]) -> tuple[list[int], list[int]]:
-        """Evict youngest-admitted sequences until this decode step's fresh
-        pages fit the pool.  Returns ``(surviving_decode_slots,
-        evicted_slots)``; the evicted requests are requeued at the front."""
+    def verify_page_need(self, slots: list[int], spec_lens: dict) -> dict:
+        """Worst-case fresh pages per slot for one speculative verify pass:
+        page starts among the written positions ``[kv, kv + spec_len]``.
+        The pass itself rolls rejected pages back, but eviction must plan
+        for the peak — the device allocator pops the worst case before the
+        acceptance comparison exists."""
+        from .speculate import speculative_page_need
+
+        return {
+            s: speculative_page_need(self.slots[s].kv_tokens,
+                                     spec_lens.get(s, 0), self.page_size)
+            for s in slots
+        }
+
+    def plan_speculative_evictions(self, slots: list[int],
+                                   spec_lens: dict) -> tuple[list[int], list[int]]:
+        """Fit the verify pass's worst-case page demand — **degrade before
+        evicting**: the speculative reservation is transient (rejected
+        drafts roll their pages straight back), so paying for it by
+        evicting a LIVE sequence (recompute-on-readmit: every generated
+        token revoked) is a terrible trade.  Under pressure the planner
+        first zeroes draft depths in ``spec_lens`` — youngest-admitted
+        first, mirroring the eviction order — which shrinks each slot's
+        demand to the plain-decode floor (a depth-0 lane IS plain decode);
+        only when the floor itself does not fit does the shared
+        evict-until-fit loop run.  Mutates ``spec_lens`` in place (the
+        engine builds the pass from it) and returns ``(surviving_slots,
+        evicted_slots)``."""
         active = list(slots)
+
+        def over():
+            return (sum(self.verify_page_need(active, spec_lens).values())
+                    > self.free_pages)
+
+        degraded = []
+        while over():
+            victims = [
+                s for s in sorted(active,
+                                  key=lambda s: -self.slots[s].admit_seq)
+                if spec_lens.get(s, 0) > 0
+            ]
+            if not victims:
+                break
+            spec_lens[victims[0]] = 0
+            degraded.append(victims[0])
+        if degraded:
+            self.events.append(("despeculate", tuple(degraded)))
+        evicted = self._evict_until(
+            active,
+            lambda a: sum(self.verify_page_need(a, spec_lens).values())
+            <= self.free_pages,
+        )
+        return active, evicted
+
+    def _evict_until(self, active: list[int], fits) -> list[int]:
+        """The one evict-until-fit loop (plain AND speculative decode share
+        it, so victim policy can never drift between the modes): evict the
+        youngest-admitted sequence — removing it from ``active`` when it
+        was scheduled this tick — until ``fits(active)``.  Returns the
+        evicted slots."""
         evicted = []
-        while len(self.decode_page_need(active)) > self.free_pages:
+        while not fits(active):
             victims = sorted(self.slots, key=lambda s: -self.slots[s].admit_seq)
             if not victims:  # pragma: no cover - submit() capacity guard
                 break
@@ -298,6 +381,16 @@ class ContinuousBatchingScheduler:
             evicted.append(victim)
             if victim in active:
                 active.remove(victim)
+        return evicted
+
+    def plan_evictions(self, slots: list[int]) -> tuple[list[int], list[int]]:
+        """Evict youngest-admitted sequences until this decode step's fresh
+        pages fit the pool.  Returns ``(surviving_decode_slots,
+        evicted_slots)``; the evicted requests are requeued at the front."""
+        active = list(slots)
+        evicted = self._evict_until(
+            active, lambda a: len(self.decode_page_need(a)) <= self.free_pages
+        )
         return active, evicted
 
     def plan_prefill_evictions(self, slot: int, chunk_len: int) -> tuple[bool, list[int]]:
@@ -323,7 +416,7 @@ class ContinuousBatchingScheduler:
 
     def evict(self, slot: int) -> Request:
         st = self.slots.pop(slot)
-        self.free_pages += pages_for(st.seq_len, self.page_size)
+        self.free_pages += pages_for(st.kv_tokens, self.page_size)
         self.free_slots.append(slot)
         self.free_slots.sort()
         if self.adapters is not None:
@@ -348,11 +441,31 @@ class ContinuousBatchingScheduler:
         self.free_pages -= len(slots_needing_pages)
         self.events.append(("decode", tuple(sorted(slots_needing_pages))))
 
+    def note_verify(self, accepted: dict) -> None:
+        """Execution feedback for one verify pass: ``accepted`` maps each
+        dispatched slot to the device-accepted draft count ``m`` (the pass
+        emitted ``m + 1`` tokens and kept exactly the pages covering them —
+        the rejected remainder was rolled back on device).  Advancing
+        ``kv_len`` by ``m + 1`` per slot keeps the host free-page mirror
+        exact against the allocate-then-push_pages arithmetic."""
+        consumed = 0
+        for slot in sorted(accepted):
+            m = int(accepted[slot])
+            st = self.slots[slot]
+            kv = st.kv_tokens
+            consumed += int(pages_for(kv + m + 1, self.page_size)
+                            - pages_for(kv, self.page_size))
+            st.kv_len = kv + m + 1
+        self.free_pages -= consumed
+        self.events.append(
+            ("verify", tuple((s, int(accepted[s])) for s in sorted(accepted)))
+        )
+
     def finish(self, slot: int) -> SlotState:
         """Retire a finished sequence: free its pages and its slot."""
         st = self.slots.pop(slot)
         st.finished = True
-        self.free_pages += pages_for(st.seq_len, self.page_size)
+        self.free_pages += pages_for(st.kv_tokens, self.page_size)
         self.free_slots.append(slot)
         self.free_slots.sort()
         if self.adapters is not None:

@@ -294,6 +294,12 @@ class RequestTracer:
                              cat="request", step=step, slot=slot,
                              prefilled=prefilled)
                 self._decode_start.setdefault(uid, w1)
+            elif kind == "verify":
+                # speculative draft-and-verify pass: per-slot accepted draft
+                # counts (the dispatch:verify phase span carries the timing;
+                # this instant carries the acceptance outcome)
+                rec.instant("verify", "engine", cat="schedule",
+                            accepted=[list(p) for p in ev[1]], step=step)
             elif kind == "evict":
                 uid = ev[1]
                 rec.instant("evict", f"req {uid}", cat="request", step=step)

@@ -13,7 +13,7 @@ the ROADMAP-5 cost-model-driven autotuner ranks knobs with.
 
 Conventions:
 
-- **Names** are ``<subsystem>.<quantity>`` (the canonical seven are in
+- **Names** are ``<subsystem>.<quantity>`` (the canonical nine are in
   :data:`STANDARD_TWINS`); registering twice is idempotent and updates
   nothing but the recorded values.
 - **rel_err** is the symmetric relative error ``|m - p| / max(|p|, |m|)``
@@ -53,6 +53,13 @@ STANDARD_TWINS: dict[str, tuple] = {
     "kv_pool.utilization": ("frac", 0.25, None),
     # serving/adapters.predicted_adapter_hit_rate vs AdapterStore.hit_rate
     "adapter_pool.hit_rate": ("frac", 0.25, None),
+    # serving/speculate.predicted_acceptance (model-free replay over the
+    # measured streams) vs the engine's accepted/drafted counters — the
+    # prediction error is the eviction/recompute re-decode traffic
+    "speculate.accept_rate": ("frac", 0.25, None),
+    # same replay's verify-emitted tokens per pass vs the measured
+    # decode_emitted_tokens / decode_lane_passes ratio
+    "speculate.tokens_per_step": ("tokens/step", 0.25, None),
     # resilience/goodput.goodput_accounting (or the clean-run model) vs
     # GoodputTracker
     "goodput.goodput_frac": ("frac", 0.1, None),
@@ -136,7 +143,7 @@ class TwinRegistry:
             return twin
 
     def declare_standard_twins(self) -> None:
-        """Pre-register the canonical seven (:data:`STANDARD_TWINS`) so the
+        """Pre-register the canonical nine (:data:`STANDARD_TWINS`) so the
         bench ``twins`` block is zeros-clean: every name present, idle rows
         carrying zeros, whether or not the run exercised the subsystem."""
         for name, (units, tol, err_tol) in STANDARD_TWINS.items():

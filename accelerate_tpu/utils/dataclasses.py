@@ -500,6 +500,28 @@ class ServingPlugin(KwargsHandler):
     decode_kernel: str = ""                  # "auto" (paged Pallas kernel on TPU,
                                              # native gather elsewhere) | "native" |
                                              # "flash" (env ACCELERATE_SERVE_KERNEL)
+    speculate: str = ""                      # speculative multi-token decode:
+                                             # "off" | "ngram" (prompt-lookup
+                                             # self-drafting) | "draft" (small
+                                             # draft model — pass draft_model/
+                                             # draft_params to the engine).
+                                             # env ACCELERATE_SERVE_SPECULATE
+                                             # ("on"/"1" mean "ngram"), default off
+    speculate_k: Optional[int] = None        # draft tokens proposed per verify
+                                             # pass (env
+                                             # ACCELERATE_SERVE_SPECULATE_K,
+                                             # default 4)
+    speculate_buckets: Optional[tuple] = None  # verify-program width ladder (the
+                                             # program compiles once per bucket
+                                             # at width bucket+1, never
+                                             # mid-traffic).  Default:
+                                             # (speculate_k,)
+    speculate_draft_window: Optional[int] = None  # draft-model context window
+                                             # (the fixed-shape windowed forward
+                                             # the "draft" provider re-runs per
+                                             # draft token; env
+                                             # ACCELERATE_SERVE_SPECULATE_DRAFT,
+                                             # default 32)
 
     def __post_init__(self):
         env = os.environ
@@ -523,6 +545,44 @@ class ServingPlugin(KwargsHandler):
                 f"decode_kernel must be 'auto', 'native' or 'flash', got "
                 f"{self.decode_kernel!r}"
             )
+        if isinstance(self.speculate, bool):
+            # the generate_paged(speculate=True) convention works here too
+            self.speculate = "ngram" if self.speculate else "off"
+        if not self.speculate:
+            self.speculate = env.get("ACCELERATE_SERVE_SPECULATE", "off")
+        self.speculate = {"1": "ngram", "on": "ngram", "0": "off",
+                          "": "off"}.get(self.speculate.lower(),
+                                         self.speculate.lower())
+        if self.speculate not in ("off", "ngram", "draft"):
+            raise ValueError(
+                f"speculate must be 'off', 'ngram' or 'draft' (or 'on'/'1' "
+                f"for ngram), got {self.speculate!r}"
+            )
+        if self.speculate_k is None:
+            self.speculate_k = int(env.get("ACCELERATE_SERVE_SPECULATE_K", 4))
+        if self.speculate_draft_window is None:
+            self.speculate_draft_window = int(
+                env.get("ACCELERATE_SERVE_SPECULATE_DRAFT", 32)
+            )
+        if self.speculate != "off" and self.speculate_k < 1:
+            raise ValueError(
+                f"speculate_k must be >= 1 with speculation on, got "
+                f"{self.speculate_k}"
+            )
+        if self.speculate_buckets is None:
+            self.speculate_buckets = (self.speculate_k,)
+        else:
+            self.speculate_buckets = tuple(
+                sorted(int(b) for b in self.speculate_buckets)
+            )
+            if not self.speculate_buckets or \
+                    self.speculate_buckets[-1] < self.speculate_k:
+                raise ValueError(
+                    f"speculate_buckets {self.speculate_buckets} must include "
+                    f"a bucket >= speculate_k={self.speculate_k}"
+                )
+            if self.speculate_buckets[0] < 1:
+                raise ValueError("speculate_buckets entries must be >= 1")
         for name in ("num_slots", "page_size", "pages_per_slot", "num_pages",
                      "prefill_chunk"):
             if getattr(self, name) < 1:

@@ -10,7 +10,7 @@ MFU target for the reference's TPU path ("Llama fine-tune at >=45% MFU").
 Every report carries ``schema_version`` (bumped when field semantics
 change), the unified ``twins`` block (telemetry/twins.py: every registered
 predicted/measured pair with per-twin rel_err and drift status — the
-canonical seven are always present, zeros-clean when idle), and the
+canonical nine are always present, zeros-clean when idle), and the
 measured ``telemetry_overhead_frac`` (0.0 with telemetry off; telemetry
 on/off never changes a token or the loss).
 """
@@ -26,7 +26,7 @@ BENCH_SCHEMA_VERSION = 1
 
 
 def _twins_block() -> dict:
-    """The unified twins block: declare the canonical seven (zeros-clean),
+    """The unified twins block: declare the canonical nine (zeros-clean),
     then render everything the run recorded."""
     from accelerate_tpu.telemetry import twin_registry
 
@@ -428,7 +428,14 @@ def serve_report(args) -> dict:
     count/bytes, the predicted pool ladder, and the **per-adapter-loop
     twin** — the same trace re-served one tenant at a time, which the
     batched einsum must beat on tokens/s (the S-LoRA win, CPU-measurable
-    as slot occupancy)."""
+    as slot occupancy).
+
+    ``--speculate [K]``: speculative multi-token decode (n-gram
+    self-drafting, K drafts per verify pass).  The speculate fields ride
+    EVERY serve report zeros-clean: ``accept_rate`` (+``_predicted`` via
+    the model-free trace replay — the TwinRegistry pair), ``tokens_per_step``
+    (+``_predicted``; 1.0 is the plain-decode floor the speculative run
+    must beat), ``draft_overhead_frac``, ``speculative_rollbacks``."""
     import dataclasses as _dc
     import tempfile
     import time as _time
@@ -445,6 +452,9 @@ def serve_report(args) -> dict:
     from accelerate_tpu.utils.dataclasses import LoraPlugin, ServingPlugin
 
     on_tpu = jax.default_backend() == "tpu"
+    spec_k = getattr(args, "speculate", None)
+    spec_kw = ({"speculate": "ngram", "speculate_k": int(spec_k)}
+               if spec_k else {})
     if on_tpu:
         # the 600m-class decode shape (the headline bench's model family);
         # pool sized off the KV-HBM ladder, paged Pallas decode kernel
@@ -456,7 +466,7 @@ def serve_report(args) -> dict:
         )
         plugin = ServingPlugin(
             num_slots=args.batch or 16, page_size=64, pages_per_slot=32,
-            num_pages=(args.batch or 16) * 16, prefill_chunk=512,
+            num_pages=(args.batch or 16) * 16, prefill_chunk=512, **spec_kw,
         )
         prompt_range, new_range = (64, 512), (32, 256)
     else:  # CPU-tiny smoke shape (the --batch 8 convention)
@@ -464,7 +474,7 @@ def serve_report(args) -> dict:
         plugin = ServingPlugin(
             num_slots=args.batch or 4, page_size=4, pages_per_slot=16,
             num_pages=(args.batch or 4) * 10, prefill_chunk=16,
-            decode_kernel="native",
+            decode_kernel="native", **spec_kw,
         )
         prompt_range, new_range = (4, 24), (4, 24)
     model = LlamaForCausalLM(cfg)
@@ -684,6 +694,19 @@ def main():
     ap.add_argument("--serve-seed", type=int, default=0,
                     help="trace seed for --serve (same seed -> same trace "
                          "-> same schedule, pinned by the determinism test)")
+    ap.add_argument("--speculate", nargs="?", const=4, type=int, default=None,
+                    metavar="K",
+                    help="with --serve: speculative multi-token decode — the "
+                         "n-gram/prompt-lookup self-drafter proposes K tokens "
+                         "per slot (default 4) and ONE batched verify pass "
+                         "accepts the longest greedy-matching prefix, "
+                         "bitwise-identical to single-token decode (the "
+                         "generate() parity pin).  The report's always-"
+                         "emitted accept_rate / tokens_per_step (predicted + "
+                         "measured twins), draft_overhead_frac and "
+                         "speculative_rollbacks fields measure the win; "
+                         "tokens_per_step must beat the speculate-off 1.0 "
+                         "on the seeded trace (pinned by smoke)")
     ap.add_argument("--trace-requests", nargs="?", const="-", default=None,
                     metavar="FILE",
                     help="with --serve: record request-level lifecycle spans "

@@ -58,9 +58,13 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "ServingEngine", "ContinuousBatchingScheduler", "Request", "SlotState",
         "AdapterStore", "LoraTrainer", "adapter_pool_accounting",
         "predicted_adapter_hit_rate",
-        "allocate", "release", "pages_for", "kv_pool_accounting",
+        "allocate", "release", "push_pages", "pages_for", "kv_pool_accounting",
         "synthesize_trace", "replay", "static_batching_report",
         "predicted_pool_utilization",
+    ]),
+    "speculate": ("accelerate_tpu.serving.speculate", [
+        "NgramDraft", "DraftModelDraft", "Speculator", "make_draft_provider",
+        "predicted_acceptance", "speculative_page_need",
     ]),
     "lora": ("accelerate_tpu.ops.lora", [
         "lora_apply", "lora_apply_sequential", "bgmv", "lora_spec",

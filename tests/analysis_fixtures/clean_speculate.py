@@ -1,0 +1,51 @@
+"""CLEAN speculative-decode twins — the discipline the real engine uses
+(``serving/engine.py`` + ``serving/speculate.py``).
+
+Each function mirrors one in ``planted_speculate.py`` with the hazard
+retired: the drafting layer sizes the next proposals off the RETURNED
+cache (the donated name is dead after the verify call — the engine keeps
+its own host-side ``kv_len`` mirror and never touches the donated pytree),
+and the verify width is a static bucket from the fixed
+``speculate_buckets`` ladder — one compile per bucket, never per draft
+depth.  graft-lint must stay quiet on every function here.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _verify(cache, tokens):
+    k_pages = cache["k_pages"].at[0, 0].set(tokens[0])
+    greedy = jnp.argmax(jnp.sum(k_pages, axis=(0, 1)), axis=-1)
+    return {"k_pages": k_pages, "seq_lens": cache["seq_lens"] + 1}, greedy
+
+
+jitted_verify = jax.jit(_verify, donate_argnums=(0,))
+
+
+def draft_reuses_donated_cache(cache, tokens):
+    # the draft context reads the RETURNED cache: the donated name is dead
+    # after the verify dispatch (the engine's host kv_len mirror plays this
+    # role in production — no device fetch at all)
+    new_cache, greedy = jitted_verify(cache, tokens)
+    draft_context_len = new_cache["seq_lens"] + 1
+    return new_cache, greedy, draft_context_len
+
+
+@partial(jax.jit, static_argnames=("bucket",))
+def verify_width_iota(x, bucket):
+    """GL305 fixed: the width is a bucket from the fixed speculate ladder
+    passed static — draft depths pad up to it, one compile per bucket."""
+    return x + jnp.arange(bucket)
+
+
+def example_args():
+    cache = {
+        "k_pages": jnp.zeros((4, 8, 16), jnp.float32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "draft_reuses_donated_cache": (cache, jnp.ones((16,), jnp.float32)),
+    }

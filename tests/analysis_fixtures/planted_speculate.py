@@ -1,0 +1,56 @@
+"""PLANTED speculative-decode hazards — the two ways the draft-and-verify
+contract breaks (corrected twins: ``clean_speculate.py``).
+
+The serving engine's verify step donates the whole cache pytree (allocate +
+multi-token append + page rollback all alias in place); the drafting layer
+runs on the host BETWEEN verify passes, so the tempting bug is reading the
+donated structure for the next draft's context while XLA may already be
+overwriting it — ``draft_reuses_donated_cache`` carries that shape (GL201,
+the async-ckpt race applied across the draft/verify boundary).
+``verify_width_iota`` carries the k-dependent trace (GL305): a verify
+program keyed on the drafts' width recompiles whenever a request's draft
+depth changes — exactly what the fixed ``speculate_buckets`` ladder exists
+to prevent.  Excluded from repo-wide sweeps like the rest of this
+directory.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _verify(cache, tokens):
+    k_pages = cache["k_pages"].at[0, 0].set(tokens[0])
+    greedy = jnp.argmax(jnp.sum(k_pages, axis=(0, 1)), axis=-1)
+    return {"k_pages": k_pages, "seq_lens": cache["seq_lens"] + 1}, greedy
+
+
+jitted_verify = jax.jit(_verify, donate_argnums=(0,))
+
+
+def draft_reuses_donated_cache(cache, tokens):
+    # GL201: `cache` was donated to the verify step — XLA may already be
+    # scribbling over its pool buffers when the drafting layer reads
+    # seq_lens off the STALE structure to size the next proposals, instead
+    # of the returned cache
+    new_cache, greedy = jitted_verify(cache, tokens)
+    draft_context_len = cache["seq_lens"] + 1
+    return new_cache, greedy, draft_context_len
+
+
+@jax.jit
+def verify_width_iota(drafts, x):
+    """GL305: ``drafts.shape[1]`` (this pass's draft depth) flows straight
+    into ``jnp.arange`` and the drafts are not static — the verify program
+    re-specializes per k instead of padding to a ``speculate_buckets``
+    width (the mid-traffic recompile ``strict_compiles`` exists to catch)."""
+    return x + jnp.arange(drafts.shape[1])
+
+
+def example_args():
+    cache = {
+        "k_pages": jnp.zeros((4, 8, 16), jnp.float32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "draft_reuses_donated_cache": (cache, jnp.ones((16,), jnp.float32)),
+    }

@@ -551,6 +551,27 @@ def test_fixture_lora_clean_twin_quiet():
     assert not rep.unsuppressed(), rep.render()
 
 
+def test_fixture_speculate_planted_gl201_draft_verify_boundary():
+    """The drafting layer reading the donated cache after the verify
+    dispatch (the draft/verify boundary race) is flagged at the AST
+    level."""
+    rep = lint_paths([FIXTURES / "planted_speculate.py"], excludes=())
+    assert "GL201" in _rules_of(rep), rep.render()
+
+
+def test_fixture_speculate_planted_gl305_k_dependent_trace():
+    """A verify program keyed on the drafts' width re-specializes per draft
+    depth — the AST recompile rule flags it; the clean twin (static bucket
+    from the fixed ladder) stays quiet."""
+    rep = lint_paths([FIXTURES / "planted_speculate.py"], excludes=())
+    assert "GL305" in _rules_of(rep), rep.render()
+
+
+def test_fixture_speculate_clean_twin_quiet():
+    rep = lint_paths([FIXTURES / "clean_speculate.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
+
+
 def test_gl205_one_hop_name_resolution_and_scope():
     # the live path reaches the write through a local assignment — still hit
     src = (

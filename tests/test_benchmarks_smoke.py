@@ -183,6 +183,20 @@ def test_bench_serve_smoke():
     assert extra["batched_speedup_vs_loop"] == 0.0
     assert extra["adapter_pool"]["pool_slots"] == 0
 
+    # the speculative-decode fields ride EVERY serve report, zeros-clean
+    # with speculation off — tokens_per_step sits exactly at the plain-
+    # decode 1.0 floor a speculative run must beat
+    for field in ("speculate", "speculate_k", "accept_rate",
+                  "accept_rate_predicted", "tokens_per_step",
+                  "tokens_per_step_predicted", "draft_overhead_frac",
+                  "speculative_rollbacks", "verify_steps"):
+        assert field in extra, field
+    assert extra["speculate"] == "off" and extra["speculate_k"] == 0
+    assert extra["accept_rate"] == 0.0
+    assert extra["tokens_per_step"] == 1.0
+    assert extra["draft_overhead_frac"] == 0.0
+    assert extra["speculative_rollbacks"] == 0
+
     # idle trace: every field still present, zeros (the always-emitted
     # contract BENCH_*.json relies on)
     rep_idle = _run(["bench.py", "--serve", "--batch", "8",
@@ -194,6 +208,39 @@ def test_bench_serve_smoke():
     assert extra_idle["scheduler_occupancy"] == 0.0
     assert extra_idle["p50_token_latency_ms"] == 0.0
     assert extra_idle["adapters"] == 0 and extra_idle["adapter_swaps"] == 0
+    assert extra_idle["tokens_per_step"] == 0.0
+    assert extra_idle["accept_rate"] == 0.0
+
+
+@pytest.mark.slow
+def test_bench_serve_speculate_smoke():
+    """``--serve --speculate``: the speculative run must beat the
+    speculate-off run's tokens/step (1.0, the plain-decode floor) on the
+    seeded CPU trace, the accept-rate twin agrees (predicted trace replay
+    vs measured) within its declared tolerance, the replay stays
+    recompile-free across the verify bucket ladder, and the idle-trace
+    report keeps every speculate field zeros-clean."""
+    rep = _run(["bench.py", "--serve", "--batch", "8", "--speculate"])
+    extra = rep["extra"]
+    assert extra["speculate"] == "ngram" and extra["speculate_k"] == 4
+    assert extra["tokens_per_step"] > 1.0          # beats speculate-off's 1.0
+    assert extra["accept_rate"] > 0.0
+    assert extra["verify_steps"] > 0
+    assert extra["compiles_measured"] == 0
+    # the TwinRegistry rows: registered and within the declared tolerance
+    for name in ("speculate.accept_rate", "speculate.tokens_per_step"):
+        row = extra["twins"][name]
+        assert row["status"] in ("ok", "warn"), (name, row)
+        assert row["measured"] > 0
+        assert row["rel_err"] <= row["tolerance"], (name, row)
+    # verify bucket programs join the predicted program set
+    assert extra["programs_predicted"] == len(extra["prefill_buckets"]) + 3 + 1
+    # idle trace with speculation armed: zeros-clean
+    rep_idle = _run(["bench.py", "--serve", "--batch", "8", "--speculate",
+                     "--serve-requests", "0"])
+    ei = rep_idle["extra"]
+    assert ei["accept_rate"] == ei["tokens_per_step"] == 0.0
+    assert ei["draft_overhead_frac"] == 0.0 and ei["speculative_rollbacks"] == 0
 
 
 @pytest.mark.slow

@@ -71,3 +71,13 @@ def test_launch_leg():
 def test_telemetry_leg():
     info = graft._telemetry_leg(np.random.default_rng(0))
     assert "tokens bitwise" in info and "schema valid" in info
+
+
+@pytest.mark.slow
+def test_speculate_leg():
+    """tp=2 speculative serve: token parity vs generate() over the same
+    TP-sharded params, strict_compiles post-warmup, and a real tokens/step
+    win (the leg itself raises on any of these failing)."""
+    info = graft._speculate_leg(np.random.default_rng(0))
+    assert "parity ok" in info and "compiles=0" in info
+    assert "tp" in info  # params actually tp-sharded

@@ -378,6 +378,37 @@ def test_preflight_serve_compiles_exactly_the_ladder():
         assert row["flops"] >= 0
 
 
+@pytest.mark.slow
+def test_preflight_serve_speculate_ladder_joins_the_program_set():
+    """With speculation on, the verify bucket programs join the AOT-compiled
+    set: one verify per speculate bucket rides next to the prefill ladder,
+    GL301-303 audit the lot, and the GL303 prediction counts them (the
+    heavier-ladder compiles live in the slow tier; the tier-1 preflight
+    path keeps its <=5-compile budget with speculation off)."""
+    from accelerate_tpu.commands.preflight import preflight_serve
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.utils.dataclasses import PreflightConfig, ServingPlugin
+
+    plugin = ServingPlugin(
+        num_slots=4, page_size=4, pages_per_slot=16, num_pages=40,
+        prefill_chunk=16, prefill_buckets=(16,), decode_kernel="native",
+        speculate="ngram", speculate_k=4, speculate_buckets=(2, 4),
+    )
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    findings, rows = preflight_serve(
+        PreflightConfig(), model=model, plugin=plugin,
+        gen_config=GenerationConfig(),
+    )
+    report = Report(apply_suppressions(findings))
+    assert not report.unsuppressed(), report.render()
+    assert len(rows) == len(plugin.prefill_buckets) + 2 + len(plugin.speculate_buckets)
+    labels = {r["program"] for r in rows}
+    assert labels == {"decode", "release", "prefill[16]", "verify[2]", "verify[4]"}
+    for row in rows:
+        assert row["hbm"]["total"] > 0
+
+
 def test_preflight_program_loads_fixture_convention(tmp_path):
     from accelerate_tpu.commands.preflight import preflight_program
     from accelerate_tpu.utils.dataclasses import PreflightConfig

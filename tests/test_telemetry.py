@@ -121,7 +121,7 @@ def test_mis_predicted_twin_trips_drift_report():
     assert reg.drifting("error")[0].name == "kv_pool.utilization"
 
 
-def test_all_seven_twins_register_from_their_accounting_sites():
+def test_all_standard_twins_register_from_their_accounting_sites():
     """Every existing predicted/measured accounting site records into the
     ONE registry — the migration the autotuner substrate needs."""
     reg = twin_registry()
@@ -192,13 +192,31 @@ def test_all_seven_twins_register_from_their_accounting_sites():
     goodput_accounting(0.1, 100)
     GoodputTracker().report()
 
+    # 8 + 9. speculate accept-rate / tokens-per-step (serving/harness)
+    from accelerate_tpu.serving.harness import _speculate_fields
+    from accelerate_tpu.serving.speculate import NgramDraft, Speculator
+
+    class _SpecEng:
+        metrics = {"decode_lane_passes": 4, "decode_emitted_tokens": 6,
+                   "draft_tokens": 4, "accepted_draft_tokens": 2,
+                   "speculative_rollbacks": 1, "verify_steps": 4}
+        speculator = Speculator(NgramDraft(), 2, (2,))
+        speculate_mode = "ngram"
+
+    _speculate_fields(
+        _SpecEng(),
+        [Request(uid=0, prompt=(1, 2, 1, 2), max_new_tokens=4)],
+        {0: [5, 6, 7]}, wall_s=1.0,
+    )
+
     rows = reg.drift_report()
     for name in STANDARD_TWINS:
         assert name in rows, name
     # pairs that recorded both sides carry a real rel_err status
     for paired in ("dcn_comm.dcn_bytes", "kv_pool.utilization",
                    "adapter_pool.hit_rate", "goodput.goodput_frac",
-                   "compiles.steady_state"):
+                   "compiles.steady_state", "speculate.accept_rate",
+                   "speculate.tokens_per_step"):
         assert rows[paired]["status"] != "idle", (paired, rows[paired])
     # dcn predicted (psum slab model) vs the traced psum agree exactly:
     # 4 fp32 = 16 bytes * ring factor 1.0 on both sides of a 2-slice tree
