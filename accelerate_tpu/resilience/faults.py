@@ -30,6 +30,18 @@ site                      kinds
                           one shard file truncated or bit-flipped (the torn
                           write / bit-rot simulation the verified-manifest
                           load path must catch)
+``serve_step``            ``preempt`` (drain the serving engine at a tick
+                          boundary), ``cancel`` (a cancellation storm: the
+                          oldest live request cancels) and ``deadline`` (a
+                          deadline storm: every live request expires NOW and
+                          the degradation ladder escalates one stage)
+``verify_step``           ``preempt`` — drain mid-speculative-verify, before
+                          the pass dispatches (the finest-grained serving
+                          boundary; nothing runs, every invariant holds)
+``adapter_transfer``      ``transfer`` — the hot-swap H2D staging fails
+                          mid-prefetch, inside the bounded-retry wrapper
+``adapter_memmap``        ``transfer`` — the cold-tier memmap read fails,
+                          inside its own retry wrapper
 ========================  =====================================================
 
 Occurrence counting is per-site and 1-based: an event ``FaultEvent("preempt",
@@ -56,15 +68,21 @@ from .retry import TransientIOError
 
 logger = get_logger(__name__)
 
-FAULT_KINDS = ("preempt", "nan_grad", "transfer", "corrupt_ckpt")
+FAULT_KINDS = ("preempt", "nan_grad", "transfer", "corrupt_ckpt", "cancel",
+               "deadline")
 
 # default hook site per kind (a transfer event may override its site to
-# "checkpoint_io" to target checkpoint I/O instead of the streaming path)
+# "checkpoint_io"/"adapter_transfer"/"adapter_memmap" to target checkpoint
+# I/O or the serving hot-swap path instead of the training streaming path;
+# a preempt event may override its site to "serve_step"/"verify_step" to
+# drain the serving engine instead of SIGTERM-ing the trainer)
 KIND_DEFAULT_SITE = {
     "preempt": "step",
     "nan_grad": "step",
     "transfer": "transfer",
     "corrupt_ckpt": "post_save",
+    "cancel": "serve_step",
+    "deadline": "serve_step",
 }
 
 CORRUPTION_MODES = ("truncate", "bitflip")
@@ -162,23 +180,43 @@ class FaultPlan:
         cls, seed: int, n_steps: int, *,
         p_preempt: float = 0.0, p_nan: float = 0.0,
         p_transfer: float = 0.0, p_corrupt: float = 0.0,
+        p_cancel: float = 0.0, p_deadline: float = 0.0,
+        serving: bool = False,
     ) -> "FaultPlan":
         """A random-but-reproducible plan: each step draws each enabled fault
         kind independently at its probability.  Same seed → same plan,
-        always — the soak-test entry point."""
+        always — the soak-test entry point.
+
+        ``serving=True`` targets the serving sites: ``preempt`` lands at
+        ``serve_step`` (the chaos-replay drain-and-restart loop absorbs it,
+        so later events stay armed), ``transfer`` at ``adapter_transfer``,
+        and the ``cancel``/``deadline`` storms draw at their probabilities
+        (their default site is already ``serve_step``)."""
         rng = np.random.default_rng(seed)
         events = []
         for step in range(1, n_steps + 1):
             if p_preempt and rng.random() < p_preempt:
-                events.append(FaultEvent("preempt", at=step))
-                break  # a preemption ends the process; later events are moot
+                events.append(FaultEvent(
+                    "preempt", at=step,
+                    site="serve_step" if serving else "",
+                ))
+                if not serving:
+                    break  # a training preemption ends the process; a
+                    # serving drain restarts — later events stay armed
             if p_nan and rng.random() < p_nan:
                 events.append(FaultEvent("nan_grad", at=step))
             if p_transfer and rng.random() < p_transfer:
-                events.append(FaultEvent("transfer", at=step))
+                events.append(FaultEvent(
+                    "transfer", at=step,
+                    site="adapter_transfer" if serving else "",
+                ))
             if p_corrupt and rng.random() < p_corrupt:
                 events.append(FaultEvent("corrupt_ckpt", at=step,
                                          mode=CORRUPTION_MODES[int(rng.integers(2))]))
+            if p_cancel and rng.random() < p_cancel:
+                events.append(FaultEvent("cancel", at=step))
+            if p_deadline and rng.random() < p_deadline:
+                events.append(FaultEvent("deadline", at=step))
         return cls(events, seed=seed)
 
     def to_spec(self) -> dict:

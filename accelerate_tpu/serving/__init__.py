@@ -18,7 +18,12 @@ The production-inference rebuild of the reference's
 - :mod:`.speculate` — speculative multi-token decode (draft-and-verify):
   n-gram/prompt-lookup self-drafting and draft-model providers feeding the
   engine's fixed-shape batched verify program, with the model-free
-  predicted acceptance replay (the accept-rate twin).
+  predicted acceptance replay (the accept-rate twin);
+- :mod:`.overload` — serving resilience (docs/serving.md "Overload &
+  deadlines"): the SLO-driven graceful-degradation ladder and the
+  :func:`~.overload.verify_serving_invariants` resource-contract checker
+  behind per-request deadlines, deterministic cancellation, admission
+  control/load shedding, and the :func:`~.harness.chaos_replay` soak.
 """
 
 from .adapters import (
@@ -30,11 +35,13 @@ from .adapters import (
 )
 from .engine import ServingEngine
 from .harness import (
+    chaos_replay,
     predicted_pool_utilization,
     replay,
     static_batching_report,
     synthesize_trace,
 )
+from .overload import DegradationLadder, verify_serving_invariants
 from .paged_cache import allocate, kv_pool_accounting, pages_for, push_pages, release
 from .scheduler import ContinuousBatchingScheduler, Request, SlotState
 from .speculate import (
@@ -69,6 +76,9 @@ __all__ = [
     "speculative_page_need",
     "synthesize_trace",
     "replay",
+    "chaos_replay",
     "static_batching_report",
     "predicted_pool_utilization",
+    "DegradationLadder",
+    "verify_serving_invariants",
 ]

@@ -51,6 +51,8 @@ from ..ops.lora import (
     lora_spec,
 )
 from ..ops.streaming import LayerPrefetcher, StreamStats, predicted_overlap
+from ..resilience.faults import maybe_fail_transfer
+from ..resilience.retry import DEFAULT_POLICY, with_retries
 from ..utils.dataclasses import LoraPlugin
 
 
@@ -176,21 +178,42 @@ class AdapterStore:
 
     def _host_tree(self, tid: int) -> dict[str, np.ndarray]:
         if self._offload is not None:
-            return {
-                f"{path}/{f}": self._offload.load(f"adapter_{tid}/{path}/{f}")
-                for path in self.spec for f in ("a", "b")
-            }
+            # cold-tier memmap reads fail transiently in exactly the ways
+            # checkpoint I/O does (NFS hiccup, stale handle across a
+            # preemption) — the bounded retry/backoff budget applies, and
+            # the injected-fault hook (site "adapter_memmap") fires inside
+            # each attempt so the CPU suite exercises the real backoff path
+            def attempt():
+                maybe_fail_transfer("adapter_memmap")
+                return {
+                    f"{path}/{f}": self._offload.load(f"adapter_{tid}/{path}/{f}")
+                    for path in self.spec for f in ("a", "b")
+                }
+
+            return with_retries(
+                attempt, policy=DEFAULT_POLICY,
+                site=f"adapter_memmap[{tid}]", on_retry=self._on_retry,
+            )
         return self._host[tid]
+
+    def _on_retry(self, site, attempt, exc) -> None:
+        self.stats.transfer_retries += 1
 
     # -- hot-swap streaming -------------------------------------------------
 
     def _ensure_prefetcher(self) -> LayerPrefetcher:
         if self._prefetcher is None or self._prefetcher.n_layers != len(self._tids):
+            def fetch(idx):
+                # the serving-specific fault site: an adapter-swap transfer
+                # failing mid-prefetch raises HERE, inside the prefetcher's
+                # bounded-retry wrapper — a transient blip costs one backoff
+                # (counted into StreamStats.transfer_retries, surfaced in
+                # the replay report), not the whole replay
+                maybe_fail_transfer("adapter_transfer")
+                return jax.device_put(_nest(self._host_tree(self._tids[idx])))
+
             self._prefetcher = LayerPrefetcher(
-                lambda idx: jax.device_put(
-                    _nest(self._host_tree(self._tids[idx]))
-                ),
-                max(1, len(self._tids)), depth=0, stats=self.stats,
+                fetch, max(1, len(self._tids)), depth=0, stats=self.stats,
             )
         return self._prefetcher
 

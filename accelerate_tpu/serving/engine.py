@@ -40,6 +40,7 @@ from ..models.llama import init_paged_cache
 from ..resilience import faults as _faults
 from ..telemetry import RequestTracer
 from ..utils.dataclasses import ServingPlugin, TelemetryPlugin
+from .overload import DegradationLadder
 from .paged_cache import allocate, pages_for, push_pages, release
 from .scheduler import ContinuousBatchingScheduler, Request
 from .speculate import Speculator, make_draft_provider, speculative_page_need
@@ -347,7 +348,18 @@ class ServingEngine:
             max_bypass_age=(adapters.plugin.max_bypass_age
                             if adapters is not None else 16),
             speculate_k=p.speculate_k if self._spec is not None else 0,
+            max_queue=p.max_queue, kv_shed_watermark=p.kv_shed_watermark,
+            default_deadline_ticks=p.default_deadline_ticks,
         )
+        # overload control (serving/overload.py): the degradation ladder is
+        # always armed (escalation is explicit — an SLO trip, a deadline
+        # storm, or an operator call; every stage reuses warmed programs so
+        # strict_compiles holds through the full ladder), and cancellation
+        # requests queue here until the next tick boundary processes them
+        self.despeculated = False
+        self.ladder = DegradationLadder(self)
+        self.slo = None                      # optional attached SLOMonitor
+        self._pending_cancels: list[int] = []
         (self._decode, self._prefill, self._release, self._sample,
          self._verify) = _engine_fns(
             self.model, self.gen_config, p.page_size, adapters is not None,
@@ -417,6 +429,28 @@ class ServingEngine:
         self.sched.submit(request)
         self._arrival_wall[request.uid] = time.perf_counter()
 
+    def cancel(self, uid: int) -> None:
+        """Request cancellation of ``uid`` at whatever lifecycle stage it is
+        in (queued, mid-prefill-chunk, decoding, or mid-speculative-verify).
+        Processed at the next tick boundary — the engine's device programs
+        are atomic per tick, so the boundary is the only place every
+        resource (KV pages, adapter refcount, slot, speculative state) can
+        be released consistently.  Idempotent; unknown/finished uids are
+        dropped silently.  A cancel pending at a preemption drain is still
+        owed: :meth:`remaining_requests` hands the request back exactly
+        once."""
+        if uid not in self._pending_cancels:
+            self._pending_cancels.append(uid)
+
+    def attach_slo(self, monitor) -> "DegradationLadder":
+        """Feed per-token latency and TTFT samples into ``monitor`` as they
+        are measured and wire its trip/recover callbacks to the degradation
+        ladder (trip → escalate one stage, recover → relax one).  Returns
+        the ladder for inspection."""
+        self.slo = monitor
+        self.ladder.attach(monitor)
+        return self.ladder
+
     def idle(self) -> bool:
         return self.sched.idle()
 
@@ -431,9 +465,20 @@ class ServingEngine:
         return in_flight + list(self.sched.waiting)
 
     def remaining_requests(self) -> list[Request]:
-        """After a drain: everything still owed — in-flight + queued +
-        trace arrivals the replay never delivered."""
-        return self.unfinished_requests() + list(self._undelivered)
+        """After a drain: everything still owed — in-flight + queued + trace
+        arrivals the replay never delivered — **deduplicated by uid** and
+        excluding deliberately retired requests (shed / cancelled).  A
+        request whose :meth:`cancel` is still pending (the drain interrupted
+        before the tick boundary could process it) has NOT been retired and
+        is handed back exactly once; a processed cancel never comes back."""
+        retired = self.sched.retired_uids
+        out, seen = [], set()
+        for r in self.unfinished_requests() + list(self._undelivered):
+            if r.uid in retired or r.uid in self.results or r.uid in seen:
+                continue
+            seen.add(r.uid)
+            out.append(r)
+        return out
 
     # -- program dispatch (single-tenant vs multi-tenant arity) --------------
 
@@ -513,6 +558,20 @@ class ServingEngine:
         self.cache = self._release(
             self.cache, jnp.asarray(np.zeros((n,), bool))
         )
+        # Decode compiled FIRST, against the fresh host-built cache — but
+        # every program OUTPUT carries the steady-state placement GSPMD
+        # chose (under a mesh-sharded param tree the KV pools come back
+        # tp-sharded, not replicated).  One more no-op decode warms the
+        # program against THAT layout, so the first post-warmup decode —
+        # plain serving under sharded params, or the ladder's despeculate
+        # stage re-entering decode after verify — can never recompile
+        # mid-traffic.
+        cache, _ = self._run_decode(
+            jnp.asarray(np.zeros((n,), np.int32)),
+            jnp.asarray(np.zeros((n,), bool)),
+            jnp.asarray(np.zeros((n,), np.int32)), rng,
+        )
+        self.cache = cache
         if self.adapters is not None:
             # the pool-insert scatter is a fixed-shape production program
             # too: a first hot-swap mid-traffic must hit a warm cache
@@ -543,6 +602,17 @@ class ServingEngine:
                 # boundary stop; resilience/preemption.py discipline)
                 self.interrupted = True
                 return {"type": "preempted", "step": self.steps}
+            if ev.kind == "cancel":
+                # cancellation storm: the oldest live request cancels —
+                # deterministic, so the event-log pin covers the storm
+                self._inject_cancel_oldest()
+            elif ev.kind == "deadline":
+                # deadline storm: every live request expires NOW, and the
+                # overload signal escalates the degradation ladder one stage
+                self.sched.force_expire_all()
+                self.ladder.escalate()
+        self.sched.tick = self.steps
+        self._process_control()
         t_sched = tr.stamp() if tr is not None else 0.0
         self.sched.admit()
         action = self.sched.next_action()
@@ -589,9 +659,12 @@ class ServingEngine:
                     window = (t_disp, tr.recorder.clock())
             else:
                 event["cancelled"] = True
-        elif action[0] == "decode" and self._spec is not None:
+        elif action[0] == "decode" and self._spec is not None \
+                and not self.despeculated:
             event["type"] = "verify"
             window = self._verify_tick(action[1], tr, event)
+            if self.interrupted:  # preempt-mid-verify fault: nothing ran
+                return {"type": "preempted", "step": self.steps}
         elif action[0] == "decode":
             active_slots, evicted = self.sched.plan_evictions(action[1])
             self._release_evicted(evicted)
@@ -614,7 +687,7 @@ class ServingEngine:
                     tr.phase("dispatch:decode", t_disp,
                              slots=list(active_slots), step=self.steps)
                 self.cache = cache
-                self.sched.note_decode(needing)
+                self.sched.note_decode(needing, active_slots)
                 t_sync = tr.stamp() if tr is not None else 0.0
                 next_np = np.asarray(next_tok)
                 if tr is not None:
@@ -671,12 +744,72 @@ class ServingEngine:
 
     # -- internals -----------------------------------------------------------
 
+    def _process_control(self) -> None:
+        """The tick-boundary control pass: apply pending cancellations, then
+        retire in-flight requests whose deadline has passed.  Runs BEFORE
+        admission, so a cancelled/expired request's pages and slot are
+        available to this very tick's admissions."""
+        sched = self.sched
+        for uid in list(self._pending_cancels):
+            if self._apply_cancel(uid, reason="cancel"):
+                self._pending_cancels.remove(uid)
+            elif uid in self.results or uid in sched.retired_uids:
+                # raced a finish/shed: nothing left to cancel
+                self._pending_cancels.remove(uid)
+            # else: not yet arrived — the cancel stays pending
+        for slot in sorted(sched.slots):
+            if sched.request_expired(sched.slots[slot].request):
+                self._cancel_slot(slot, reason="deadline")
+
+    def _apply_cancel(self, uid: int, reason: str) -> bool:
+        """Cancel ``uid`` at whatever stage it is in right now.  Returns
+        True when a live request was retired."""
+        sched = self.sched
+        for slot, st in sched.slots.items():
+            if st.request.uid == uid:
+                self._cancel_slot(slot, reason=reason)
+                return True
+        return sched.cancel_queued(uid, reason=reason)
+
+    def _cancel_slot(self, slot: int, reason: str) -> None:
+        """Retire an admitted request: device pages back to the functional
+        free-list first (the same release program finish/evict drive), then
+        the scheduler's mirrored host-side release — the exact ordering that
+        keeps ``verify_serving_invariants`` green at every boundary."""
+        uid = self.sched.slots[slot].request.uid
+        self._release_slots([slot])
+        self.sched.cancel_slot(slot, reason=reason)
+        self._arrival_wall.pop(uid, None)
+        self._last_token_wall.pop(uid, None)
+        self._ttft_seen.discard(uid)
+
+    def _inject_cancel_oldest(self) -> None:
+        """The cancellation-storm fault payload: cancel the oldest live
+        request — oldest-admitted in-flight first, else the head of the
+        waiting line.  Deterministic by construction."""
+        sched = self.sched
+        if sched.slots:
+            slot = min(sched.slots, key=lambda s: sched.slots[s].admit_seq)
+            self._cancel_slot(slot, reason="cancel")
+        elif sched.waiting:
+            sched.cancel_queued(sched.waiting[0].uid, reason="cancel")
+
     def _verify_tick(self, candidate_slots, tr, event):
         """One speculative draft-and-verify pass (the decode action with
         speculation armed).  Draft first (the proposals size the page
         reservation), evict for the WORST-CASE page demand, dispatch the
         bucket-padded verify program, then settle the host mirror off the
-        device-accepted lengths.  Returns the tracing window (or None)."""
+        device-accepted lengths.  Returns the tracing window (or None).
+
+        The ``verify_step`` fault site fires FIRST — a ``preempt`` armed
+        there drains the engine mid-verify with nothing dispatched and no
+        state touched, so the drain/resume contract (and every invariant)
+        holds at the finest-grained boundary speculation has."""
+        for ev in _faults.fault_point("verify_step"):
+            if ev.kind == "preempt":
+                self.interrupted = True
+                event["preempted"] = True
+                return None
         sp = self._spec
         sched = self.sched
         cand = list(candidate_slots)
@@ -805,8 +938,12 @@ class ServingEngine:
             if uid not in self._ttft_seen:
                 self._ttft_seen.add(uid)
                 self.ttft_s.append(now - self._arrival_wall[uid])
+                if self.slo is not None:
+                    self.slo.observe("ttft_s", self.ttft_s[-1])
         elif uid in self._last_token_wall:
             self.token_gaps_s.append(now - self._last_token_wall[uid])
+            if self.slo is not None:
+                self.slo.observe("token_latency_s", self.token_gaps_s[-1])
         self._last_token_wall[uid] = now
         st.tokens.append(tok)
         if not st.prefill_done:
@@ -909,5 +1046,10 @@ class ServingEngine:
 
     def free_page_mirror_in_sync(self) -> bool:
         """Test hook: the host scheduler's free-page mirror equals the
-        device allocator's ``free_top`` (one scalar fetch)."""
+        device allocator's ``free_top`` (one scalar fetch).  The full
+        resource contract — page conservation, slot accounting, adapter
+        refcount balance — is the reusable
+        :func:`~.overload.verify_serving_invariants` checker this grew
+        into; chaos tests and ``replay(..., verify_invariants=True)`` run
+        that one."""
         return int(self.cache["free_top"]) == self.sched.free_pages

@@ -21,6 +21,8 @@ a chip to differ meaningfully, the slot arithmetic does not.
 
 from __future__ import annotations
 
+from typing import Callable, Optional
+
 import numpy as np
 
 from .paged_cache import pages_for
@@ -36,6 +38,7 @@ def synthesize_trace(
     prompt_len_range: tuple = (4, 24),
     new_tokens_range: tuple = (2, 16),
     adapters: int = 0,
+    deadline_range: Optional[tuple] = None,
 ) -> list[Request]:
     """A deterministic request trace: Poisson arrivals (exponential gaps in
     virtual engine-step time) with uniformly mixed prompt/output lengths.
@@ -44,6 +47,9 @@ def synthesize_trace(
     With ``adapters=N`` each request draws a tenant ``adapter_id`` uniformly
     from ``0..N`` — id 0 rows serve the base model, so every multi-tenant
     trace mixes no-adapter traffic in (the id-0 bitwise contract's coverage).
+    With ``deadline_range=(lo, hi)`` each request draws a per-request
+    ``deadline_ticks`` uniformly — the deadline-pressure traffic the
+    overload tests replay.
     """
     rng = np.random.default_rng(seed)
     trace = []
@@ -54,8 +60,11 @@ def synthesize_trace(
         n_new = int(rng.integers(new_tokens_range[0], new_tokens_range[1] + 1))
         prompt = tuple(int(x) for x in rng.integers(1, vocab_size, p_len))
         adapter_id = int(rng.integers(0, adapters + 1)) if adapters > 0 else 0
+        deadline = (int(rng.integers(deadline_range[0], deadline_range[1] + 1))
+                    if deadline_range is not None else 0)
         trace.append(Request(uid=uid, prompt=prompt, max_new_tokens=n_new,
-                             arrival_step=int(t), adapter_id=adapter_id))
+                             arrival_step=int(t), adapter_id=adapter_id,
+                             deadline_ticks=deadline))
     return trace
 
 
@@ -126,7 +135,7 @@ def predicted_pool_utilization(trace: list[Request], *, num_slots: int,
 
 
 def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
-           slo_monitor=None) -> dict:
+           slo_monitor=None, verify_invariants: bool = False) -> dict:
     """Run the trace through the engine and compose the serving report.
     Every field is always present (zeros on an empty/idle trace).
 
@@ -147,6 +156,15 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
     otherwise — tracing off costs nothing and changes no token).  Pass an
     :class:`~accelerate_tpu.telemetry.SLOMonitor` as ``slo_monitor`` to
     feed it the replay's per-token latency and TTFT samples.
+
+    Overload/resilience fields ride every report zeros-clean:
+    ``requests_shed`` / ``deadline_misses`` / ``cancelled`` /
+    ``pages_reclaimed_on_cancel`` / ``request_goodput_frac`` (completed over
+    completed + deliberately retired) / ``transfer_retries`` (the adapter
+    hot-swap path's absorbed transient failures) / the degradation ladder's
+    stage and engagement count.  With ``verify_invariants=True`` the full
+    resource contract (:func:`~.overload.verify_serving_invariants`) is
+    checked after the run and any violation raises.
     """
     import time
 
@@ -168,6 +186,14 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
             "the bucket ladder (chase with JAX_LOG_COMPILES=1, or pass "
             "strict_compiles=False to report anyway)"
         )
+    if verify_invariants:
+        from .overload import verify_serving_invariants
+
+        problems = verify_serving_invariants(engine)
+        if problems:
+            raise RuntimeError(
+                "serving invariants violated after replay: " + "; ".join(problems)
+            )
     m = engine.metrics
     p = engine.plugin
     import jax
@@ -195,7 +221,10 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
                measured=compiles_measured, source="serving/harness.replay")
     spec_fields = _speculate_fields(engine, trace, results, wall_s,
                                     draft_before=draft_before)
-    if slo_monitor is not None:
+    if slo_monitor is not None and getattr(engine, "slo", None) is not slo_monitor:
+        # a monitor already attached to the engine (attach_slo) saw every
+        # sample live — re-feeding it here would double-count quantiles and
+        # re-fire trips into the report being assembled
         slo_monitor.observe_many("token_latency_s", engine.token_gaps_s)
         slo_monitor.observe_many("ttft_s", engine.ttft_s)
     # overhead as THIS replay's recording cost over THIS replay's wall (a
@@ -246,10 +275,69 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
         ),
         **spec_fields,
         **telemetry_fields,
+        # overload-control + cancellation fields — ALWAYS present, zeros on
+        # a clean run (the resilience analog of the goodput block)
+        **_overload_fields(engine, trace),
         # multi-tenant adapter fields — ALWAYS present (zeros without an
         # AdapterStore), with the predicted/measured pool-hit-rate twins
         **_adapter_fields(engine, trace),
         "results": results,
+    }
+
+
+def _overload_fields(engine, trace: list[Request]) -> dict:
+    """The always-emitted overload/cancellation block of the serving report
+    (zeros-clean on a clean run): shed/deadline/cancel counters, pages
+    reclaimed by cancellation, request-level goodput (completed over
+    completed + deliberately retired), the adapter path's absorbed transfer
+    retries, and the degradation ladder's standing.  The serving twins
+    record their measured side always; the predicted side is the clean-run
+    model (zero faults, goodput 1.0) and is only recorded when no fault
+    plan is active — a chaos soak records its own predictions."""
+    from ..resilience.faults import active_fault_plan
+    from ..telemetry import twin_registry
+
+    sched = engine.sched
+    completed = len(engine.results)
+    retired = len(sched.retired_uids)
+    goodput = (round(completed / (completed + retired), 4)
+               if completed + retired else 0.0)
+    store = getattr(engine, "adapters", None)
+    retries = int(store.stats.transfer_retries) if store is not None else 0
+    reg = twin_registry()
+    measured = {
+        "serving.requests_shed": sched.requests_shed,
+        "serving.deadline_misses": sched.deadline_misses,
+        "serving.cancelled": sched.cancelled,
+        "serving.pages_reclaimed_on_cancel": sched.pages_reclaimed_on_cancel,
+        "serving.request_goodput_frac": goodput,
+    }
+    # the zero-events clean-run model only applies when nothing could
+    # legitimately shed or expire: no fault plan, no overload knobs armed,
+    # no per-request deadlines in the trace — intended admission-control
+    # shedding must never read as a twin "error"
+    clean_predictions = (
+        active_fault_plan() is None
+        and not sched.max_queue and not sched.kv_shed_watermark
+        and not sched.default_deadline_ticks and not sched.shed_armed
+        and not any(r.deadline_ticks for r in trace)
+    )
+    for name, value in measured.items():
+        reg.record_measured(name, value, source="serving/harness._overload_fields")
+        if clean_predictions:
+            pred = (1.0 if name.endswith("request_goodput_frac") and trace
+                    else 0.0)
+            reg.record_predicted(name, pred,
+                                 source="serving/harness clean-run model")
+    return {
+        "requests_shed": sched.requests_shed,
+        "deadline_misses": sched.deadline_misses,
+        "cancelled": sched.cancelled,
+        "pages_reclaimed_on_cancel": sched.pages_reclaimed_on_cancel,
+        "request_goodput_frac": goodput,
+        "transfer_retries": retries,
+        "ladder_stage": engine.ladder.stage,
+        "ladder_engagements": engine.ladder.engagements,
     }
 
 
@@ -356,6 +444,130 @@ def _adapter_fields(engine, trace: list[Request]) -> dict:
         "adapter_pool_hit_rate_predicted": predicted_hit,
         "adapter_swaps": store.swaps,
         "adapter_swap_bytes": store.swap_bytes,
+    }
+
+
+def chaos_replay(engine_factory: Callable[[], object], trace: list[Request],
+                 plan, *, max_restarts: int = 8, verify_invariants: bool = True,
+                 strict_compiles: bool = True,
+                 baseline_parity: bool = True) -> dict:
+    """Seeded chaos soak: replay ``trace`` under a
+    :class:`~accelerate_tpu.resilience.FaultPlan` of serving faults
+    (cancellation storms, deadline storms, adapter-transfer failures,
+    preempt-at-tick / preempt-mid-verify), restarting a fresh engine after
+    every drain, until the traffic is fully disposed of (completed, shed or
+    cancelled).
+
+    The acceptance pin this function exists for: **surviving requests'
+    greedy tokens are BITWISE identical to a fault-free replay of the same
+    surviving set** — faults may change *which* requests complete, never
+    *what* a completed request says.  After every engine (drained or done)
+    the full resource contract runs
+    (:func:`~.overload.verify_serving_invariants` — free-page mirror exact,
+    zero leaked pages, adapter refcounts balanced), and post-warmup compile
+    events stay at zero per engine (``strict_compiles``) — a fault must
+    never push the engine off its warmed program set.
+
+    ``engine_factory`` builds a fresh engine per life (the process-shared
+    jit cache makes restarts cheap).  Returns the soak report: surviving
+    ``results``, ``token_parity``, restart/fault/retirement counters, and
+    ``invariant_problems`` (empty on a healthy engine).
+    """
+    import dataclasses as _dc
+
+    from ..resilience.faults import fault_plan as _fault_plan
+    from .overload import verify_serving_invariants
+
+    results: dict[int, list] = {}
+    restarts = 0
+    compiles_measured = 0
+    invariant_problems: list[str] = []
+    counters = {"requests_shed": 0, "deadline_misses": 0, "cancelled": 0,
+                "pages_reclaimed_on_cancel": 0, "transfer_retries": 0}
+    pending = [_dc.replace(r) for r in
+               sorted(trace, key=lambda r: (r.arrival_step, r.uid))]
+    with _fault_plan(plan):
+        while pending:
+            engine = engine_factory()
+            engine.warmup()
+            before = engine.compile_events
+            engine.run(pending)
+            compiles_measured += engine.compile_events - before
+            results.update(engine.results)
+            sched = engine.sched
+            counters["requests_shed"] += sched.requests_shed
+            counters["deadline_misses"] += sched.deadline_misses
+            counters["cancelled"] += sched.cancelled
+            counters["pages_reclaimed_on_cancel"] += sched.pages_reclaimed_on_cancel
+            store = getattr(engine, "adapters", None)
+            if store is not None:
+                counters["transfer_retries"] += int(store.stats.transfer_retries)
+            if verify_invariants:
+                invariant_problems.extend(verify_serving_invariants(engine))
+            if not engine.interrupted:
+                break
+            # drained: a fresh engine serves the remainder (arrivals rebased
+            # — the drain consumed the virtual clock the originals were
+            # keyed on; relative order is preserved by uid)
+            pending = [_dc.replace(r, arrival_step=0)
+                       for r in engine.remaining_requests()]
+            restarts += 1
+            if restarts > max_restarts:
+                raise RuntimeError(
+                    f"chaos replay exceeded {max_restarts} restarts with "
+                    f"{len(pending)} requests still pending"
+                )
+    if strict_compiles and compiles_measured > 0:
+        raise RuntimeError(
+            f"{compiles_measured} post-warmup compile event(s) during the "
+            "chaos soak: a fault pushed the engine off its warmed program set"
+        )
+    if invariant_problems:
+        raise RuntimeError(
+            "serving invariants violated during the chaos soak: "
+            + "; ".join(invariant_problems)
+        )
+    token_parity = True
+    if baseline_parity and results:
+        # fault-free replay of the SAME surviving set (no plan installed):
+        # deadlines dropped — the baseline measures what the survivors SAY,
+        # and a deadline re-expiring in the quieter baseline schedule would
+        # change which requests complete, not their tokens
+        survivors = [
+            _dc.replace(r, arrival_step=0, deadline_ticks=0)
+            for r in sorted(trace, key=lambda r: r.uid) if r.uid in results
+        ]
+        baseline = engine_factory()
+        # the baseline must serve the surviving set UNCONDITIONALLY: its
+        # admission controls disarm, because a bounded queue, a pressure
+        # watermark or a default deadline would shed/expire survivors the
+        # chaos run completed (all rebased to arrival 0) and fail the
+        # parity pin spuriously — the pin is about tokens, not policy
+        baseline.sched.max_queue = 0
+        baseline.sched.kv_shed_watermark = 0.0
+        baseline.sched.default_deadline_ticks = 0
+        baseline.warmup()
+        base_results = baseline.run(survivors)
+        token_parity = base_results == results
+    from ..telemetry import twin_registry
+
+    total = len(trace)
+    twin_registry().record_measured(
+        "serving.request_goodput_frac",
+        round(len(results) / total, 4) if total else 0.0,
+        source="serving/harness.chaos_replay",
+    )
+    return {
+        "requests": total,
+        "completed": len(results),
+        "survivor_frac": round(len(results) / total, 4) if total else 0.0,
+        "restarts": restarts,
+        "faults_fired": len(plan.fired),
+        "compiles_measured": compiles_measured,
+        "token_parity": token_parity,
+        "invariant_problems": invariant_problems,
+        **counters,
+        "results": results,
     }
 
 

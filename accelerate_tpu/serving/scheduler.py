@@ -32,6 +32,27 @@ Policy (every knob in :class:`~accelerate_tpu.utils.dataclasses.ServingPlugin`):
   the **youngest admitted** sequence is preempted — its pages are released
   and the request requeues at the head of the waiting line with its prompt
   intact (recompute-on-readmit, the vLLM default).
+- **Overload control** (docs/serving.md "Overload & deadlines"): the waiting
+  line is bounded (``max_queue``) and sheds when the bound or the
+  **predicted KV pressure** (used pages + every queued prompt's admission
+  demand, as a pool fraction vs ``kv_shed_watermark``) is exceeded.  The
+  shed policy is deterministic: **oldest-beyond-deadline first**, then the
+  youngest arrival (the newcomer backs off).  Sheds never touch admitted
+  sequences — load shedding is an admission-control decision.
+- **Deadlines**: a request carrying ``deadline_ticks`` expires
+  ``deadline_ticks`` engine ticks after ``arrival_step``; expired queued
+  requests shed (reason ``"deadline"``) and expired in-flight requests are
+  cancelled by the engine through :meth:`cancel_slot` — both count as
+  ``deadline_misses``.
+- **Cancellation**: :meth:`cancel_queued` / :meth:`cancel_slot` retire a
+  request at any stage, releasing every resource it holds (pages by the
+  same ``pages_for(kv_tokens)`` arithmetic finish/evict use, the slot, the
+  adapter refcount).  ``retired_uids`` records deliberate retirements so a
+  preemption drain never hands a cancelled request back.
+
+Every decision appends to ``events`` — the determinism log now including
+``("shed", uid, reason)`` / ``("cancel", uid, stage, reason)`` /
+``("ladder", stage)`` entries, pinned by tests/test_overload.py.
 """
 
 from __future__ import annotations
@@ -53,7 +74,11 @@ class Request:
     feeds arrivals deterministically by step index, not wall clock).
     ``adapter_id`` is the requesting TENANT's LoRA adapter (0 = the base
     model); admission maps it to a device pool slot through the
-    :class:`~.adapters.AdapterStore`.
+    :class:`~.adapters.AdapterStore`.  ``deadline_ticks`` is the request's
+    latency budget in the same virtual time: the request expires
+    ``deadline_ticks`` ticks after ``arrival_step`` (0 = no deadline) —
+    expired queued requests shed, expired in-flight requests cancel, and
+    both count as deadline misses.
     """
 
     uid: int
@@ -61,6 +86,7 @@ class Request:
     max_new_tokens: int
     arrival_step: int = 0
     adapter_id: int = 0
+    deadline_ticks: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -117,7 +143,9 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, num_slots: int, num_pages: int, page_size: int,
                  pages_per_slot: int, prefill_chunk: int, prefill_buckets: tuple,
-                 adapters=None, max_bypass_age: int = 16, speculate_k: int = 0):
+                 adapters=None, max_bypass_age: int = 16, speculate_k: int = 0,
+                 max_queue: int = 0, kv_shed_watermark: float = 0.0,
+                 default_deadline_ticks: int = 0):
         self.num_slots = num_slots
         self.num_pages = num_pages
         self.page_size = page_size
@@ -127,15 +155,33 @@ class ContinuousBatchingScheduler:
         self.adapters = adapters             # AdapterStore (multi-tenant mode)
         self.max_bypass_age = max_bypass_age
         self.speculate_k = speculate_k       # admission reserves verify pages
+        self.max_queue = max_queue           # waiting-line bound (0 = unbounded)
+        self.kv_shed_watermark = kv_shed_watermark  # predicted-pressure shed (0 = off)
+        self.default_deadline_ticks = default_deadline_ticks
         self.waiting: deque[Request] = deque()
         self.slots: dict[int, SlotState] = {}
         self.free_slots: list[int] = list(range(num_slots))
         self.free_pages = num_pages          # host mirror of the device stack
+        self.tick = 0                        # virtual engine time (the engine
+                                             # sets it each step; deadlines
+                                             # expire against it)
         self._admit_counter = 0
         self._last_was_prefill = False
         self._head_block_age = 0             # ticks the line head has been
         self._head_block_uid = None          # adapter-blocked (fairness bound)
         self.events: list[tuple] = []        # the determinism log
+        # overload / cancellation bookkeeping (docs/serving.md): the ladder
+        # mutates the two knobs below; the counters feed the serving report
+        self.admission_reserve_pages = 0     # tightened-admission free floor
+        self.shed_armed = False              # ladder stage 4: queue clamps to
+                                             # num_slots and sheds aggressively
+        self.requests_shed = 0
+        self.deadline_misses = 0
+        self.cancelled = 0
+        self.pages_reclaimed_on_cancel = 0
+        self.retired_uids: set[int] = set()  # shed/cancelled — deliberately
+                                             # retired, never handed back
+        self._force_expired: set[int] = set()  # deadline-storm fault payload
 
     # -- queueing -----------------------------------------------------------
 
@@ -167,11 +213,142 @@ class ContinuousBatchingScheduler:
                 f"(min(pages_per_slot={self.pages_per_slot}, "
                 f"num_pages={self.num_pages}) * page_size={self.page_size})"
             )
+        if request.deadline_ticks < 0:
+            raise ValueError(
+                f"request {request.uid}: deadline_ticks must be >= 0 "
+                f"(got {request.deadline_ticks})"
+            )
+        if request.deadline_ticks == 0 and self.default_deadline_ticks:
+            request = dataclasses.replace(
+                request, deadline_ticks=self.default_deadline_ticks
+            )
         self.waiting.append(request)
         self.events.append(("submit", request.uid))
+        # backpressure at the door: the bound holds between ticks too, so a
+        # burst of submits can never grow the line past max_queue
+        if self.max_queue:
+            while len(self.waiting) > self.max_queue:
+                self._shed(self._shed_victim(), "queue")
 
     def requeue_front(self, request: Request) -> None:
         self.waiting.appendleft(request)
+
+    # -- deadlines / shedding / cancellation ---------------------------------
+
+    def request_expired(self, req: Request) -> bool:
+        """Has ``req``'s deadline passed at the current :attr:`tick`?  A
+        deadline-storm fault (:mod:`~accelerate_tpu.resilience.faults`)
+        force-expires live uids through :meth:`force_expire_all`."""
+        if req.uid in self._force_expired:
+            return True
+        return bool(req.deadline_ticks) and \
+            self.tick >= req.arrival_step + req.deadline_ticks
+
+    def force_expire_all(self) -> None:
+        """Deadline storm: every live request (queued + in-flight) expires
+        NOW — queued ones shed on the next policy pass, in-flight ones are
+        cancelled by the engine's deadline sweep."""
+        for req in self.waiting:
+            self._force_expired.add(req.uid)
+        for st in self.slots.values():
+            self._force_expired.add(st.request.uid)
+
+    def _shed_victim(self) -> int:
+        """Index into ``waiting`` of the deterministic shed victim:
+        **oldest-beyond-deadline first** (earliest arrival, uid breaking
+        ties), else the youngest arrival — the newcomer backs off."""
+        expired = [
+            i for i, req in enumerate(self.waiting) if self.request_expired(req)
+        ]
+        if expired:
+            return min(expired, key=lambda i: (self.waiting[i].arrival_step,
+                                               self.waiting[i].uid))
+        return max(range(len(self.waiting)),
+                   key=lambda i: (self.waiting[i].arrival_step,
+                                  self.waiting[i].uid))
+
+    def _shed(self, idx: int, reason: str) -> Request:
+        req = self.waiting[idx]
+        del self.waiting[idx]
+        self.requests_shed += 1
+        # an expired victim is a deadline miss whatever triggered the shed
+        # (the queue bound may pick the oldest-beyond-deadline first —
+        # shedding it one tick earlier must not hide the miss)
+        if reason == "deadline" or self.request_expired(req):
+            self.deadline_misses += 1
+        self.retired_uids.add(req.uid)
+        self._force_expired.discard(req.uid)
+        self.events.append(("shed", req.uid, reason))
+        return req
+
+    def predicted_kv_pressure(self) -> float:
+        """Predicted pool pressure if the whole waiting line admitted: used
+        pages plus every queued prompt's admission demand, as a fraction of
+        the pool (the ``kv_shed_watermark`` comparand)."""
+        demand = sum(self.admission_page_need(r) for r in self.waiting)
+        return (self.used_pages + demand) / self.num_pages
+
+    def _enforce_queue_policy(self) -> None:
+        """The per-tick admission-control pass, in deterministic order:
+        (1) expired queued requests shed (deadline misses), (2) the queue
+        bound holds, (3) predicted KV pressure sheds down to the watermark,
+        (4) the ladder's shed stage clamps the line to ``num_slots``."""
+        i = 0
+        while i < len(self.waiting):
+            if self.request_expired(self.waiting[i]):
+                self._shed(i, "deadline")
+            else:
+                i += 1
+        if self.max_queue:
+            while len(self.waiting) > self.max_queue:
+                self._shed(self._shed_victim(), "queue")
+        if self.kv_shed_watermark:
+            while self.waiting and \
+                    self.predicted_kv_pressure() > self.kv_shed_watermark:
+                self._shed(self._shed_victim(), "kv_pressure")
+        if self.shed_armed:
+            while len(self.waiting) > self.num_slots:
+                self._shed(self._shed_victim(), "overload")
+
+    def cancel_queued(self, uid: int, reason: str = "cancel") -> bool:
+        """Retire a still-queued request.  Returns False when ``uid`` is not
+        in the waiting line (idempotent — the engine's cancel API retries at
+        whatever stage the request is actually in)."""
+        for i, req in enumerate(self.waiting):
+            if req.uid == uid:
+                del self.waiting[i]
+                self._retire_cancelled(req, "queued", reason, 0)
+                return True
+        return False
+
+    def cancel_slot(self, slot: int, reason: str = "cancel") -> Request:
+        """Retire an admitted request at whatever stage it is in
+        (mid-prefill-chunk or decoding), releasing the slot, its pages (the
+        same ``pages_for(kv_tokens)`` arithmetic finish/evict use — the
+        engine releases the device side with the same mask first) and its
+        adapter hold.  The resource contract
+        :func:`~.overload.verify_serving_invariants` pins."""
+        st = self.slots.pop(slot)
+        freed = int(pages_for(st.kv_tokens, self.page_size))
+        self.free_pages += freed
+        self.free_slots.append(slot)
+        self.free_slots.sort()
+        if self.adapters is not None:
+            self.adapters.unpin(st.request.adapter_id)
+        stage = "decode" if st.prefill_done else "prefill"
+        self._retire_cancelled(st.request, stage, reason, freed)
+        return st.request
+
+    def _retire_cancelled(self, req: Request, stage: str, reason: str,
+                          freed: int) -> None:
+        self.pages_reclaimed_on_cancel += freed
+        if reason == "deadline":
+            self.deadline_misses += 1
+        else:
+            self.cancelled += 1
+        self.retired_uids.add(req.uid)
+        self._force_expired.discard(req.uid)
+        self.events.append(("cancel", req.uid, stage, reason))
 
     # -- admission ----------------------------------------------------------
 
@@ -202,7 +379,12 @@ class ContinuousBatchingScheduler:
         FIFO, except that a head blocked on adapter-pool contention is
         bypassed by adapter-ready requests for at most ``max_bypass_age``
         ticks (see the module policy).  Admission PINS the request's
-        adapter before scheduling it.  Returns the admitted slot ids."""
+        adapter before scheduling it.  The overload-control pass (deadline
+        expiry, queue bound, KV-pressure watermark) runs first, and a
+        tightened ladder (:attr:`admission_reserve_pages`) additionally
+        keeps a free-page floor the admitted prompt may not dip under.
+        Returns the admitted slot ids."""
+        self._enforce_queue_policy()
         if self.adapters is not None:
             # hot-swap streaming: dispatch the next arrivals' adapter uploads
             # under the current step's compute (LayerPrefetcher double
@@ -230,7 +412,12 @@ class ContinuousBatchingScheduler:
             if idx is None:
                 break
             req = self.waiting[idx]
-            if self.admission_page_need(req) > self.free_pages:
+            # the tightened-admission reserve only applies while the pool is
+            # actually contended: with zero occupied slots the head admits
+            # regardless, so tightening can never idle-spin an empty engine
+            # (the admit-vs-submit livelock guard, extended to the ladder)
+            reserve = self.admission_reserve_pages if self.slots else 0
+            if self.admission_page_need(req) > self.free_pages - reserve:
                 break
             del self.waiting[idx]
             adapter_slot = 0
@@ -437,8 +624,18 @@ class ContinuousBatchingScheduler:
         self.free_pages -= pages_for(st.prefilled, self.page_size) - before
         self.events.append(("prefill", st.request.uid, slot, st.prefilled))
 
-    def note_decode(self, slots_needing_pages: list[int]) -> None:
+    def note_decode(self, slots_needing_pages: list[int],
+                    active_slots: Optional[list] = None) -> None:
         self.free_pages -= len(slots_needing_pages)
+        if active_slots:
+            # a slot carrying an explicit kv_len (set by an earlier verify
+            # pass) advances it here too: a despeculated plain-decode step
+            # writes exactly one KV position per active slot, and the page
+            # arithmetic must keep following the device
+            for s in active_slots:
+                st = self.slots.get(s)
+                if st is not None and st.kv_len is not None:
+                    st.kv_len += 1
         self.events.append(("decode", tuple(sorted(slots_needing_pages))))
 
     def note_verify(self, accepted: dict) -> None:
@@ -470,6 +667,7 @@ class ContinuousBatchingScheduler:
         self.free_slots.sort()
         if self.adapters is not None:
             self.adapters.unpin(st.request.adapter_id)
+        self._force_expired.discard(st.request.uid)
         self.events.append(("finish", st.request.uid, slot))
         return st
 

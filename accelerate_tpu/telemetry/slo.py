@@ -137,7 +137,11 @@ class SLOMonitor:
     quantile table is always queryable.
 
     >>> mon = SLOMonitor({"ttft_s": {"p99_trip": 0.5}},
-    ...                  on_trip=lambda m, q, v: engine.drain())
+    ...                  on_trip=lambda m, q, v: engine.ladder.escalate())
+
+    ``on_recover`` fires on the transition back to ``ok`` from any breach
+    level — the degradation ladder's relax signal
+    (``ServingEngine.attach_slo`` wires trip → escalate, recover → relax).
     """
 
     DEFAULT_METRICS = ("token_latency_s", "ttft_s", "step_time_s",
@@ -145,10 +149,12 @@ class SLOMonitor:
 
     def __init__(self, thresholds: Optional[dict] = None,
                  on_warn: Optional[Callable] = None,
-                 on_trip: Optional[Callable] = None):
+                 on_trip: Optional[Callable] = None,
+                 on_recover: Optional[Callable] = None):
         self.thresholds = dict(thresholds or {})
         self.on_warn = on_warn
         self.on_trip = on_trip
+        self.on_recover = on_recover
         self._est: dict[str, dict[str, StreamingQuantile]] = {}
         self._state: dict[str, str] = {}   # metric -> "ok"|"warn"|"trip"
         self.warn_count = 0
@@ -196,7 +202,8 @@ class SLOMonitor:
         prev = self._state[metric]
         if level != prev:
             self._state[metric] = level
-            # fire on the transition INTO (or up through) a breach level
+            # fire on the transition INTO (or up through) a breach level,
+            # and on the transition back OUT (the ladder's relax signal)
             if level == "trip":
                 self.trip_count += 1
                 if self.on_trip is not None:
@@ -205,6 +212,8 @@ class SLOMonitor:
                 self.warn_count += 1
                 if self.on_warn is not None:
                     self.on_warn(metric, which, self._est[metric][which].value())
+            elif level == "ok" and self.on_recover is not None:
+                self.on_recover(metric, None, 0.0)
 
     # -- queries ------------------------------------------------------------
 

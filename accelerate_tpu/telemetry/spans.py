@@ -230,13 +230,19 @@ class RequestTracer:
     admit; re-emitted as the readmit wait after an eviction), ``admit``/
     ``evict``/``retire`` instants, one ``prefill_chunk`` span per chunk
     (bracketing the chunk's real dispatch+sync window), one ``decode`` span
-    from prefill completion to retirement, and ``adapter_swap`` instants
-    when admission hot-swapped the tenant's adapter in.
+    from prefill completion to retirement, ``adapter_swap`` instants when
+    admission hot-swapped the tenant's adapter in, and the overload-control
+    retirements: a ``shed`` instant (admission-control drop, with its
+    reason — queue / kv_pressure / deadline / overload) or a ``cancel``
+    instant (any-stage retirement, with the stage it struck at and the
+    reason — an explicit cancel or a deadline miss).
 
     **Per-step track** (``engine``): ``schedule`` (admission + the
     scheduler decision), ``dispatch:<kind>`` (the device program call —
     async, so this is host dispatch time), ``host_sync`` (the token
-    fetch).  All host-side: the engine's device programs are untouched.
+    fetch), and ``ladder`` instants marking degradation-ladder stage
+    transitions.  All host-side: the engine's device programs are
+    untouched.
     """
 
     def __init__(self, capacity: int = 4096,
@@ -306,6 +312,24 @@ class RequestTracer:
                 # the readmit wait is the next queued span
                 self._submit_ts[uid] = now
                 self._decode_start.pop(uid, None)
+            elif kind == "shed":
+                uid, reason = ev[1], ev[2]
+                rec.instant("shed", f"req {uid}", cat="request", step=step,
+                            reason=reason)
+                self._submit_ts.pop(uid, None)
+            elif kind == "cancel":
+                uid, stage, reason = ev[1], ev[2], ev[3]
+                start = self._decode_start.pop(uid, None)
+                if start is not None:
+                    # close the open decode span at the cancellation point
+                    rec.complete("decode", f"req {uid}", start, now,
+                                 cat="request", step=step)
+                rec.instant("cancel", f"req {uid}", cat="request", step=step,
+                            stage=stage, reason=reason)
+                self._submit_ts.pop(uid, None)
+            elif kind == "ladder":
+                rec.instant("ladder", "engine", cat="overload", stage=ev[1],
+                            step=step)
             elif kind == "finish":
                 uid = ev[1]
                 start = self._decode_start.pop(uid, now)

@@ -13,7 +13,7 @@ the ROADMAP-5 cost-model-driven autotuner ranks knobs with.
 
 Conventions:
 
-- **Names** are ``<subsystem>.<quantity>`` (the canonical nine are in
+- **Names** are ``<subsystem>.<quantity>`` (the canonical set is in
   :data:`STANDARD_TWINS`); registering twice is idempotent and updates
   nothing but the recorded values.
 - **rel_err** is the symmetric relative error ``|m - p| / max(|p|, |m|)``
@@ -66,6 +66,19 @@ STANDARD_TWINS: dict[str, tuple] = {
     # the recompile guard: predicted 0 post-warmup vs the monitoring stream
     # — tolerance 0.0: ANY disagreement is an error
     "compiles.steady_state": ("events", 0.0, 0.0),
+    # serving overload control (serving/harness._overload_fields): the
+    # clean-run model predicts ZERO sheds/misses/cancels/reclaims — any
+    # measured event on a clean, unarmed replay is an error.  With a
+    # FaultPlan active, overload knobs armed, or deadlines in the trace,
+    # only the measured side records (a chaos soak owns its predictions;
+    # intended admission-control shedding is policy, not drift) — the rows
+    # never false-alarm on purpose-injected chaos or configured shedding
+    "serving.requests_shed": ("events", 0.0, 0.0),
+    "serving.deadline_misses": ("events", 0.0, 0.0),
+    "serving.cancelled": ("events", 0.0, 0.0),
+    "serving.pages_reclaimed_on_cancel": ("pages", 0.0, 0.0),
+    # completed / (completed + deliberately retired); clean-run model: 1.0
+    "serving.request_goodput_frac": ("frac", 0.1, None),
 }
 
 
@@ -143,7 +156,7 @@ class TwinRegistry:
             return twin
 
     def declare_standard_twins(self) -> None:
-        """Pre-register the canonical nine (:data:`STANDARD_TWINS`) so the
+        """Pre-register the canonical set (:data:`STANDARD_TWINS`) so the
         bench ``twins`` block is zeros-clean: every name present, idle rows
         carrying zeros, whether or not the run exercised the subsystem."""
         for name, (units, tol, err_tol) in STANDARD_TWINS.items():

@@ -522,6 +522,26 @@ class ServingPlugin(KwargsHandler):
                                              # draft token; env
                                              # ACCELERATE_SERVE_SPECULATE_DRAFT,
                                              # default 32)
+    max_queue: Optional[int] = None          # bounded waiting line: beyond this
+                                             # depth the deterministic shed
+                                             # policy drops requests (0 =
+                                             # unbounded; env
+                                             # ACCELERATE_SERVE_MAX_QUEUE)
+    kv_shed_watermark: Optional[float] = None  # predicted KV pressure (used +
+                                             # queued prompt demand, as a pool
+                                             # fraction) beyond which queued
+                                             # requests shed (0.0 = off; env
+                                             # ACCELERATE_SERVE_KV_WATERMARK)
+    default_deadline_ticks: Optional[int] = None  # deadline (engine ticks from
+                                             # arrival) stamped on requests that
+                                             # carry none (0 = no deadline; env
+                                             # ACCELERATE_SERVE_DEADLINE)
+    ladder_reserve_frac: Optional[float] = None  # free-page reserve admission
+                                             # must keep once the degradation
+                                             # ladder tightens (fraction of the
+                                             # pool; env
+                                             # ACCELERATE_SERVE_LADDER_RESERVE,
+                                             # default 0.125)
 
     def __post_init__(self):
         env = os.environ
@@ -583,6 +603,35 @@ class ServingPlugin(KwargsHandler):
                 )
             if self.speculate_buckets[0] < 1:
                 raise ValueError("speculate_buckets entries must be >= 1")
+        if self.max_queue is None:
+            self.max_queue = int(env.get("ACCELERATE_SERVE_MAX_QUEUE", 0))
+        if self.kv_shed_watermark is None:
+            self.kv_shed_watermark = float(
+                env.get("ACCELERATE_SERVE_KV_WATERMARK", 0.0)
+            )
+        if self.default_deadline_ticks is None:
+            self.default_deadline_ticks = int(env.get("ACCELERATE_SERVE_DEADLINE", 0))
+        if self.ladder_reserve_frac is None:
+            self.ladder_reserve_frac = float(
+                env.get("ACCELERATE_SERVE_LADDER_RESERVE", 0.125)
+            )
+        if self.max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0 (0 = unbounded), got {self.max_queue}")
+        if not 0.0 <= self.kv_shed_watermark <= 1.0:
+            raise ValueError(
+                f"kv_shed_watermark must be in [0, 1] (0 = off), got "
+                f"{self.kv_shed_watermark}"
+            )
+        if self.default_deadline_ticks < 0:
+            raise ValueError(
+                f"default_deadline_ticks must be >= 0 (0 = none), got "
+                f"{self.default_deadline_ticks}"
+            )
+        if not 0.0 < self.ladder_reserve_frac < 1.0:
+            raise ValueError(
+                f"ladder_reserve_frac must be in (0, 1), got "
+                f"{self.ladder_reserve_frac}"
+            )
         for name in ("num_slots", "page_size", "pages_per_slot", "num_pages",
                      "prefill_chunk"):
             if getattr(self, name) < 1:

@@ -435,7 +435,17 @@ def serve_report(args) -> dict:
     EVERY serve report zeros-clean: ``accept_rate`` (+``_predicted`` via
     the model-free trace replay — the TwinRegistry pair), ``tokens_per_step``
     (+``_predicted``; 1.0 is the plain-decode floor the speculative run
-    must beat), ``draft_overhead_frac``, ``speculative_rollbacks``."""
+    must beat), ``draft_overhead_frac``, ``speculative_rollbacks``.
+
+    The overload-control block (serving/overload.py) rides EVERY serve
+    report zeros-clean too: ``requests_shed`` / ``deadline_misses`` /
+    ``cancelled`` / ``pages_reclaimed_on_cancel`` /
+    ``request_goodput_frac`` (1.0 on a clean busy replay) /
+    ``transfer_retries`` (adapter hot-swap transients absorbed by the
+    bounded retry layer) / ``ladder_stage`` + ``ladder_engagements`` (the
+    graceful-degradation ladder's standing), with the matching
+    ``serving.*`` rows in the ``twins`` block pinned to the clean-run
+    model (zero sheds/misses/cancels, goodput 1.0)."""
     import dataclasses as _dc
     import tempfile
     import time as _time

@@ -59,8 +59,9 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "AdapterStore", "LoraTrainer", "adapter_pool_accounting",
         "predicted_adapter_hit_rate",
         "allocate", "release", "push_pages", "pages_for", "kv_pool_accounting",
-        "synthesize_trace", "replay", "static_batching_report",
-        "predicted_pool_utilization",
+        "synthesize_trace", "replay", "chaos_replay", "static_batching_report",
+        "predicted_pool_utilization", "DegradationLadder",
+        "verify_serving_invariants",
     ]),
     "speculate": ("accelerate_tpu.serving.speculate", [
         "NgramDraft", "DraftModelDraft", "Speculator", "make_draft_provider",

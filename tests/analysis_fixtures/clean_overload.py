@@ -1,0 +1,51 @@
+"""CLEAN overload-control twins — the discipline the real engine uses
+(``serving/engine.py`` + ``serving/scheduler.py``).
+
+Each function mirrors one in ``planted_overload.py`` with the hazard
+retired: the reclaim accounting reads the RETURNED cache (the donated name
+is dead after the release dispatch — the production engine's host
+``kv_tokens`` mirror plays this role with no device fetch at all), and the
+shed arithmetic is host-side with any device mask padded to a static bound
+(one compile, ever — the shed path never re-keys compiles).  graft-lint
+must stay quiet on every function here.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _release(cache, mask):
+    seq_lens = jnp.where(mask, 0, cache["seq_lens"])
+    return {"k_pages": cache["k_pages"], "seq_lens": seq_lens}
+
+
+jitted_release = jax.jit(_release, donate_argnums=(0,))
+
+
+def cancel_reuses_donated_cache(cache, cancel_mask):
+    # the reclaim accounting reads the RETURNED structure: the donated name
+    # is dead after the release dispatch (in production the scheduler's
+    # host free-page mirror does this arithmetic with no device fetch)
+    new_cache = jitted_release(cache, cancel_mask)
+    pages_reclaimed = new_cache["seq_lens"].sum()
+    return new_cache, pages_reclaimed
+
+
+@partial(jax.jit, static_argnames=("bound",))
+def shed_mask_queue_iota(x, bound):
+    """GL305 fixed: the width is a static queue BOUND (``max_queue``), not
+    this tick's live queue depth — queues of any length pad up to it, one
+    compile ever."""
+    return x + jnp.arange(bound)
+
+
+def example_args():
+    cache = {
+        "k_pages": jnp.zeros((4, 8, 16), jnp.float32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "cancel_reuses_donated_cache": (cache, jnp.zeros((4,), bool)),
+    }

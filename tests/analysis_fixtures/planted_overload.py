@@ -1,0 +1,57 @@
+"""PLANTED overload-control hazards — the two ways the cancellation/shed
+machinery breaks the serving contracts (corrected twins:
+``clean_overload.py``).
+
+Cancellation releases a request's pages through the donated release
+program; the tempting bug is computing the ``pages_reclaimed_on_cancel``
+accounting off the DONATED cache structure after the release dispatch —
+``cancel_reuses_donated_cache`` carries that shape (GL201, the async-ckpt
+race applied across the cancel/release boundary; the real engine keeps a
+host-side mirror and never touches the donated pytree).
+``shed_mask_queue_iota`` carries the queue-length-dependent trace (GL305):
+a shed program keyed on the waiting line's length re-specializes every time
+the queue grows or shrinks — the shed path must never re-key compiles
+(admission control is HOST arithmetic; anything on device pads to a fixed
+bound).  Excluded from repo-wide sweeps like the rest of this directory.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _release(cache, mask):
+    seq_lens = jnp.where(mask, 0, cache["seq_lens"])
+    return {"k_pages": cache["k_pages"], "seq_lens": seq_lens}
+
+
+jitted_release = jax.jit(_release, donate_argnums=(0,))
+
+
+def cancel_reuses_donated_cache(cache, cancel_mask):
+    # GL201: `cache` was donated to the release step — XLA may already be
+    # overwriting its buffers in place when the reclaim accounting reads
+    # seq_lens off the STALE structure instead of the returned one (the
+    # production engine reads its host kv_tokens mirror: no device fetch)
+    new_cache = jitted_release(cache, cancel_mask)
+    pages_reclaimed = cache["seq_lens"].sum()
+    return new_cache, pages_reclaimed
+
+
+@jax.jit
+def shed_mask_queue_iota(queued_deadlines, x):
+    """GL305: ``queued_deadlines.shape[0]`` (this tick's waiting-line
+    length) flows straight into ``jnp.arange`` and the queue is not static
+    — the shed program re-specializes per queue depth instead of padding to
+    a fixed bound (the mid-traffic recompile ``strict_compiles`` exists to
+    catch; shedding must not re-key compiles)."""
+    return x + jnp.arange(queued_deadlines.shape[0])
+
+
+def example_args():
+    cache = {
+        "k_pages": jnp.zeros((4, 8, 16), jnp.float32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "cancel_reuses_donated_cache": (cache, jnp.zeros((4,), bool)),
+    }

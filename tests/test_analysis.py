@@ -572,6 +572,27 @@ def test_fixture_speculate_clean_twin_quiet():
     assert not rep.unsuppressed(), rep.render()
 
 
+def test_fixture_overload_planted_gl201_cancel_release_boundary():
+    """The cancel path's reclaim accounting reading the donated cache after
+    the release dispatch (the async-ckpt race across the cancel/release
+    boundary) is flagged at the AST level."""
+    rep = lint_paths([FIXTURES / "planted_overload.py"], excludes=())
+    assert "GL201" in _rules_of(rep), rep.render()
+
+
+def test_fixture_overload_planted_gl305_queue_length_trace():
+    """A shed program keyed on the waiting line's live length re-specializes
+    per queue depth — the AST recompile rule flags it; the clean twin
+    (static ``max_queue`` bound) stays quiet."""
+    rep = lint_paths([FIXTURES / "planted_overload.py"], excludes=())
+    assert "GL305" in _rules_of(rep), rep.render()
+
+
+def test_fixture_overload_clean_twin_quiet():
+    rep = lint_paths([FIXTURES / "clean_overload.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
+
+
 def test_gl205_one_hop_name_resolution_and_scope():
     # the live path reaches the write through a local assignment — still hit
     src = (

@@ -183,6 +183,26 @@ def test_bench_serve_smoke():
     assert extra["batched_speedup_vs_loop"] == 0.0
     assert extra["adapter_pool"]["pool_slots"] == 0
 
+    # the overload-control block rides EVERY serve report, zeros-clean on a
+    # clean replay (ISSUE 14: sheds/misses/cancels zero, request goodput
+    # 1.0, no transfer retries, ladder at normal) — with the serving.*
+    # twin rows pinned to the clean-run model
+    for field in ("requests_shed", "deadline_misses", "cancelled",
+                  "pages_reclaimed_on_cancel", "request_goodput_frac",
+                  "transfer_retries", "ladder_stage", "ladder_engagements"):
+        assert field in extra, field
+    assert extra["requests_shed"] == extra["deadline_misses"] == 0
+    assert extra["cancelled"] == extra["pages_reclaimed_on_cancel"] == 0
+    assert extra["request_goodput_frac"] == 1.0
+    assert extra["transfer_retries"] == 0
+    assert extra["ladder_stage"] == "normal"
+    assert extra["ladder_engagements"] == 0
+    for name in ("serving.requests_shed", "serving.deadline_misses",
+                 "serving.cancelled", "serving.pages_reclaimed_on_cancel",
+                 "serving.request_goodput_frac"):
+        row = extra["twins"][name]
+        assert row["status"] == "ok", (name, row)
+
     # the speculative-decode fields ride EVERY serve report, zeros-clean
     # with speculation off — tokens_per_step sits exactly at the plain-
     # decode 1.0 floor a speculative run must beat
@@ -210,6 +230,10 @@ def test_bench_serve_smoke():
     assert extra_idle["adapters"] == 0 and extra_idle["adapter_swaps"] == 0
     assert extra_idle["tokens_per_step"] == 0.0
     assert extra_idle["accept_rate"] == 0.0
+    assert extra_idle["requests_shed"] == 0 and extra_idle["cancelled"] == 0
+    assert extra_idle["deadline_misses"] == 0
+    assert extra_idle["request_goodput_frac"] == 0.0  # nothing served
+    assert extra_idle["ladder_stage"] == "normal"
 
 
 @pytest.mark.slow

@@ -209,6 +209,35 @@ def test_all_standard_twins_register_from_their_accounting_sites():
         {0: [5, 6, 7]}, wall_s=1.0,
     )
 
+    # 10-14. serving overload block (serving/harness._overload_fields):
+    # measured from the scheduler counters, predicted from the clean-run
+    # model (no FaultPlan active here)
+    from accelerate_tpu.serving.harness import _overload_fields
+
+    class _OverloadSched:
+        requests_shed = 0
+        deadline_misses = 0
+        cancelled = 0
+        pages_reclaimed_on_cancel = 0
+        retired_uids: set = set()
+        max_queue = 0
+        kv_shed_watermark = 0.0
+        default_deadline_ticks = 0
+        shed_armed = False
+
+    class _OverloadLadder:
+        stage = "normal"
+        engagements = 0
+
+    class _OverloadEng:
+        sched = _OverloadSched()
+        results = {0: [1, 2]}
+        adapters = None
+        ladder = _OverloadLadder()
+
+    _overload_fields(_OverloadEng(),
+                     [Request(uid=0, prompt=(1,), max_new_tokens=2)])
+
     rows = reg.drift_report()
     for name in STANDARD_TWINS:
         assert name in rows, name
