@@ -215,6 +215,7 @@ def generate_paged(
     speculate_k: Optional[int] = None,
     draft_model=None,
     draft_params=None,
+    prefix_cache=None,
 ):
     """:func:`generate`-shaped decoding through the **paged serving path**
     (``accelerate_tpu/serving/``): the batch rows become requests, decode
@@ -240,6 +241,13 @@ def generate_paged(
     tests/test_speculate.py pins it, including under eviction/recompute
     pressure and mixed LoRA tenant traffic).  ``speculate=True`` means
     ``"ngram"``.
+
+    Prefix caching: ``prefix_cache=True`` (or ``"on"``) arms the
+    content-addressed COW shared-page cache
+    (``serving/prefix_cache.py``) — rows sharing a prompt prefix reuse
+    each other's KV pages at page granularity, and greedy tokens stay
+    BITWISE identical with it on or off (tests/test_prefix_cache.py).
+    ``False`` is an explicit opt-out over a plugin/env-armed default.
     """
     import dataclasses as _dc
 
@@ -265,6 +273,14 @@ def generate_paged(
         speculate = "ngram"
     elif speculate is False:
         speculate = "off"
+    # same convention for content-addressed prefix reuse: True/"on" arms
+    # the COW shared-page cache through the serving path (greedy tokens
+    # stay BITWISE identical on/off — the acceptance pin
+    # tests/test_prefix_cache.py extends)
+    if prefix_cache is True:
+        prefix_cache = "on"
+    elif prefix_cache is False:
+        prefix_cache = "off"
     if serving_plugin is None:
         # provision for the offline case: every row resident at once
         page_size = 16
@@ -274,13 +290,15 @@ def generate_paged(
             num_pages=b * pages, prefill_chunk=max(16, t_prompt),
             **({"speculate": speculate} if speculate is not None else {}),
             **({"speculate_k": speculate_k} if speculate_k else {}),
+            **({"prefix_cache": prefix_cache} if prefix_cache is not None else {}),
         )
-    elif speculate is not None or speculate_k:
+    elif speculate is not None or speculate_k or prefix_cache is not None:
         serving_plugin = _dc.replace(
             serving_plugin,
             **({"speculate": speculate} if speculate is not None else {}),
             **({"speculate_k": speculate_k, "speculate_buckets": None}
                if speculate_k else {}),
+            **({"prefix_cache": prefix_cache} if prefix_cache is not None else {}),
         )
     engine = ServingEngine(model, params, serving_plugin, generation_config,
                            rng=rng, adapters=adapters,

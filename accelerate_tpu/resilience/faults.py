@@ -69,7 +69,7 @@ from .retry import TransientIOError
 logger = get_logger(__name__)
 
 FAULT_KINDS = ("preempt", "nan_grad", "transfer", "corrupt_ckpt", "cancel",
-               "deadline")
+               "deadline", "prefix")
 
 # default hook site per kind (a transfer event may override its site to
 # "checkpoint_io"/"adapter_transfer"/"adapter_memmap" to target checkpoint
@@ -83,6 +83,11 @@ KIND_DEFAULT_SITE = {
     "corrupt_ckpt": "post_save",
     "cancel": "serve_step",
     "deadline": "serve_step",
+    # cache-invalidation storm: the serving engine flushes its prefix index
+    # (every index hold drops; live slots keep their shared refcounts) —
+    # future admissions miss, tokens stay bitwise (the prefix interplay leg
+    # of the chaos soak pins it)
+    "prefix": "serve_step",
 }
 
 CORRUPTION_MODES = ("truncate", "bitflip")

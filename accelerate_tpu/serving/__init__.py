@@ -23,7 +23,14 @@ The production-inference rebuild of the reference's
   deadlines"): the SLO-driven graceful-degradation ladder and the
   :func:`~.overload.verify_serving_invariants` resource-contract checker
   behind per-request deadlines, deterministic cancellation, admission
-  control/load shedding, and the :func:`~.harness.chaos_replay` soak.
+  control/load shedding, and the :func:`~.harness.chaos_replay` soak;
+- :mod:`.prefix_cache` — content-addressed COW prefix reuse (ROADMAP
+  item 2's first half): full prompt-prefix pages hash-match against shared
+  refcounted physical pages, chunked prefill starts at the hit boundary,
+  eviction respects shared refcounts (the AdapterStore LRU rule);
+- :mod:`.transfer` — the first disaggregated prefill→decode slice: two
+  fixed-shape wire programs stream finished KV pages between engines, with
+  the ``dcn``-axis byte-accounting twin (``transfer.page_bytes``).
 """
 
 from .adapters import (
@@ -37,11 +44,18 @@ from .engine import ServingEngine
 from .harness import (
     chaos_replay,
     predicted_pool_utilization,
+    predicted_prefix_hit_rate,
     replay,
     static_batching_report,
     synthesize_trace,
 )
 from .overload import DegradationLadder, verify_serving_invariants
+from .prefix_cache import (
+    PrefixCache,
+    block_hashes,
+    prefix_cache_accounting,
+    unbounded_prefix_hit_rate,
+)
 from .paged_cache import allocate, kv_pool_accounting, pages_for, push_pages, release
 from .scheduler import ContinuousBatchingScheduler, Request, SlotState
 from .speculate import (
@@ -51,6 +65,12 @@ from .speculate import (
     make_draft_provider,
     predicted_acceptance,
     speculative_page_need,
+)
+from .transfer import (
+    DisaggregatedPair,
+    PagedKVTransport,
+    page_bytes,
+    transfer_accounting,
 )
 
 __all__ = [
@@ -81,4 +101,13 @@ __all__ = [
     "predicted_pool_utilization",
     "DegradationLadder",
     "verify_serving_invariants",
+    "PrefixCache",
+    "block_hashes",
+    "predicted_prefix_hit_rate",
+    "unbounded_prefix_hit_rate",
+    "prefix_cache_accounting",
+    "PagedKVTransport",
+    "DisaggregatedPair",
+    "transfer_accounting",
+    "page_bytes",
 ]

@@ -42,7 +42,8 @@ from ..telemetry import RequestTracer
 from ..utils.dataclasses import ServingPlugin, TelemetryPlugin
 from .overload import DegradationLadder
 from .paged_cache import allocate, pages_for, push_pages, release
-from .scheduler import ContinuousBatchingScheduler, Request
+from .prefix_cache import PrefixCache
+from .scheduler import ContinuousBatchingScheduler, Request, SlotState
 from .speculate import Speculator, make_draft_provider, speculative_page_need
 
 
@@ -271,6 +272,85 @@ def fresh_engine_jits(model, gen_config, page_size: int, lora: bool = False,
     )
 
 
+def _prefix_step_fns(page_size: int):
+    """The prefix-cache device programs (model-free — pure allocator
+    arithmetic on the cache pytree, keyed by page geometry only):
+
+    - ``adopt_step`` writes an admission's shared page ids into the slot's
+      block-table row prefix and pins ``seq_lens[slot]`` at the hit
+      boundary (the region chunked prefill will skip; no free-stack touch —
+      shared pages were never free);
+    - ``release_cow_step`` is the keep-aware COW release: per released slot
+      it pushes ONLY the pages past ``keep_counts[slot]`` (the slot's
+      shared prefix stays off the stack — the host refcounts decide when an
+      aliased page actually frees);
+    - ``push_free_step`` pushes an explicit masked id set (refcount-zero
+      deaths + LRU reclaims the host queued) — the device half of
+      ``PrefixCache.pop_pending``'s double-free guard.
+    """
+
+    def adopt_step(cache, slot, page_ids, n_shared):
+        npp = cache["block_tables"].shape[1]
+        keep = jnp.arange(npp, dtype=jnp.int32) < n_shared
+        row = jax.lax.dynamic_slice_in_dim(cache["block_tables"], slot, 1)[0]
+        row = jnp.where(keep, page_ids, row)
+        block_tables = jax.lax.dynamic_update_slice_in_dim(
+            cache["block_tables"], row[None], slot, 0
+        )
+        return {
+            "layers": cache["layers"],
+            "block_tables": block_tables,
+            "seq_lens": cache["seq_lens"].at[slot].set(n_shared * page_size),
+            "free_stack": cache["free_stack"],
+            "free_top": cache["free_top"],
+        }
+
+    def release_cow_step(cache, mask, keep_counts):
+        mask = mask.astype(bool)
+        n = cache["block_tables"].shape[1]
+        logical = jnp.arange(n, dtype=jnp.int32)[None, :]
+        owned = mask[:, None] & (logical >= keep_counts[:, None]) & (
+            logical < pages_for(cache["seq_lens"], page_size)[:, None]
+        )
+        free_stack, free_top = push_pages(
+            cache["free_stack"], cache["free_top"],
+            cache["block_tables"].reshape(-1), owned.reshape(-1),
+        )
+        return {
+            "layers": cache["layers"],
+            "block_tables": cache["block_tables"],
+            "seq_lens": jnp.where(mask, 0, cache["seq_lens"]),
+            "free_stack": free_stack,
+            "free_top": free_top,
+        }
+
+    def push_free_step(cache, page_ids, mask):
+        free_stack, free_top = push_pages(
+            cache["free_stack"], cache["free_top"], page_ids, mask
+        )
+        return {
+            "layers": cache["layers"],
+            "block_tables": cache["block_tables"],
+            "seq_lens": cache["seq_lens"],
+            "free_stack": free_stack,
+            "free_top": free_top,
+        }
+
+    return adopt_step, release_cow_step, push_free_step
+
+
+@lru_cache(maxsize=8)
+def _prefix_fns(page_size: int):
+    """Jitted (donated) prefix-cache programs, shared per page geometry —
+    each compiles exactly once per process per slot-count shape."""
+    adopt_step, release_cow_step, push_free_step = _prefix_step_fns(page_size)
+    return (
+        jax.jit(adopt_step, donate_argnums=(0,)),
+        jax.jit(release_cow_step, donate_argnums=(0,)),
+        jax.jit(push_free_step, donate_argnums=(0,)),
+    )
+
+
 @lru_cache(maxsize=8)
 def _engine_fns(model, gen_config, page_size: int, lora: bool = False,
                 lora_kernel_mode: str = "auto"):
@@ -297,7 +377,8 @@ class ServingEngine:
     def __init__(self, model, params, plugin: Optional[ServingPlugin] = None,
                  generation_config: Optional[GenerationConfig] = None, rng=None,
                  adapters=None, telemetry: Optional[TelemetryPlugin] = None,
-                 draft_model=None, draft_params=None):
+                 draft_model=None, draft_params=None,
+                 hold_finished: bool = False):
         self.plugin = plugin or ServingPlugin()
         self.gen_config = generation_config or GenerationConfig()
         if getattr(getattr(model, "config", None), "scan_layers", False):
@@ -341,6 +422,16 @@ class ServingEngine:
                 window=p.speculate_draft_window,
             )
             self._spec = Speculator(provider, p.speculate_k, p.speculate_buckets)
+        # content-addressed prefix reuse (serving/prefix_cache.py): COW
+        # shared pages with host-side refcounts; the three extra device
+        # programs (adopt / keep-aware COW release / push-free) are pure
+        # allocator arithmetic keyed by page geometry
+        self.prefix: Optional[PrefixCache] = None
+        if p.prefix_cache == "on":
+            self.prefix = PrefixCache(p.page_size)
+            self._adopt, self._release_cow, self._push_free = _prefix_fns(
+                p.page_size
+            )
         self.sched = ContinuousBatchingScheduler(
             p.num_slots, p.num_pages, p.page_size, p.pages_per_slot,
             p.prefill_chunk, p.prefill_buckets,
@@ -350,6 +441,7 @@ class ServingEngine:
             speculate_k=p.speculate_k if self._spec is not None else 0,
             max_queue=p.max_queue, kv_shed_watermark=p.kv_shed_watermark,
             default_deadline_ticks=p.default_deadline_ticks,
+            prefix=self.prefix,
         )
         # overload control (serving/overload.py): the degradation ladder is
         # always armed (escalation is explicit — an SLO trip, a deadline
@@ -383,6 +475,11 @@ class ServingEngine:
         self.warmed_up = False
         self.steps = 0
         self.interrupted = False
+        # disaggregation (serving/transfer.py): a prefill-role engine holds
+        # finished slots — pages intact — until the transport streams them
+        # to the decode engine and calls release_held()
+        self.hold_finished = hold_finished
+        self.held: list[int] = []
         self._undelivered: list[Request] = []
         self.results: dict[int, list[int]] = {}
         self._arrival_wall: dict[int, float] = {}
@@ -401,8 +498,17 @@ class ServingEngine:
             "verify_steps": 0, "draft_tokens": 0, "accepted_draft_tokens": 0,
             "decode_lane_passes": 0, "decode_emitted_tokens": 0,
             "speculative_rollbacks": 0,
+            # disaggregation (zeros unless a PagedKVTransport streams KV
+            # pages out of / into this engine — serving/transfer.py)
+            "page_transfers": 0, "page_transfer_pages": 0,
+            "page_transfer_bytes": 0,
         }
         self.ttft_s: list[float] = []
+        # TTFT in VIRTUAL engine ticks (arrival -> first token), the
+        # deterministic twin of the wall-clock ttft_s samples: the prefix
+        # cache's with/without-reuse comparison pins on these (wall clocks
+        # flake on CPU; tick counts replay identically)
+        self.ttft_ticks: list[int] = []
         self.token_gaps_s: list[float] = []
 
     # -- telemetry -----------------------------------------------------------
@@ -441,6 +547,47 @@ class ServingEngine:
         once."""
         if uid not in self._pending_cancels:
             self._pending_cancels.append(uid)
+
+    def adopt_prefilled(self, request: Request, first_token: int) -> int:
+        """Decode-role half of the disaggregated handoff
+        (serving/transfer.py): occupy a free slot for a request whose
+        prompt was prefilled on ANOTHER engine, whose first token is
+        already sampled, and whose KV pages the transport's ``recv``
+        program is about to scatter into this pool.  The host mirror books
+        ``pages_for(prompt_len)`` pages (the recv program pops exactly
+        those); decode proceeds through the ordinary tick loop from the
+        first generated token on.  Returns the slot id."""
+        sched = self.sched
+        if not sched.free_slots:
+            raise RuntimeError("adopt_prefilled: no free decode slot")
+        n_pages = int(pages_for(request.prompt_len, self.plugin.page_size))
+        if n_pages > sched.free_pages:
+            raise RuntimeError(
+                f"adopt_prefilled: request {request.uid} needs {n_pages} "
+                f"pages, pool has {sched.free_pages} free"
+            )
+        slot = sched.free_slots.pop(0)
+        st = SlotState(request, sched._admit_counter,
+                       prefilled=request.prompt_len)
+        st.tokens = [int(first_token)]
+        sched.slots[slot] = st
+        sched._admit_counter += 1
+        sched.free_pages -= n_pages
+        sched.events.append(("admit", request.uid, slot))
+        # the prefill engine delivered the first token — TTFT is its story
+        self._arrival_wall[request.uid] = time.perf_counter()
+        self._last_token_wall[request.uid] = time.perf_counter()
+        self._ttft_seen.add(request.uid)
+        return slot
+
+    def release_held(self, slot: int) -> None:
+        """Prefill-role half of the handoff: retire a held finished slot
+        once its pages have been streamed out (device release first, then
+        the host mirror — the ordering every retirement path uses)."""
+        self.held.remove(slot)
+        self._release_slots([slot])
+        self.sched.finish(slot)
+        self._drain_prefix_frees()
 
     def attach_slo(self, monitor) -> "DegradationLadder":
         """Feed per-token latency and TTFT samples into ``monitor`` as they
@@ -483,12 +630,14 @@ class ServingEngine:
     # -- program dispatch (single-tenant vs multi-tenant arity) --------------
 
     def _run_decode(self, tokens, active, adapter_slots, rng):
+        self._drain_prefix_frees()
         if self.adapters is None:
             return self._decode(self.params, self.cache, tokens, active, rng)
         return self._decode(self.params, self.adapters.pool, self.cache,
                             tokens, active, adapter_slots, rng)
 
     def _run_prefill(self, slot, chunk_ids, start, chunk_len, adapter_slot):
+        self._drain_prefix_frees()
         if self.adapters is None:
             return self._prefill(self.params, self.cache, slot, chunk_ids,
                                  start, chunk_len)
@@ -496,6 +645,7 @@ class ServingEngine:
                              slot, chunk_ids, start, chunk_len, adapter_slot)
 
     def _run_verify(self, tokens, spec_len, active, adapter_slots, rng):
+        self._drain_prefix_frees()
         if self.adapters is None:
             return self._verify(self.params, self.cache, tokens, spec_len,
                                 active, rng)
@@ -555,9 +705,28 @@ class ServingEngine:
                 )
                 self.cache = cache
             self._spec.provider.warmup(n, self.plugin.speculate_k)
-        self.cache = self._release(
-            self.cache, jnp.asarray(np.zeros((n,), bool))
-        )
+        if self.prefix is not None:
+            # the three prefix programs are production programs: a first
+            # hit / COW release / refcount-death push mid-traffic must hit
+            # a warm cache (no-op passes: zero shared pages, empty masks)
+            pps = self.plugin.pages_per_slot
+            self.cache = self._adopt(
+                self.cache, jnp.asarray(0, jnp.int32),
+                jnp.asarray(np.zeros((pps,), np.int32)),
+                jnp.asarray(0, jnp.int32),
+            )
+            self.cache = self._release_cow(
+                self.cache, jnp.asarray(np.zeros((n,), bool)),
+                jnp.asarray(np.zeros((n,), np.int32)),
+            )
+            self.cache = self._push_free(
+                self.cache, jnp.asarray(np.zeros((pps,), np.int32)),
+                jnp.asarray(np.zeros((pps,), bool)),
+            )
+        else:
+            self.cache = self._release(
+                self.cache, jnp.asarray(np.zeros((n,), bool))
+            )
         # Decode compiled FIRST, against the fresh host-built cache — but
         # every program OUTPUT carries the steady-state placement GSPMD
         # chose (under a mesh-sharded param tree the KV pools come back
@@ -601,6 +770,7 @@ class ServingEngine:
                 # (the serving analog of the trainer's SIGTERM-at-step-
                 # boundary stop; resilience/preemption.py discipline)
                 self.interrupted = True
+                self._drain_prefix_frees()
                 return {"type": "preempted", "step": self.steps}
             if ev.kind == "cancel":
                 # cancellation storm: the oldest live request cancels —
@@ -611,10 +781,36 @@ class ServingEngine:
                 # overload signal escalates the degradation ladder one stage
                 self.sched.force_expire_all()
                 self.ladder.escalate()
+            elif ev.kind == "prefix":
+                # cache-invalidation storm: every index hold drops — live
+                # slots keep their shared refcounts (their pages free later
+                # through the normal release path), future admissions miss.
+                # Tokens stay bitwise: a flush only changes WHERE K/V gets
+                # computed, never what it holds.
+                if self.prefix is not None:
+                    freed = self.prefix.flush()
+                    self.sched.free_pages += freed
+                    self.sched.events.append(("prefix_flush", freed))
         self.sched.tick = self.steps
         self._process_control()
         t_sched = tr.stamp() if tr is not None else 0.0
-        self.sched.admit()
+        admitted = self.sched.admit()
+        if self.prefix is not None:
+            # push refcount-death / LRU-reclaim pages BEFORE any allocating
+            # dispatch (the host mirror counted them at decision time), then
+            # write each adopted prefix into its slot's block-table row
+            self._drain_prefix_frees()
+            for s in admitted:
+                st = self.sched.slots[s]
+                if st.shared_pages:
+                    pps = self.plugin.pages_per_slot
+                    ids = np.zeros((pps,), np.int32)
+                    ids[:len(st.shared_pages)] = st.shared_pages
+                    self.cache = self._adopt(
+                        self.cache, jnp.asarray(s, jnp.int32),
+                        jnp.asarray(ids),
+                        jnp.asarray(len(st.shared_pages), jnp.int32),
+                    )
         action = self.sched.next_action()
         if tr is not None:
             tr.phase("schedule", t_sched, action=action[0], step=self.steps)
@@ -646,6 +842,11 @@ class ServingEngine:
                 m["prefill_useful_tokens"] += chunk
                 m["prompt_tokens"] += chunk
                 event.update(slot=slot, chunk=chunk, bucket=bucket)
+                if self.prefix is not None and st.prefill_done:
+                    # the completed prompt's NEW full pages register in the
+                    # content index (one small block-row fetch; the engine
+                    # syncs a token this tick anyway)
+                    self._insert_prefix(slot, st)
                 if st.prefill_done:
                     # the prompt's last-token logits seed the decode loop —
                     # the first generated token, exactly like generate()
@@ -664,6 +865,7 @@ class ServingEngine:
             event["type"] = "verify"
             window = self._verify_tick(action[1], tr, event)
             if self.interrupted:  # preempt-mid-verify fault: nothing ran
+                self._drain_prefix_frees()
                 return {"type": "preempted", "step": self.steps}
         elif action[0] == "decode":
             active_slots, evicted = self.sched.plan_evictions(action[1])
@@ -697,7 +899,7 @@ class ServingEngine:
                 for s in active_slots:
                     if self._record_token(s, int(next_np[s]), release=False):
                         done_slots.append(s)
-                if done_slots:
+                if done_slots and not self.hold_finished:
                     self._release_slots(done_slots)
                     self._finish_decode_slots(done_slots)
                 m = self.metrics
@@ -720,6 +922,10 @@ class ServingEngine:
             # (submit/admit/swap/bypass/prefill/evict/finish this tick)
             tr.consume_scheduler_events(self.sched.events, self.steps,
                                         window=window)
+        # the tick boundary owes the device every refcount-death push the
+        # host counted this tick (mirror exact at every boundary — the
+        # refcounted invariant checker runs between ticks)
+        self._drain_prefix_frees()
         self.steps += 1
         return event
 
@@ -758,6 +964,10 @@ class ServingEngine:
                 self._pending_cancels.remove(uid)
             # else: not yet arrived — the cancel stays pending
         for slot in sorted(sched.slots):
+            # a held finished slot already delivered its tokens — pages stay
+            # parked for the KV transfer; a deadline cannot revoke them
+            if sched.slots[slot].finished:
+                continue
             if sched.request_expired(sched.slots[slot].request):
                 self._cancel_slot(slot, reason="deadline")
 
@@ -766,7 +976,9 @@ class ServingEngine:
         True when a live request was retired."""
         sched = self.sched
         for slot, st in sched.slots.items():
-            if st.request.uid == uid:
+            if st.request.uid == uid and not st.finished:
+                # (a held finished slot is already in results — the caller's
+                # raced-a-finish branch drops the stale cancel)
                 self._cancel_slot(slot, reason=reason)
                 return True
         return sched.cancel_queued(uid, reason=reason)
@@ -788,8 +1000,9 @@ class ServingEngine:
         request — oldest-admitted in-flight first, else the head of the
         waiting line.  Deterministic by construction."""
         sched = self.sched
-        if sched.slots:
-            slot = min(sched.slots, key=lambda s: sched.slots[s].admit_seq)
+        live = [s for s in sched.slots if not sched.slots[s].finished]
+        if live:
+            slot = min(live, key=lambda s: sched.slots[s].admit_seq)
             self._cancel_slot(slot, reason="cancel")
         elif sched.waiting:
             sched.cancel_queued(sched.waiting[0].uid, reason="cancel")
@@ -907,7 +1120,7 @@ class ServingEngine:
             # drafts must not inflate the measured accept-rate twin — the
             # predicted replay caps at the stream end the same way)
             delivered_drafts += r - 1
-        if done_slots:
+        if done_slots and not self.hold_finished:
             self._release_slots(done_slots)
             self._finish_decode_slots(done_slots)
         m["verify_steps"] += 1
@@ -938,6 +1151,7 @@ class ServingEngine:
             if uid not in self._ttft_seen:
                 self._ttft_seen.add(uid)
                 self.ttft_s.append(now - self._arrival_wall[uid])
+                self.ttft_ticks.append(self.steps - st.request.arrival_step)
                 if self.slo is not None:
                     self.slo.observe("ttft_s", self.ttft_s[-1])
         elif uid in self._last_token_wall:
@@ -959,7 +1173,12 @@ class ServingEngine:
             self._arrival_wall.pop(uid, None)
             self._last_token_wall.pop(uid, None)
             self._ttft_seen.discard(uid)
-            if release:
+            if self.hold_finished:
+                # prefill-role engine: the KV pages stay resident until the
+                # transport streams them to the decode engine
+                st.finished = True
+                self.held.append(slot)
+            elif release:
                 self._release_slots([slot])
                 self.sched.finish(slot)
             return True
@@ -968,7 +1187,57 @@ class ServingEngine:
     def _release_slots(self, slots: list[int]) -> None:
         mask = np.zeros((self.plugin.num_slots,), bool)
         mask[slots] = True
-        self.cache = self._release(self.cache, jnp.asarray(mask))
+        if self.prefix is None:
+            self.cache = self._release(self.cache, jnp.asarray(mask))
+            return
+        # COW release: the device pushes ONLY the pages past each slot's
+        # shared prefix — an aliased page never reaches the free stack from
+        # here (the host refcounts in _release_slot_pages decide when it
+        # actually frees, through the push_free program)
+        keep = np.zeros((self.plugin.num_slots,), np.int32)
+        for s in slots:
+            st = self.sched.slots.get(s)
+            if st is not None:
+                keep[s] = len(st.shared_pages)
+            else:
+                # evict() popped the state already; it parked the keep count
+                keep[s] = self.sched.evicted_keep.pop(s, 0)
+        self.cache = self._release_cow(self.cache, jnp.asarray(mask),
+                                       jnp.asarray(keep))
+
+    def _drain_prefix_frees(self) -> None:
+        """Push every refcount-death / LRU-reclaim page the host queued onto
+        the device free stack (fixed-width batches of ``pages_per_slot`` —
+        one warmed program shape).  ``pop_pending`` hard-asserts none of
+        them still holds a reference (the double-free guard)."""
+        if self.prefix is None or not self.prefix.pending_free:
+            return
+        pages = self.prefix.pop_pending()
+        width = self.plugin.pages_per_slot
+        for i in range(0, len(pages), width):
+            chunk = pages[i:i + width]
+            ids = np.zeros((width,), np.int32)
+            mask = np.zeros((width,), bool)
+            ids[:len(chunk)] = chunk
+            mask[:len(chunk)] = True
+            self.cache = self._push_free(self.cache, jnp.asarray(ids),
+                                         jnp.asarray(mask))
+
+    def _insert_prefix(self, slot: int, st) -> None:
+        """Register a completed prefill's NEW full pages in the content
+        index.  The physical ids come from one small block-row fetch (the
+        device popped them; the host mirror only tracks counts) — the
+        slot's shared set stays a contiguous row prefix, so the COW release
+        keep-count arithmetic holds."""
+        hashes = self.prefix.block_hashes(st.request.prompt,
+                                          st.request.adapter_id)
+        k = len(st.shared_pages)
+        if len(hashes) <= k:
+            return
+        row = np.asarray(self.cache["block_tables"])[slot, :len(hashes)]
+        inserted = self.prefix.insert_owned(hashes[k:],
+                                            [int(p) for p in row[k:]])
+        st.shared_pages.extend(inserted)
 
     def _release_evicted(self, evicted: list[int]) -> None:
         if evicted:

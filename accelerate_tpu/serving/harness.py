@@ -39,6 +39,9 @@ def synthesize_trace(
     new_tokens_range: tuple = (2, 16),
     adapters: int = 0,
     deadline_range: Optional[tuple] = None,
+    prefix_share: float = 0.0,
+    shared_prefixes: int = 2,
+    shared_prefix_len: int = 0,
 ) -> list[Request]:
     """A deterministic request trace: Poisson arrivals (exponential gaps in
     virtual engine-step time) with uniformly mixed prompt/output lengths.
@@ -50,8 +53,23 @@ def synthesize_trace(
     With ``deadline_range=(lo, hi)`` each request draws a per-request
     ``deadline_ticks`` uniformly — the deadline-pressure traffic the
     overload tests replay.
+
+    With ``prefix_share=P`` each request opens, with probability ``P``,
+    with one of ``shared_prefixes`` seeded **system preambles** of
+    ``shared_prefix_len`` tokens (default: the middle of
+    ``prompt_len_range``, so preambles span full pages at the test
+    geometries) — the shared-system-prompt traffic mix the prefix cache's
+    hit rate is measured on (``bench.py --serve --prefix-share P``).  The
+    per-request tail stays unique, so shared traffic still exercises the
+    copy-on-write fork.
     """
     rng = np.random.default_rng(seed)
+    if prefix_share and not shared_prefix_len:
+        shared_prefix_len = (prompt_len_range[0] + prompt_len_range[1]) // 2
+    preambles = [
+        tuple(int(x) for x in rng.integers(1, vocab_size, shared_prefix_len))
+        for _ in range(shared_prefixes if prefix_share else 0)
+    ]
     trace = []
     t = 0.0
     for uid in range(n_requests):
@@ -59,6 +77,9 @@ def synthesize_trace(
         p_len = int(rng.integers(prompt_len_range[0], prompt_len_range[1] + 1))
         n_new = int(rng.integers(new_tokens_range[0], new_tokens_range[1] + 1))
         prompt = tuple(int(x) for x in rng.integers(1, vocab_size, p_len))
+        if preambles and rng.random() < prefix_share:
+            pre = preambles[int(rng.integers(0, len(preambles)))]
+            prompt = pre + prompt
         adapter_id = int(rng.integers(0, adapters + 1)) if adapters > 0 else 0
         deadline = (int(rng.integers(deadline_range[0], deadline_range[1] + 1))
                     if deadline_range is not None else 0)
@@ -132,6 +153,111 @@ def predicted_pool_utilization(trace: list[Request], *, num_slots: int,
         if steps > 1_000_000:  # pragma: no cover - trace arithmetic safety net
             break
     return round(page_step_sum / max(steps, 1) / num_pages, 4)
+
+
+class _AnyAdapters:
+    """Duck-typed adapter shim for prediction replays: every tenant is
+    known, pin-able and free — the replay models PAGE arithmetic, not
+    adapter-pool contention, but must keep tenant ids flowing so the
+    prefix hash chain stays adapter-keyed (cross-tenant prompts never
+    alias)."""
+
+    refcount: dict = {}
+
+    def known(self, tid):
+        return True
+
+    def can_pin(self, tid):
+        return True
+
+    def pin(self, tid):
+        return 0, False
+
+    def unpin(self, tid):
+        return None
+
+    def prefetch(self, tid):
+        return None
+
+
+def predicted_prefix_hit_rate(trace: list[Request], *, num_slots: int,
+                              num_pages: int, page_size: int,
+                              pages_per_slot: int, prefill_chunk: int) -> float:
+    """CheckFreq-style *predicted* twin of the measured prefix hit rate: a
+    model-free replay of the REAL scheduler arithmetic over the trace (the
+    :func:`predicted_pool_utilization` pattern) with a virtual
+    :class:`~.prefix_cache.PrefixCache` armed — slot concurrency (two
+    identical prompts prefilling at once cannot share), LRU reclaim under
+    pool pressure, and eviction churn all replay exactly.  Insertions use
+    synthetic page ids (the count arithmetic is what matters; no device
+    exists here).  The prediction error vs the measured twin is the
+    execution traffic the virtual clock cannot see: EOS early exits
+    (requests that finish before their modeled decode length frees pages
+    earlier) and fault-injected flushes."""
+    if not trace:
+        return 0.0
+    import dataclasses as _dc
+
+    from .prefix_cache import PrefixCache
+    from .scheduler import ContinuousBatchingScheduler
+
+    prefix = PrefixCache(page_size)
+    sched = ContinuousBatchingScheduler(
+        num_slots, num_pages, page_size, pages_per_slot, prefill_chunk,
+        (prefill_chunk,), prefix=prefix,
+    )
+    sched.adapters = _AnyAdapters()
+    pending = [_dc.replace(r, deadline_ticks=0)
+               for r in sorted(trace, key=lambda r: (r.arrival_step, r.uid))]
+    next_page = [0]
+
+    def insert(st):
+        hashes = prefix.block_hashes(st.request.prompt, st.request.adapter_id)
+        k = len(st.shared_pages)
+        if len(hashes) > k:
+            ids = list(range(next_page[0], next_page[0] + len(hashes) - k))
+            next_page[0] += len(ids)
+            st.shared_pages.extend(prefix.insert_owned(hashes[k:], ids))
+
+    i, steps = 0, 0
+    while True:
+        sched.tick = steps
+        while i < len(pending) and pending[i].arrival_step <= steps:
+            sched.submit(pending[i])
+            i += 1
+        if sched.idle() and i >= len(pending):
+            break
+        sched.admit()
+        prefix.pending_free.clear()  # no device: the push is virtual
+        action = sched.next_action()
+        if action[0] == "prefill":
+            slot, start, chunk = action[1], action[2], action[3]
+            survived, _ = sched.plan_prefill_evictions(slot, chunk)
+            if survived:
+                sched.note_prefill(slot, chunk)
+                st = sched.slots[slot]
+                if st.prefill_done:
+                    insert(st)
+                    st.tokens.append(0)
+                    if len(st.tokens) >= st.request.max_new_tokens:
+                        sched.finish(slot)
+        elif action[0] == "decode":
+            active, _ = sched.plan_evictions(action[1])
+            if active:
+                sched.note_decode(sched.decode_page_need(active), active)
+                done = []
+                for s in active:
+                    st = sched.slots[s]
+                    st.tokens.append(0)
+                    if len(st.tokens) >= st.request.max_new_tokens:
+                        done.append(s)
+                for s in done:
+                    sched.finish(s)
+        prefix.pending_free.clear()
+        steps += 1
+        if steps > 1_000_000:  # pragma: no cover - trace arithmetic safety net
+            break
+    return prefix.hit_rate()
 
 
 def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
@@ -249,6 +375,13 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
         "p50_token_latency_ms": _percentile_ms(engine.token_gaps_s, 50),
         "p99_token_latency_ms": _percentile_ms(engine.token_gaps_s, 99),
         "ttft_p50_ms": _percentile_ms(engine.ttft_s, 50),
+        # TTFT in virtual engine ticks — the deterministic twin wall clocks
+        # cannot give on CPU (the prefix cache's with/without-reuse
+        # comparison pins on this)
+        "ttft_p50_ticks": (
+            round(float(np.percentile(np.asarray(engine.ttft_ticks), 50)), 2)
+            if engine.ttft_ticks else 0.0
+        ),
         "kv_pool_utilization": measured_util,
         "kv_pool_utilization_predicted": predicted_util,
         "kv_pool_peak_utilization": round(m["peak_used_pages"] / p.num_pages, 4),
@@ -268,12 +401,17 @@ def replay(engine, trace: list[Request], *, strict_compiles: bool = True,
         "compiles_measured": compiles_measured,
         "compiles_warmup": compiles_warmup,
         # decode + release + first-token sampler, plus — with speculation —
-        # one verify program per bucket and the draft provider's own program
+        # one verify program per bucket and the draft provider's own
+        # program, plus — with prefix caching — adopt + push_free + the COW
+        # release replacing the plain one (net +2)
         "programs_predicted": len(p.prefill_buckets) + 3 + (
             len(p.speculate_buckets) + engine.speculator.provider.programs
             if engine.speculator is not None else 0
-        ),
+        ) + (2 if engine.prefix is not None else 0),
         **spec_fields,
+        # prefix-cache + disaggregation fields — ALWAYS present, zeros when
+        # the cache is off / no transport is attached
+        **_prefix_fields(engine, trace),
         **telemetry_fields,
         # overload-control + cancellation fields — ALWAYS present, zeros on
         # a clean run (the resilience analog of the goodput block)
@@ -339,6 +477,65 @@ def _overload_fields(engine, trace: list[Request]) -> dict:
         "ladder_stage": engine.ladder.stage,
         "ladder_engagements": engine.ladder.engagements,
     }
+
+
+def _prefix_fields(engine, trace: list[Request]) -> dict:
+    """The always-emitted prefix-cache block of the serving report
+    (zeros-clean with the cache off — the idle contract):
+
+    - ``prefix_hit_rate`` — index-served cacheable pages over cacheable
+      pages demanded at admission, counted once per request (measured),
+      with the ``_predicted`` twin from the model-free scheduler replay
+      (:func:`predicted_prefix_hit_rate` — concurrency and LRU reclaim
+      modeled exactly; the prediction error is EOS-early-exit and
+      fault-flush traffic the virtual clock cannot see);
+    - ``pages_shared_peak`` — peak physical pages aliased by > 1 holder;
+    - ``cow_forks`` — admissions that shared a proper prefix then wrote
+      their own divergent pages;
+    - ``prefill_tokens_skipped`` — prompt tokens never recomputed;
+    - ``page_transfer_bytes`` (+pages/transfers) — the disaggregation
+      slice's measured wire bytes (``transfer.page_bytes`` twin; zero
+      unless a :class:`~.transfer.PagedKVTransport` streamed this engine).
+    """
+    m = engine.metrics
+    prefix = getattr(engine, "prefix", None)
+    fields = {
+        "prefix_cache": "on" if prefix is not None else "off",
+        "prefix_hit_rate": 0.0,
+        "prefix_hit_rate_predicted": 0.0,
+        "pages_shared_peak": 0,
+        "cow_forks": 0,
+        "prefill_tokens_skipped": 0,
+        "prefix_evictions": 0,
+        "page_transfers": m["page_transfers"],
+        "page_transfer_pages": m["page_transfer_pages"],
+        "page_transfer_bytes": m["page_transfer_bytes"],
+    }
+    if prefix is None:
+        return fields
+    from ..telemetry import twin_registry
+
+    rep = prefix.report()
+    fields.update(
+        prefix_hit_rate=rep["prefix_hit_rate"],
+        pages_shared_peak=rep["pages_shared_peak"],
+        cow_forks=rep["cow_forks"],
+        prefill_tokens_skipped=rep["prefill_tokens_skipped"],
+        prefix_evictions=rep["prefix_evictions"],
+    )
+    p = engine.plugin
+    predicted = predicted_prefix_hit_rate(
+        trace, num_slots=p.num_slots, num_pages=p.num_pages,
+        page_size=p.page_size, pages_per_slot=p.pages_per_slot,
+        prefill_chunk=p.prefill_chunk,
+    )
+    fields["prefix_hit_rate_predicted"] = predicted
+    twin_registry().record(
+        "prefix_cache.hit_rate", predicted=predicted,
+        measured=rep["prefix_hit_rate"],
+        source="serving/harness._prefix_fields",
+    )
+    return fields
 
 
 def _speculate_fields(engine, trace: list[Request], results: dict,

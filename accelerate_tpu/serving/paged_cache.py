@@ -82,6 +82,18 @@ def push_pages(free_stack, free_top, pages, mask):
     program.  ``pages``/``mask``: aligned ``[K]`` arrays; masked-out lanes
     route their scatter out of bounds and drop (the shared write-mask
     convention).  Returns ``(free_stack, free_top)``.
+
+    **Aliasing contract** (prefix caching, docs/serving.md): a page id may
+    reach this scatter ONLY while no holder references it.  The callers
+    enforce it — the engine's COW release masks each slot's shared-prefix
+    pages out (``release`` here pushes a slot's WHOLE block-table prefix,
+    so prefix-armed engines route through the keep-aware variant instead),
+    and ``PrefixCache.pop_pending`` hard-asserts refcount zero before the
+    ``push_free`` dispatch — while ``verify_serving_invariants()`` checks
+    the device-side exclusion (referenced ∩ free-stack = ∅) after the
+    fact.  Pushing a still-referenced page is the double-free a refcount
+    bug causes — two owners of one physical page — pinned by a planted
+    test (tests/test_prefix_cache.py).
     """
     mask = mask.astype(bool)
     rank = jnp.cumsum(mask.astype(jnp.int32)) - 1

@@ -32,6 +32,16 @@ Policy (every knob in :class:`~accelerate_tpu.utils.dataclasses.ServingPlugin`):
   the **youngest admitted** sequence is preempted — its pages are released
   and the request requeues at the head of the waiting line with its prompt
   intact (recompute-on-readmit, the vLLM default).
+- **Prefix reuse** (with a :class:`~.prefix_cache.PrefixCache` armed):
+  admission matches the prompt's content-addressed full-page prefix against
+  the index, strikes the hit from the page demand, pins the hit pages
+  (one refcount per page) and starts chunked prefill AT the hit boundary —
+  the shared region is never recomputed.  Page-pressure paths reclaim LRU
+  **index-only** pages (refcount 1: cached, referenced by no live slot)
+  before ever evicting a live sequence; a page some slot still shares is
+  never a victim (the AdapterStore refcount-LRU rule).  Every release path
+  routes through ``_release_slot_pages``: private pages free by count,
+  shared pages drop one refcount and free only at zero.
 - **Overload control** (docs/serving.md "Overload & deadlines"): the waiting
   line is bounded (``max_queue``) and sheds when the bound or the
   **predicted KV pressure** (used pages + every queued prompt's admission
@@ -104,6 +114,12 @@ class SlotState:
     last_token: int = 0            # decode input for the next step
     finished: bool = False
     adapter_slot: int = 0          # device pool slot the request decodes with
+    shared_pages: Optional[list] = None  # prefix-cache page ids this slot
+                                   # holds a refcount on — ALWAYS a
+                                   # contiguous block-table row prefix
+                                   # (adopted prefix + own inserted pages);
+                                   # the COW release program skips exactly
+                                   # these, the host unrefs them
     kv_len: Optional[int] = None   # explicit device-side KV length (speculative
                                    # decode: EOS inside an accepted window can
                                    # retire the HOST stream short of the KV the
@@ -113,6 +129,8 @@ class SlotState:
     def __post_init__(self):
         if self.tokens is None:
             self.tokens = []
+        if self.shared_pages is None:
+            self.shared_pages = []
 
     @property
     def prefill_done(self) -> bool:
@@ -145,7 +163,7 @@ class ContinuousBatchingScheduler:
                  pages_per_slot: int, prefill_chunk: int, prefill_buckets: tuple,
                  adapters=None, max_bypass_age: int = 16, speculate_k: int = 0,
                  max_queue: int = 0, kv_shed_watermark: float = 0.0,
-                 default_deadline_ticks: int = 0):
+                 default_deadline_ticks: int = 0, prefix=None):
         self.num_slots = num_slots
         self.num_pages = num_pages
         self.page_size = page_size
@@ -153,6 +171,7 @@ class ContinuousBatchingScheduler:
         self.prefill_chunk = prefill_chunk
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self.adapters = adapters             # AdapterStore (multi-tenant mode)
+        self.prefix = prefix                 # PrefixCache (COW prefix reuse)
         self.max_bypass_age = max_bypass_age
         self.speculate_k = speculate_k       # admission reserves verify pages
         self.max_queue = max_queue           # waiting-line bound (0 = unbounded)
@@ -181,6 +200,12 @@ class ContinuousBatchingScheduler:
         self.pages_reclaimed_on_cancel = 0
         self.retired_uids: set[int] = set()  # shed/cancelled — deliberately
                                              # retired, never handed back
+        self.evicted_keep: dict[int, int] = {}  # slot -> shared-prefix page
+                                             # count parked by evict() for
+                                             # the engine's COW release
+        self._prefix_counted: set[int] = set()  # uids already counted in
+                                             # the hit-rate twin (readmits
+                                             # skip the rate counters)
         self._force_expired: set[int] = set()  # deadline-storm fault payload
 
     # -- queueing -----------------------------------------------------------
@@ -329,8 +354,7 @@ class ContinuousBatchingScheduler:
         adapter hold.  The resource contract
         :func:`~.overload.verify_serving_invariants` pins."""
         st = self.slots.pop(slot)
-        freed = int(pages_for(st.kv_tokens, self.page_size))
-        self.free_pages += freed
+        freed = self._release_slot_pages(st)
         self.free_slots.append(slot)
         self.free_slots.sort()
         if self.adapters is not None:
@@ -349,6 +373,24 @@ class ContinuousBatchingScheduler:
         self.retired_uids.add(req.uid)
         self._force_expired.discard(req.uid)
         self.events.append(("cancel", req.uid, stage, reason))
+
+    def _release_slot_pages(self, st: SlotState) -> int:
+        """The ONE host-side page-release arithmetic (finish, evict and
+        cancel all route through it, so the mirror can never drift between
+        retirement paths): private pages — everything past the slot's
+        shared prefix — free immediately (the engine's COW release program
+        pushes exactly those device-side); shared pages drop ONE refcount
+        each, and only the ones that reach zero join the free count (they
+        queue for the engine's ``push_free`` dispatch — ``release`` never
+        pushes an aliased page).  Returns the pages added to the free
+        mirror."""
+        total = int(pages_for(st.kv_tokens, self.page_size))
+        shared = len(st.shared_pages)
+        freed = total - shared
+        if shared and self.prefix is not None:
+            freed += self.prefix.unref_pages(st.shared_pages)
+        self.free_pages += freed
+        return freed
 
     # -- admission ----------------------------------------------------------
 
@@ -412,13 +454,34 @@ class ContinuousBatchingScheduler:
             if idx is None:
                 break
             req = self.waiting[idx]
+            hashes = hit = ()
+            if self.prefix is not None:
+                hashes = self.prefix.block_hashes(req.prompt, req.adapter_id)
+                hit = self.prefix.match(hashes)
             # the tightened-admission reserve only applies while the pool is
             # actually contended: with zero occupied slots the head admits
             # regardless, so tightening can never idle-spin an empty engine
             # (the admit-vs-submit livelock guard, extended to the ladder)
             reserve = self.admission_reserve_pages if self.slots else 0
-            if self.admission_page_need(req) > self.free_pages - reserve:
-                break
+            if self.prefix is not None:
+                # anti-thrash headroom: a prefix hit makes readmission almost
+                # free (the shared region costs nothing), so an evicted
+                # request could instantly steal the pages a RUNNING slot
+                # needs to grow — and the two then evict each other forever.
+                # One page of decode headroom per occupied slot keeps
+                # admission from packing past the in-flight set's next step;
+                # zero occupied slots ⇒ zero headroom (the livelock guard)
+                reserve += len(self.slots)
+            need = self.admission_page_need(req, hit_pages=len(hit))
+            if need > self.free_pages - reserve:
+                # index-only cached pages are the cheapest capacity there is:
+                # reclaim them LRU before refusing the admission — but never
+                # the pages this very admission just matched (the
+                # match→adopt window), and never a page a live slot still
+                # references (the AdapterStore rule)
+                self._reclaim(need + reserve, protect=frozenset(hit))
+                if need > self.free_pages - reserve:
+                    break
             del self.waiting[idx]
             adapter_slot = 0
             if self.adapters is not None and req.adapter_id:
@@ -428,26 +491,75 @@ class ContinuousBatchingScheduler:
             if idx > 0:
                 self.events.append(("bypass", req.uid, self.waiting[0].uid))
             slot = self.free_slots.pop(0)
+            shared: list = []
+            hit_tokens = 0
+            if hashes:
+                # commit the hit (adopt re-matches — the protected reclaim
+                # guarantees it finds at least the probed prefix): the slot
+                # takes a refcount on every shared page, prefill starts at
+                # the hit boundary (chunked prefill skips the shared region
+                # entirely), and the engine's adopt program writes the ids
+                # into the block-table row.  A readmission (evicted earlier
+                # this replay) skips the hit-RATE counters — the twin's
+                # predicted replay cannot see recompute churn
+                shared = self.prefix.adopt(
+                    hashes, count=req.uid not in self._prefix_counted
+                )
+                self._prefix_counted.add(req.uid)
+                hit_tokens = len(shared) * self.page_size
+                if shared:
+                    self.events.append(("prefix_hit", req.uid, hit_tokens))
+                    if len(shared) < len(hashes):
+                        self.events.append(("cow_fork", req.uid))
             self.slots[slot] = SlotState(req, self._admit_counter,
-                                         adapter_slot=adapter_slot)
+                                         adapter_slot=adapter_slot,
+                                         shared_pages=shared,
+                                         prefilled=hit_tokens)
             self._admit_counter += 1
             admitted.append(slot)
             self.events.append(("admit", req.uid, slot))
         return admitted
 
-    def admission_page_need(self, req: Request) -> int:
+    def _reclaim(self, demand: int, protect: frozenset = frozenset()) -> int:
+        """Free LRU index-only prefix pages until ``free_pages >= demand``
+        (best effort).  Freed ids queue in the prefix cache's
+        ``pending_free`` for the engine's next ``push_free`` dispatch; the
+        host mirror counts them immediately (the decision-time convention
+        every release path uses).  ``protect`` exempts matched-but-not-yet-
+        adopted pages.  Returns pages reclaimed."""
+        freed = 0
+        while self.free_pages < demand and self.prefix is not None:
+            page = self.prefix.reclaim_one(protect)
+            if page is None:
+                break
+            self.free_pages += 1
+            freed += 1
+            self.events.append(("prefix_evict", page))
+        return freed
+
+    def admission_page_need(self, req: Request,
+                            hit_pages: Optional[int] = None) -> int:
         """Pages admission demands before scheduling ``req``: the prompt,
         plus — in speculative mode — the worst-case pages of the request's
         FIRST verify pass (positions ``prompt_len .. prompt_len + depth``,
         depth clamped to the request's own token budget).  The clamp keeps
         the demand within ``pages_for(prompt + max_new)``, which ``submit``
         already guarantees the pool can offer — the speculative reservation
-        can never re-introduce the admit-vs-submit livelock."""
-        base = pages_for(req.prompt_len, self.page_size)
+        can never re-introduce the admit-vs-submit livelock.
+
+        With a :class:`~.prefix_cache.PrefixCache` armed, the longest
+        cached prefix's pages come from the index, not the free pool —
+        ``hit_pages`` of the demand are struck (``None`` probes the index;
+        pass the count when the caller already matched)."""
+        if hit_pages is None:
+            hit_pages = 0
+            if self.prefix is not None:
+                hit_pages = len(self.prefix.match(
+                    self.prefix.block_hashes(req.prompt, req.adapter_id)))
         if not self.speculate_k:
-            return base
+            return pages_for(req.prompt_len, self.page_size) - hit_pages
         depth = min(self.speculate_k, req.max_new_tokens - 1)
-        return pages_for(req.prompt_len + 1 + depth, self.page_size)
+        return pages_for(req.prompt_len + 1 + depth, self.page_size) - hit_pages
 
     # -- the per-tick decision ----------------------------------------------
 
@@ -560,7 +672,19 @@ class ContinuousBatchingScheduler:
         evicted slots."""
         evicted = []
         while not fits(active):
-            victims = sorted(self.slots, key=lambda s: -self.slots[s].admit_seq)
+            # cached-but-unreferenced prefix pages are cheaper capacity than
+            # any live sequence (eviction = recompute-on-readmit): reclaim
+            # one LRU index-only page and re-test before picking a victim
+            if self._reclaim(self.free_pages + 1):
+                continue
+            # finished slots are exempt: a hold_finished (prefill-role)
+            # engine parks finished sequences — pages intact — awaiting the
+            # KV transfer; evicting one would requeue an already-finished
+            # request and orphan the engine's held-slot bookkeeping
+            victims = sorted(
+                (s for s in self.slots if not self.slots[s].finished),
+                key=lambda s: -self.slots[s].admit_seq,
+            )
             if not victims:  # pragma: no cover - submit() capacity guard
                 break
             victim = victims[0]
@@ -594,8 +718,11 @@ class ContinuousBatchingScheduler:
                       - pages_for(st.prefilled, self.page_size))
             if needed <= self.free_pages:
                 return True, evicted
+            if self._reclaim(needed):  # index-only pages first, always
+                continue
             victims = sorted(
-                (s for s in self.slots if s != slot),
+                (s for s in self.slots
+                 if s != slot and not self.slots[s].finished),
                 key=lambda s: -self.slots[s].admit_seq,
             ) or [slot]
             self.evict(victims[0])
@@ -603,7 +730,13 @@ class ContinuousBatchingScheduler:
 
     def evict(self, slot: int) -> Request:
         st = self.slots.pop(slot)
-        self.free_pages += pages_for(st.kv_tokens, self.page_size)
+        # the engine's device-side COW release runs AFTER this pop: park the
+        # keep count so the release program still skips the shared prefix
+        # (pushing an aliased page here is exactly the double-free the
+        # refcount guard exists for)
+        if self.prefix is not None:
+            self.evicted_keep[slot] = len(st.shared_pages)
+        self._release_slot_pages(st)
         self.free_slots.append(slot)
         self.free_slots.sort()
         if self.adapters is not None:
@@ -662,7 +795,7 @@ class ContinuousBatchingScheduler:
         """Retire a finished sequence: free its pages and its slot."""
         st = self.slots.pop(slot)
         st.finished = True
-        self.free_pages += pages_for(st.kv_tokens, self.page_size)
+        self._release_slot_pages(st)
         self.free_slots.append(slot)
         self.free_slots.sort()
         if self.adapters is not None:

@@ -1,0 +1,353 @@
+"""Disaggregated prefill→decode: streaming finished KV pages between
+engines (DistServe discipline — the first slice).
+
+Once KV pages are a transferable, refcounted resource (the prefix cache's
+contract), prefill and decode stop having to share an engine: a **prefill
+gang** turns prompts into KV pages + a first token at full chunked-prefill
+throughput, and a **decode gang** consumes those pages at decode batch
+shapes — neither workload pads out the other's step.  This module lands
+the in-process two-engine slice of that split:
+
+- :class:`PagedKVTransport` — two fixed-shape jitted programs move one
+  finished slot's pages between pools: ``send`` gathers the slot's
+  block-table row into a contiguous wire payload (``[L, pps, Hkv, page,
+  D]`` per K/V — the exact bytes a DCN stream would carry), ``recv`` pops
+  fresh pages from the destination free stack, scatters the payload into
+  them and installs block-table row + ``seq_len``.  Bytes are accounted
+  against the ``dcn``-axis model (:func:`transfer_accounting`, the
+  ``dcn_comm_accounting`` pattern) as the ``transfer.page_bytes`` twin.
+- :class:`DisaggregatedPair` — the host loop over a prefill-role engine
+  (``hold_finished=True``: finished slots keep their pages until streamed)
+  and a decode-role engine.  Greedy tokens are BITWISE identical to the
+  same trace through one engine (pinned by tests/test_prefix_cache.py):
+  the payload bytes ARE the K/V, so the decode side attends over exactly
+  what a local prefill would have written.
+
+Multi-host streaming (real DCN between slices, the
+``parallel/hierarchical.py`` transport under a ``dcn`` mesh axis) is the
+documented follow-up: the wire payload, page accounting and twin names are
+already shaped for it — only the in-process device-to-device copy becomes
+a cross-slice send.
+"""
+
+from __future__ import annotations
+
+import dataclasses as _dc
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import ServingEngine
+from .paged_cache import allocate, pages_for
+from .scheduler import Request
+
+
+def page_bytes(config, page_size: int, dtype_bytes: int = 2) -> int:
+    """Wire bytes of ONE physical page across all layers — the unit the
+    transfer twin counts in (``kv_pool_accounting``'s bytes/page)."""
+    return (2 * config.num_hidden_layers * page_size
+            * config.num_key_value_heads * config.head_dim * dtype_bytes)
+
+
+def transfer_accounting(config, trace, page_size: int, dtype_bytes: int = 2,
+                        dcn_gbps: float = 25.0) -> dict:
+    """Predicted ``dcn``-axis byte model for a disaggregated replay of
+    ``trace`` (the ``dcn_comm_accounting`` pattern): every request ships
+    ``pages_for(prompt_len)`` live pages exactly once, prefill→decode.
+    The measured twin (``transfer.page_bytes``) comes from the transport's
+    executed transfers — the two agree exactly unless a request never made
+    it to the handoff (shed, cancelled, drained).  ``dcn_gbps`` turns the
+    bytes into a stream-time envelope per the reference DCN link rate."""
+    per_page = page_bytes(config, page_size, dtype_bytes)
+    pages = sum(int(pages_for(r.prompt_len, page_size)) for r in trace)
+    total = pages * per_page
+    from ..telemetry import twin_registry
+
+    twin_registry().record_predicted(
+        "transfer.page_bytes", total,
+        source="serving/transfer.transfer_accounting",
+    )
+    return {
+        "requests": len(trace),
+        "pages_predicted": pages,
+        "bytes_per_page": per_page,
+        "page_transfer_bytes": total,
+        "dcn_gbps_ref": dcn_gbps,
+        "stream_s_pred": round(total / (dcn_gbps * 1e9), 6) if total else 0.0,
+    }
+
+
+def _transfer_step_fns():
+    def send_step(cache, slot):
+        # one slot's pages, gathered contiguous through its block-table row
+        # — the wire payload a DCN stream would carry (dead pages ride as
+        # padding; the byte twin counts live pages only)
+        row = jax.lax.dynamic_slice_in_dim(cache["block_tables"], slot, 1)[0]
+        ks = jnp.stack([l["k_pages"][:, row] for l in cache["layers"]])
+        vs = jnp.stack([l["v_pages"][:, row] for l in cache["layers"]])
+        return ks, vs  # [L, Hkv, pps, page, D] each
+
+    def recv_step(cache, slot, ks, vs, n_pages, seq_len):
+        # pop n_pages fresh pages, install the block-table row, scatter the
+        # payload into the popped pages — one donated fixed-shape program
+        pps = cache["block_tables"].shape[1]
+        lane = jnp.arange(pps, dtype=jnp.int32)
+        need = lane < n_pages
+        block_tables, free_top = allocate(
+            cache["block_tables"], cache["free_stack"], cache["free_top"],
+            jnp.full((pps,), slot, jnp.int32), lane, need,
+        )
+        row = jax.lax.dynamic_slice_in_dim(block_tables, slot, 1)[0]
+        num_pages = cache["layers"][0]["k_pages"].shape[1]
+        dst = jnp.where(need, row, num_pages)  # OOB -> drop (write-mask rule)
+        new_layers = [
+            {"k_pages": l["k_pages"].at[:, dst].set(ks[i], mode="drop"),
+             "v_pages": l["v_pages"].at[:, dst].set(vs[i], mode="drop")}
+            for i, l in enumerate(cache["layers"])
+        ]
+        return {
+            "layers": new_layers,
+            "block_tables": block_tables,
+            "seq_lens": cache["seq_lens"].at[slot].set(seq_len),
+            "free_stack": cache["free_stack"],
+            "free_top": free_top,
+        }
+
+    return send_step, recv_step
+
+
+@lru_cache(maxsize=8)
+def _transfer_fns(_geom_key):
+    send_step, recv_step = _transfer_step_fns()
+    return (
+        jax.jit(send_step),                      # read-only gather
+        jax.jit(recv_step, donate_argnums=(0,)),  # destination pool donates
+    )
+
+
+class PagedKVTransport:
+    """Streams one finished slot's KV pages from a prefill-role engine to a
+    decode-role engine (in-process: same devices, a gather + scatter; the
+    payload shape is the multi-host wire format).  Byte accounting records
+    the measured side of the ``transfer.page_bytes`` twin and appends
+    ``("page_transfer", uid, n_pages, bytes)`` to the destination
+    scheduler's determinism log (the ``page_transfer`` span)."""
+
+    def __init__(self, src: ServingEngine, dst: ServingEngine):
+        ps, pd = src.plugin, dst.plugin
+        if (ps.page_size, ps.pages_per_slot) != (pd.page_size, pd.pages_per_slot):
+            raise ValueError(
+                "prefill/decode page geometry must match for the in-process "
+                f"handoff: src=({ps.page_size}, {ps.pages_per_slot}) vs "
+                f"dst=({pd.page_size}, {pd.pages_per_slot})"
+            )
+        self.src, self.dst = src, dst
+        self._send, self._recv = _transfer_fns(
+            (ps.page_size, ps.pages_per_slot)
+        )
+        cfg = src.model.config
+        self._page_bytes = page_bytes(
+            cfg, ps.page_size, jnp.dtype(cfg.dtype).itemsize
+        )
+        self.transfers = 0
+        self.pages_moved = 0
+        self.bytes_moved = 0
+
+    def warmup(self) -> None:
+        """Compile both wire programs before traffic (no-op passes: the
+        send gathers slot 0, the recv installs zero pages)."""
+        ks, vs = self._send(self.src.cache, jnp.asarray(0, jnp.int32))
+        self.dst.cache = self._recv(
+            self.dst.cache, jnp.asarray(0, jnp.int32), ks, vs,
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+        )
+
+    def transfer(self, src_slot: int, request: Request, first_token: int) -> int:
+        """Move one held finished slot: gather on the prefill engine, adopt
+        a decode slot, scatter + install on the decode engine, then release
+        the source pages (COW-aware — a prefix-shared page on the prefill
+        side frees only at refcount zero).  Returns the decode slot."""
+        src, dst = self.src, self.dst
+        n_pages = int(pages_for(request.prompt_len, src.plugin.page_size))
+        ks, vs = self._send(src.cache, jnp.asarray(src_slot, jnp.int32))
+        dst_slot = dst.adopt_prefilled(request, first_token)
+        dst.cache = self._recv(
+            dst.cache, jnp.asarray(dst_slot, jnp.int32), ks, vs,
+            jnp.asarray(n_pages, jnp.int32),
+            jnp.asarray(request.prompt_len, jnp.int32),
+        )
+        src.release_held(src_slot)
+        moved = n_pages * self._page_bytes
+        self.transfers += 1
+        self.pages_moved += n_pages
+        self.bytes_moved += moved
+        for eng in (src, dst):
+            eng.metrics["page_transfers"] += 1
+            eng.metrics["page_transfer_pages"] += n_pages
+            eng.metrics["page_transfer_bytes"] += moved
+        dst.sched.events.append(
+            ("page_transfer", request.uid, n_pages, moved)
+        )
+        from ..telemetry import twin_registry
+
+        twin_registry().record_measured(
+            "transfer.page_bytes", self.bytes_moved,
+            source="serving/transfer.PagedKVTransport",
+        )
+        return dst_slot
+
+
+class DisaggregatedPair:
+    """The first disaggregated prefill→decode deployment shape: one
+    prefill-role engine (requests clamped to ``max_new_tokens=1`` — the
+    prompt plus the first sampled token), one decode-role engine, and the
+    transport streaming finished KV pages between them.
+
+    ``run(trace)`` replays a request trace to completion and returns the
+    same ``{uid: tokens}`` dict a single engine's ``run`` would — BITWISE
+    identical greedy tokens (the acceptance pin): the first token comes
+    off the prefill engine's last-chunk logits exactly as a fused engine
+    would sample it, and the decode engine attends over the transferred
+    bytes verbatim.
+    """
+
+    def __init__(self, model, params, plugin=None, generation_config=None,
+                 rng=None, prefill_plugin=None):
+        from ..utils.dataclasses import ServingPlugin
+
+        plugin = plugin or ServingPlugin()
+        if plugin.speculate != "off":
+            raise ValueError(
+                "the disaggregation slice is plain-decode only: disarm "
+                "ServingPlugin.speculate on the pair (speculation composes "
+                "on the decode engine as a follow-up)"
+            )
+        # per-tick deadlines belong to the fused engine's admission story
+        # (each half runs its own virtual clock) — disarm the DEFAULT too,
+        # not just the per-request field: submit() re-stamps
+        # default_deadline_ticks onto any request carrying 0, which would
+        # silently defeat run()'s deadline_ticks=0 opt-out
+        plugin = _dc.replace(plugin, default_deadline_ticks=0)
+        prefill_plugin = _dc.replace(prefill_plugin or plugin,
+                                     default_deadline_ticks=0)
+        self.prefill_engine = ServingEngine(
+            model, params, prefill_plugin, generation_config,
+            rng=rng, hold_finished=True,
+        )
+        self.decode_engine = ServingEngine(
+            model, params, plugin, generation_config, rng=rng,
+        )
+        self.transport = PagedKVTransport(self.prefill_engine,
+                                          self.decode_engine)
+
+    def warmup(self) -> int:
+        before = self.prefill_engine._compile_counter.count
+        self.prefill_engine.warmup()
+        self.decode_engine.warmup()
+        self.transport.warmup()
+        # post-warmup compile baselines: run() must stay compile-free from
+        # here (the strict_compiles contract extends across the pair — the
+        # wire programs are production programs too)
+        self._compile_base = (self.prefill_engine.compile_events,
+                              self.decode_engine.compile_events)
+        return self.prefill_engine._compile_counter.count - before
+
+    def run(self, trace: list[Request], max_steps: int = 200_000) -> dict[int, list[int]]:
+        P, D = self.prefill_engine, self.decode_engine
+        pending = sorted(trace, key=lambda r: (r.arrival_step, r.uid))
+        originals = {r.uid: r for r in pending}
+        eos = P.gen_config.eos_token_id
+        done: dict[int, list[int]] = {}
+        i = 0
+        steps = 0
+        while True:
+            while i < len(pending) and pending[i].arrival_step <= P.steps:
+                P.add_request(_dc.replace(pending[i], max_new_tokens=1,
+                                          deadline_ticks=0))
+                i += 1
+            # stream every held finished prefill the decode side can seat
+            while P.held and self._dst_capacity():
+                slot = P.held[0]
+                uid = P.sched.slots[slot].request.uid
+                tok = P.results[uid][0]
+                if originals[uid].max_new_tokens == 1 or \
+                        (eos is not None and tok == eos):
+                    # the first token already finished the request: nothing
+                    # to decode, nothing to stream
+                    P.release_held(slot)
+                    done[uid] = [tok]
+                    continue
+                # the decode engine runs on its own virtual clock: per-tick
+                # deadlines belong to the fused engine's admission story and
+                # stay a documented follow-up for the split
+                self.transport.transfer(
+                    slot, _dc.replace(originals[uid], deadline_ticks=0),
+                    P.results[uid][0],
+                )
+            if P.held and not self._dst_capacity() and not D.idle():
+                # a finished prefill is waiting on decode capacity: drain
+                # decode FIRST (prefill idling ahead of a blocked handoff
+                # must never starve the decode engine of ticks)
+                D.step()
+            elif self._p_busy():
+                P.step()
+            elif not D.idle():
+                D.step()
+            elif i < len(pending):
+                P.step()  # idle tick — advances the virtual arrival clock
+            elif P.held:
+                raise RuntimeError(
+                    "disaggregated handoff wedged: held prefill slots with "
+                    "an idle decode engine that cannot seat them — "
+                    "mismatched pool geometry?"
+                )  # pragma: no cover - geometry validated at construction
+            else:
+                break
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"disaggregated replay exceeded {max_steps} steps"
+                )
+        # the prefill engine recorded 1-token results; the decode engine
+        # owns the full streams (first token included); one-token requests
+        # finished at the handoff boundary
+        return {**D.results, **done}
+
+    def _p_busy(self) -> bool:
+        P = self.prefill_engine
+        return bool(P.sched.waiting) or any(
+            not st.finished for st in P.sched.slots.values()
+        )
+
+    def _dst_capacity(self) -> bool:
+        P, D = self.prefill_engine, self.decode_engine
+        if not P.held or not D.sched.free_slots:
+            return False
+        uid = P.sched.slots[P.held[0]].request.uid
+        need = pages_for(
+            P.sched.slots[P.held[0]].request.prompt_len, D.plugin.page_size
+        )
+        return need <= D.sched.free_pages
+
+    def report(self) -> dict:
+        t = self.transport
+        base = getattr(self, "_compile_base", (0, 0))
+        return {
+            "page_transfers": t.transfers,
+            "page_transfer_pages": t.pages_moved,
+            "page_transfer_bytes": t.bytes_moved,
+            "prefill_steps": self.prefill_engine.steps,
+            "decode_steps": self.decode_engine.steps,
+            # post-warmup compile events per engine — zero is the contract
+            "compiles_prefill": self.prefill_engine.compile_events - base[0],
+            "compiles_decode": self.decode_engine.compile_events - base[1],
+        }
+
+
+__all__ = [
+    "PagedKVTransport", "DisaggregatedPair", "transfer_accounting",
+    "page_bytes",
+]

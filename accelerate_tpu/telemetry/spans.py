@@ -330,6 +330,25 @@ class RequestTracer:
             elif kind == "ladder":
                 rec.instant("ladder", "engine", cat="overload", stage=ev[1],
                             step=step)
+            elif kind == "prefix_hit":
+                # admission mapped a cached prefix: hit_tokens of prefill
+                # skipped (the COW share boundary for this request)
+                rec.instant("prefix_hit", f"req {ev[1]}", cat="prefix",
+                            hit_tokens=ev[2], step=step)
+            elif kind == "cow_fork":
+                rec.instant("cow_fork", f"req {ev[1]}", cat="prefix",
+                            step=step)
+            elif kind == "prefix_evict":
+                rec.instant("prefix_evict", "engine", cat="prefix",
+                            page=ev[1], step=step)
+            elif kind == "prefix_flush":
+                rec.instant("prefix_flush", "engine", cat="prefix",
+                            pages_freed=ev[1], step=step)
+            elif kind == "page_transfer":
+                # the disaggregation handoff: one request's KV pages
+                # streamed prefill -> decode
+                rec.instant("page_transfer", f"req {ev[1]}", cat="transfer",
+                            pages=ev[2], bytes=ev[3], step=step)
             elif kind == "finish":
                 uid = ev[1]
                 start = self._decode_start.pop(uid, now)

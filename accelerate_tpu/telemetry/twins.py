@@ -79,6 +79,21 @@ STANDARD_TWINS: dict[str, tuple] = {
     "serving.pages_reclaimed_on_cancel": ("pages", 0.0, 0.0),
     # completed / (completed + deliberately retired); clean-run model: 1.0
     "serving.request_goodput_frac": ("frac", 0.1, None),
+    # serving/prefix_cache.predicted_prefix_hit_rate (model-free trace
+    # replay, unbounded index) vs the PrefixCache's admission counters —
+    # the prediction error is capacity traffic (LRU reclaims, flush
+    # faults, eviction-driven re-admissions re-hitting their own pages)
+    "prefix_cache.hit_rate": ("frac", 0.25, None),
+    # TTFT in virtual engine ticks: predicted = the SAME trace replayed
+    # with reuse OFF (the no-reuse baseline bench runs), measured = with
+    # reuse.  The drift IS the reuse win — tolerance 1.0 keeps the row
+    # informational (it can never read as model error)
+    "prefix_cache.ttft_ticks": ("ticks", 1.0, 1.0),
+    # serving/transfer.transfer_accounting (every request ships
+    # pages_for(prompt) live pages once, prefill->decode) vs the
+    # transport's executed byte counter — exact by construction unless a
+    # request never reached the handoff
+    "transfer.page_bytes": ("bytes", 0.01, None),
 }
 
 

@@ -522,6 +522,15 @@ class ServingPlugin(KwargsHandler):
                                              # draft token; env
                                              # ACCELERATE_SERVE_SPECULATE_DRAFT,
                                              # default 32)
+    prefix_cache: str = ""                   # content-addressed COW prefix
+                                             # reuse (serving/prefix_cache.py):
+                                             # "off" | "on" — full prompt-prefix
+                                             # pages hash-match against shared
+                                             # refcounted physical pages, chunked
+                                             # prefill starts at the hit
+                                             # boundary.  env
+                                             # ACCELERATE_SERVE_PREFIX_CACHE
+                                             # ("1"/"on" mean on), default off
     max_queue: Optional[int] = None          # bounded waiting line: beyond this
                                              # depth the deterministic shed
                                              # policy drops requests (0 =
@@ -603,6 +612,21 @@ class ServingPlugin(KwargsHandler):
                 )
             if self.speculate_buckets[0] < 1:
                 raise ValueError("speculate_buckets entries must be >= 1")
+        if isinstance(self.prefix_cache, bool):
+            self.prefix_cache = "on" if self.prefix_cache else "off"
+        if not self.prefix_cache:
+            self.prefix_cache = os.environ.get(
+                "ACCELERATE_SERVE_PREFIX_CACHE", "off"
+            )
+        self.prefix_cache = {"1": "on", "true": "on", "0": "off",
+                             "false": "off", "": "off"}.get(
+            self.prefix_cache.lower(), self.prefix_cache.lower()
+        )
+        if self.prefix_cache not in ("off", "on"):
+            raise ValueError(
+                f"prefix_cache must be 'off' or 'on' (or '1'/'true' for on), "
+                f"got {self.prefix_cache!r}"
+            )
         if self.max_queue is None:
             self.max_queue = int(env.get("ACCELERATE_SERVE_MAX_QUEUE", 0))
         if self.kv_shed_watermark is None:

@@ -465,6 +465,10 @@ def serve_report(args) -> dict:
     spec_k = getattr(args, "speculate", None)
     spec_kw = ({"speculate": "ngram", "speculate_k": int(spec_k)}
                if spec_k else {})
+    prefix_share = getattr(args, "prefix_share", None)
+    if prefix_share:
+        # --prefix-share arms the COW prefix cache on the serving engine
+        spec_kw["prefix_cache"] = "on"
     if on_tpu:
         # the 600m-class decode shape (the headline bench's model family);
         # pool sized off the KV-HBM ladder, paged Pallas decode kernel
@@ -494,6 +498,7 @@ def serve_report(args) -> dict:
         args.serve_seed, args.serve_requests, vocab_size=cfg.vocab_size,
         mean_interarrival_steps=0.5, prompt_len_range=prompt_range,
         new_tokens_range=new_range, adapters=n_adapters,
+        prefix_share=prefix_share or 0.0,
     )
     gen_cfg = GenerationConfig(max_new_tokens=new_range[1])
     store = store_dir = None
@@ -511,6 +516,20 @@ def serve_report(args) -> dict:
                              offload_dir=store_dir.name)
         for t in range(1, n_adapters + 1):
             store.publish_random(t, jax.random.PRNGKey(1000 + t))
+    # the no-reuse baseline runs FIRST (its registry records are then
+    # overwritten by the main replay's): same trace, prefix cache off — the
+    # ttft with/without-reuse comparison the prefix twin records (ticks:
+    # deterministic on CPU where wall clocks flake)
+    ttft_no_reuse_ticks = 0.0
+    no_reuse_results = None
+    if prefix_share:
+        base_engine = ServingEngine(
+            model, params, _dc.replace(plugin, prefix_cache="off"), gen_cfg,
+            adapters=store,
+        )
+        base_rep = replay(base_engine, trace)
+        ttft_no_reuse_ticks = base_rep["ttft_p50_ticks"]
+        no_reuse_results = base_rep["results"]
     engine = ServingEngine(model, params, plugin, gen_cfg, adapters=store)
     trace_out = getattr(args, "trace_requests", None)
     if trace_out is not None:
@@ -520,6 +539,53 @@ def serve_report(args) -> dict:
         # telemetry_overhead_frac
         engine.enable_tracing()
     rep = replay(engine, trace)
+    rep["ttft_no_reuse_p50_ticks"] = ttft_no_reuse_ticks
+    rep["prefix_reuse_token_parity"] = (
+        no_reuse_results == rep["results"] if no_reuse_results is not None
+        else True
+    )
+    if prefix_share:
+        from accelerate_tpu.telemetry import twin_registry as _tr
+
+        # predicted = the no-reuse baseline's TTFT, measured = with reuse:
+        # the drift IS the reuse win (tolerance 1.0 — informational row)
+        _tr().record("prefix_cache.ttft_ticks",
+                     predicted=ttft_no_reuse_ticks,
+                     measured=rep["ttft_p50_ticks"],
+                     source="bench.serve prefix baseline")
+    if getattr(args, "disaggregate", False) and n_adapters:
+        raise SystemExit(
+            "--disaggregate composes with the base model only for now "
+            "(adapter routing across the prefill→decode split is the "
+            "documented follow-up) — drop --adapters"
+        )
+    if getattr(args, "disaggregate", False):
+        from accelerate_tpu.serving import (
+            DisaggregatedPair, transfer_accounting,
+        )
+
+        # the first disaggregated prefill→decode slice on the same trace:
+        # page_transfer_bytes measured vs the dcn accounting model (the
+        # transfer.page_bytes twin — exact unless a request never reached
+        # the handoff)
+        pair = DisaggregatedPair(
+            model, params, _dc.replace(plugin, speculate="off"), gen_cfg,
+        )
+        pair.warmup()
+        pair_results = pair.run(trace)
+        pair_rep = pair.report()
+        pair_rep["token_parity_vs_fused"] = pair_results == rep["results"]
+        rep["disaggregated"] = pair_rep
+        rep["page_transfers"] = pair_rep["page_transfers"]
+        rep["page_transfer_pages"] = pair_rep["page_transfer_pages"]
+        rep["page_transfer_bytes"] = pair_rep["page_transfer_bytes"]
+        rep["transfer_accounting"] = transfer_accounting(
+            cfg, trace, plugin.page_size,
+            dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+        )
+    else:
+        rep["disaggregated"] = {"page_transfers": 0, "page_transfer_bytes": 0,
+                                "token_parity_vs_fused": True}
     if trace_out is not None and trace_out != "-":
         engine.trace.write_chrome_trace(trace_out)
         rep["trace_file"] = trace_out
@@ -717,6 +783,28 @@ def main():
                          "speculative_rollbacks fields measure the win; "
                          "tokens_per_step must beat the speculate-off 1.0 "
                          "on the seeded trace (pinned by smoke)")
+    ap.add_argument("--prefix-share", type=float, default=None, metavar="P",
+                    help="with --serve: shared-system-prompt traffic mix — "
+                         "each request opens, with probability P, with one of "
+                         "two seeded preambles, and the engine arms the "
+                         "content-addressed COW prefix cache "
+                         "(serving/prefix_cache.py).  The report's always-"
+                         "emitted prefix block (prefix_hit_rate predicted + "
+                         "measured twins, pages_shared_peak, cow_forks, "
+                         "prefill_tokens_skipped) measures the reuse; a "
+                         "no-reuse baseline replay of the SAME trace feeds "
+                         "the ttft with/without-reuse comparison "
+                         "(ttft_p50_ticks must improve — pinned by smoke).  "
+                         "Tokens are bitwise identical with reuse on or off")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="with --serve: run the trace through the "
+                         "disaggregated prefill→decode pair "
+                         "(serving/transfer.py) instead of one fused engine "
+                         "— finished KV pages stream between the two engines "
+                         "through the fixed-shape wire programs, and "
+                         "page_transfer_bytes is reported against the "
+                         "dcn-accounting model (the transfer.page_bytes "
+                         "twin, exact by construction)")
     ap.add_argument("--trace-requests", nargs="?", const="-", default=None,
                     metavar="FILE",
                     help="with --serve: record request-level lifecycle spans "

@@ -62,6 +62,12 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "synthesize_trace", "replay", "chaos_replay", "static_batching_report",
         "predicted_pool_utilization", "DegradationLadder",
         "verify_serving_invariants",
+        "PagedKVTransport", "DisaggregatedPair", "transfer_accounting",
+        "page_bytes",
+    ]),
+    "prefix_cache": ("accelerate_tpu.serving.prefix_cache", [
+        "PrefixCache", "block_hashes", "unbounded_prefix_hit_rate",
+        "prefix_cache_accounting",
     ]),
     "speculate": ("accelerate_tpu.serving.speculate", [
         "NgramDraft", "DraftModelDraft", "Speculator", "make_draft_provider",

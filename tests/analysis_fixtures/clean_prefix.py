@@ -1,0 +1,57 @@
+"""CLEAN prefix-cache twins — the discipline the real engine uses
+(``serving/engine.py`` ``_prefix_fns`` + ``serving/prefix_cache.py``).
+
+Each function mirrors one in ``planted_prefix.py`` with the hazard
+retired: the keep-count accounting reads the RETURNED cache (the donated
+name is dead after the adopt dispatch — in production the host
+``SlotState.shared_pages`` mirror plays this role with no device fetch at
+all), and the adopt arithmetic pads the shared-page id vector to the
+static ``pages_per_slot`` bound with the hit length as a plain masked
+ARGUMENT (one compile for any hit depth — the fixed-shape contract
+``strict_compiles`` enforces).  graft-lint must stay quiet on every
+function here.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _adopt(cache, page_ids, n_shared):
+    keep = jnp.arange(cache["block_tables"].shape[1]) < n_shared
+    row = jnp.where(keep, page_ids, cache["block_tables"][0])
+    return {"block_tables": cache["block_tables"].at[0].set(row),
+            "seq_lens": cache["seq_lens"]}
+
+
+jitted_adopt = jax.jit(_adopt, donate_argnums=(0,))
+
+
+def adopt_reuses_donated_block_tables(cache, page_ids, n_shared):
+    # the keep-count accounting reads the RETURNED structure: the donated
+    # name is dead after the adopt dispatch (in production the scheduler's
+    # host shared-prefix mirror does this arithmetic with no device fetch)
+    new_cache = jitted_adopt(cache, page_ids, n_shared)
+    keep_counts = (new_cache["block_tables"][0] >= 0).sum()
+    return new_cache, keep_counts
+
+
+@partial(jax.jit, static_argnames=("pages_per_slot",))
+def adopt_mask_hit_iota(n_hit, x, pages_per_slot):
+    """GL305 fixed: the width is the static ``pages_per_slot`` BOUND, not
+    this admission's live hit length — hits of any depth pad up to it and
+    mask, one compile ever."""
+    return x + jnp.where(jnp.arange(pages_per_slot) < n_hit, 1, 0)
+
+
+def example_args():
+    cache = {
+        "block_tables": jnp.zeros((4, 8), jnp.int32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "adopt_reuses_donated_block_tables": (
+            cache, jnp.zeros((8,), jnp.int32), jnp.asarray(2, jnp.int32)
+        ),
+    }

@@ -1,0 +1,64 @@
+"""PLANTED prefix-cache hazards — the two ways COW page sharing breaks the
+serving contracts (corrected twins: ``clean_prefix.py``).
+
+Adoption writes a request's shared page ids into the donated cache's
+block table; the tempting bug is reading the block-table row back off the
+DONATED structure after the adopt dispatch to build the release keep
+counts — ``adopt_reuses_donated_block_tables`` carries that shape (GL201,
+the async-ckpt race applied across the share boundary; the real engine
+reads the RETURNED cache, and its host ``shared_pages`` mirror needs no
+device fetch at release time at all).
+``adopt_mask_hit_iota`` carries the hit-length-dependent trace (GL305): an
+adopt program keyed on this admission's matched-prefix length re-
+specializes per hit depth — the first prompt with a different cached
+prefix length would recompile mid-traffic (``strict_compiles``); the real
+adopt program pads the id vector to the static ``pages_per_slot`` bound
+and masks, one compile ever.  Excluded from repo-wide sweeps like the
+rest of this directory.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _adopt(cache, page_ids, n_shared):
+    keep = jnp.arange(cache["block_tables"].shape[1]) < n_shared
+    row = jnp.where(keep, page_ids, cache["block_tables"][0])
+    return {"block_tables": cache["block_tables"].at[0].set(row),
+            "seq_lens": cache["seq_lens"]}
+
+
+jitted_adopt = jax.jit(_adopt, donate_argnums=(0,))
+
+
+def adopt_reuses_donated_block_tables(cache, page_ids, n_shared):
+    # GL201: `cache` was donated to the adopt step — XLA may already be
+    # overwriting its buffers in place when the keep-count accounting
+    # reads block_tables off the STALE structure instead of the returned
+    # one (the production engine keeps the shared prefix in the host
+    # SlotState mirror: no device fetch on the release path)
+    new_cache = jitted_adopt(cache, page_ids, n_shared)
+    keep_counts = (cache["block_tables"][0] >= 0).sum()
+    return new_cache, keep_counts
+
+
+@jax.jit
+def adopt_mask_hit_iota(hit_page_ids, x):
+    """GL305: ``hit_page_ids.shape[0]`` (this admission's matched-prefix
+    length) flows straight into ``jnp.arange`` and the hit length is not
+    static — the adopt program re-specializes per hit depth instead of
+    padding to the ``pages_per_slot`` bound (the mid-traffic recompile
+    ``strict_compiles`` exists to catch)."""
+    return x + jnp.arange(hit_page_ids.shape[0])
+
+
+def example_args():
+    cache = {
+        "block_tables": jnp.zeros((4, 8), jnp.int32),
+        "seq_lens": jnp.zeros((4,), jnp.int32),
+    }
+    return {
+        "adopt_reuses_donated_block_tables": (
+            cache, jnp.zeros((8,), jnp.int32), jnp.asarray(2, jnp.int32)
+        ),
+    }

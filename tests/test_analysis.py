@@ -593,6 +593,28 @@ def test_fixture_overload_clean_twin_quiet():
     assert not rep.unsuppressed(), rep.render()
 
 
+def test_fixture_prefix_planted_gl201_share_boundary():
+    """Reading the donated block table back AFTER the adopt dispatch to
+    build the COW release keep counts (the async-ckpt race applied across
+    the share boundary) is flagged at the AST level."""
+    rep = lint_paths([FIXTURES / "planted_prefix.py"], excludes=())
+    assert "GL201" in _rules_of(rep), rep.render()
+
+
+def test_fixture_prefix_planted_gl305_hit_length_trace():
+    """An adopt program keyed on this admission's matched-prefix length
+    re-specializes per hit depth — the AST recompile rule flags it; the
+    clean twin (static pages_per_slot bound, hit length as a masked
+    argument) stays quiet."""
+    rep = lint_paths([FIXTURES / "planted_prefix.py"], excludes=())
+    assert "GL305" in _rules_of(rep), rep.render()
+
+
+def test_fixture_prefix_clean_twin_quiet():
+    rep = lint_paths([FIXTURES / "clean_prefix.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
+
+
 def test_gl205_one_hop_name_resolution_and_scope():
     # the live path reaches the write through a local assignment — still hit
     src = (

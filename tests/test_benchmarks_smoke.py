@@ -217,6 +217,24 @@ def test_bench_serve_smoke():
     assert extra["draft_overhead_frac"] == 0.0
     assert extra["speculative_rollbacks"] == 0
 
+    # the prefix-cache + disaggregation block rides EVERY serve report,
+    # zeros-clean with the cache off and no transport attached (ISSUE 15:
+    # the always-emitted idle contract)
+    for field in ("prefix_cache", "prefix_hit_rate",
+                  "prefix_hit_rate_predicted", "pages_shared_peak",
+                  "cow_forks", "prefill_tokens_skipped", "prefix_evictions",
+                  "page_transfers", "page_transfer_bytes", "ttft_p50_ticks",
+                  "disaggregated"):
+        assert field in extra, field
+    assert extra["prefix_cache"] == "off"
+    assert extra["prefix_hit_rate"] == 0.0
+    assert extra["pages_shared_peak"] == 0 and extra["cow_forks"] == 0
+    assert extra["prefill_tokens_skipped"] == 0
+    assert extra["page_transfer_bytes"] == 0
+    assert extra["disaggregated"]["page_transfers"] == 0
+    assert extra["twins"]["prefix_cache.hit_rate"]["status"] == "idle"
+    assert extra["twins"]["transfer.page_bytes"]["status"] == "idle"
+
     # idle trace: every field still present, zeros (the always-emitted
     # contract BENCH_*.json relies on)
     rep_idle = _run(["bench.py", "--serve", "--batch", "8",
@@ -234,6 +252,59 @@ def test_bench_serve_smoke():
     assert extra_idle["deadline_misses"] == 0
     assert extra_idle["request_goodput_frac"] == 0.0  # nothing served
     assert extra_idle["ladder_stage"] == "normal"
+
+
+@pytest.mark.slow
+def test_bench_serve_prefix_share_smoke():
+    """``--serve --prefix-share``: on the seeded shared-system-prompt CPU
+    trace the prefix cache must actually reuse (prefill_tokens_skipped >
+    0, hit rate > 0 with the scheduler-replay predicted twin within its
+    registered tolerance), continuous-with-reuse must beat no-reuse on
+    TTFT (virtual ticks — deterministic on CPU), tokens stay bitwise
+    identical reuse on/off, and the replay stays recompile-free; with
+    ``--disaggregate`` the pair's tokens match the fused engine and
+    page_transfer_bytes equals the dcn accounting model exactly."""
+    rep = _run(["bench.py", "--serve", "--batch", "4", "--serve-requests",
+                "10", "--prefix-share", "0.8", "--disaggregate"])
+    extra = rep["extra"]
+    assert extra["prefix_cache"] == "on"
+    assert extra["prefix_hit_rate"] > 0.0
+    assert extra["prefill_tokens_skipped"] > 0
+    assert extra["prefix_reuse_token_parity"] is True
+    # reuse beats no-reuse on TTFT (the acceptance comparison, in ticks)
+    assert extra["ttft_p50_ticks"] < extra["ttft_no_reuse_p50_ticks"]
+    row = extra["twins"]["prefix_cache.hit_rate"]
+    assert row["rel_err"] <= row["tolerance"], row
+    assert extra["compiles_measured"] == 0
+    # the disaggregated slice: parity + the exact byte twin
+    dis = extra["disaggregated"]
+    assert dis["token_parity_vs_fused"] is True
+    assert dis["page_transfers"] > 0
+    assert dis["compiles_prefill"] == 0 and dis["compiles_decode"] == 0
+    assert extra["page_transfer_bytes"] == \
+        extra["transfer_accounting"]["page_transfer_bytes"] > 0
+    assert extra["twins"]["transfer.page_bytes"]["rel_err"] == 0.0
+
+
+@pytest.mark.slow
+def test_bench_serve_prefix_all_armed_strict_compiles():
+    """The acceptance gate: reuse + speculation + adapters ALL armed on one
+    replay — strict_compiles holds post-warmup (the harness raises on any
+    mid-traffic compile, so the bench completing IS the pin) and the
+    prefix block still measures real reuse."""
+    # 16 requests at share 0.9: tenant-keyed hashing splits the preambles
+    # across 3 adapter classes, so the trace needs enough arrivals for
+    # same-tenant repeats to land hits
+    rep = _run(["bench.py", "--serve", "--batch", "4", "--serve-requests",
+                "16", "--prefix-share", "0.9", "--speculate", "3",
+                "--adapters", "2"])
+    extra = rep["extra"]
+    assert extra["prefix_cache"] == "on"
+    assert extra["speculate"] == "ngram"
+    assert extra["adapters"] > 0
+    assert extra["compiles_measured"] == 0
+    assert extra["prefill_tokens_skipped"] > 0
+    assert extra["prefix_reuse_token_parity"] is True
 
 
 @pytest.mark.slow

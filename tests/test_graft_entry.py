@@ -74,6 +74,17 @@ def test_telemetry_leg():
 
 
 @pytest.mark.slow
+def test_prefix_leg():
+    """tp=2 prefix-cached serve over shared-system-prompt traffic: hit
+    rate > 0 with the scheduler-replay twin in exact agreement, survivors
+    bitwise vs the reuse-off replay, zero post-warmup compiles, refcounted
+    invariants green (the leg itself raises on any of these failing)."""
+    info = graft._prefix_leg(np.random.default_rng(0))
+    assert "parity ok" in info and "compiles=0" in info
+    assert "hit_rate=" in info and "tp" in info
+
+
+@pytest.mark.slow
 def test_speculate_leg():
     """tp=2 speculative serve: token parity vs generate() over the same
     TP-sharded params, strict_compiles post-warmup, and a real tokens/step

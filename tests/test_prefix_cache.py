@@ -1,0 +1,514 @@
+"""Prefix-cached paged KV tests: copy-on-write shared pages, refcounted
+eviction, and the first disaggregated prefill→decode slice (ISSUE 15).
+
+The acceptance pins: ``generate_paged`` greedy tokens are BITWISE identical
+with prefix caching on or off — including under eviction/recompute
+pressure, speculative-decode rollback, mixed LoRA tenant traffic, and
+cancel/deadline/prefix-flush chaos — the refcounted
+``verify_serving_invariants`` contract holds after every scenario (no
+referenced page on the free stack, refcounts balance the index + slot
+holds exactly, host shared-prefix mirror == device block-table rows), and
+the disaggregated pair emits the same tokens as a fused engine with the
+``transfer.page_bytes`` twin exact.
+
+Every engine in this module shares ONE geometry (slots=4, page=4, pool=24,
+chunk=8 — test_overload.py's) so the process-shared jit cache compiles
+each program exactly once across both modules (the tier-1 time budget).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu.generation import GenerationConfig, generate, generate_paged
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.resilience import FaultEvent, FaultPlan
+from accelerate_tpu.serving import (
+    DisaggregatedPair,
+    PrefixCache,
+    Request,
+    ServingEngine,
+    block_hashes,
+    chaos_replay,
+    prefix_cache_accounting,
+    replay,
+    synthesize_trace,
+    transfer_accounting,
+    verify_serving_invariants,
+)
+from accelerate_tpu.telemetry import twin_registry
+from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+MAX_NEW = 16  # ONE decode budget for the module: every engine shares jits
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _plugin(**kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pages_per_slot", 8)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefill_buckets", (4, 8))
+    kw.setdefault("decode_kernel", "native")
+    return ServingPlugin(**kw)
+
+
+def _engine(tiny_model, **kw):
+    model, params = tiny_model
+    return ServingEngine(model, params, _plugin(**kw),
+                         GenerationConfig(max_new_tokens=MAX_NEW))
+
+
+def _shared_trace(seed, n, share=0.85, pre_len=9, new=(4, 8)):
+    return synthesize_trace(
+        seed, n, vocab_size=256, mean_interarrival_steps=1.0,
+        prompt_len_range=(4, 12), new_tokens_range=new,
+        prefix_share=share, shared_prefix_len=pre_len,
+    )
+
+
+def _assert_clean(eng):
+    problems = verify_serving_invariants(eng)
+    assert not problems, problems
+
+
+# ---------------------------------------------------------------------------
+# host-side contracts: hashing, refcounts, LRU, the double-free guard
+# ---------------------------------------------------------------------------
+
+
+def test_block_hash_chain_cap_and_tenant_keying():
+    """Hashes chain (a page's hash commits to the WHOLE prefix), cap at
+    (len-1)//page so the last prompt token always prefills, and the tenant
+    id keys the chain (cross-tenant prompts never alias)."""
+    p = tuple(range(1, 14))  # 13 tokens, page 4 -> cap (13-1)//4 = 3 pages
+    h = block_hashes(p, 4)
+    assert len(h) == 3
+    # page-aligned prompt: the last page is still not cacheable
+    assert len(block_hashes(tuple(range(1, 13)), 4)) == 2  # 12 tokens
+    assert len(block_hashes((1, 2, 3), 4)) == 0
+    # chaining: same page-2 tokens under a different page-1 differ
+    q = (99,) + p[1:]
+    assert block_hashes(q, 4)[1] != h[1]
+    # tenant keying
+    assert block_hashes(p, 4, adapter_id=1) != h
+
+
+def test_refcount_lifecycle_reclaim_lru_and_protect():
+    pc = PrefixCache(4)
+    h = block_hashes(tuple(range(1, 14)), 4)
+    # a prefilled slot inserts its pages: index hold + slot hold each
+    assert pc.insert_owned(h, [10, 11, 12]) == [10, 11, 12]
+    assert pc.refcount == {10: 2, 11: 2, 12: 2}
+    # a second admission adopts the full prefix
+    assert pc.adopt(h) == [10, 11, 12]
+    assert pc.refcount[10] == 3
+    assert pc.stats["pages_shared_peak"] == 3
+    # nothing reclaimable while slots hold references
+    pc.unref_pages([10, 11, 12])          # second slot releases
+    assert pc.reclaim_one() is None        # first slot still holds
+    assert pc.unref_pages([10, 11, 12]) == 0  # index still holds all three
+    # now index-only: LRU reclaim frees, protect exempts
+    assert pc.reclaim_one(protect=frozenset({10, 11, 12})) is None
+    page = pc.reclaim_one()
+    assert page == 10                      # LRU: earliest-touched first
+    assert pc.pop_pending() == [10]
+    assert pc.flush() == 2                 # the remaining index-only pages
+    assert sorted(pc.pop_pending()) == [11, 12]
+    assert pc.refcount == {} and pc.index == {}
+
+
+def test_pop_pending_double_free_guard_planted():
+    """THE corruption a refcount bug causes: a still-referenced page queued
+    for the device free stack must fail loudly at the host boundary."""
+    pc = PrefixCache(4)
+    pc.ref_pages([7])
+    pc.pending_free.append(7)  # planted: freed while referenced
+    with pytest.raises(RuntimeError, match="double-free"):
+        pc.pop_pending()
+
+
+def test_insert_stops_at_indexed_conflict():
+    """A concurrent identical prefill that lost the race keeps its
+    duplicate pages private — every slot's shared set stays a contiguous
+    block-table row prefix."""
+    pc = PrefixCache(4)
+    h = block_hashes(tuple(range(1, 14)), 4)
+    pc.insert_owned(h[:2], [3, 4])
+    # the loser tries to insert the same chain with ITS pages: nothing lands
+    assert pc.insert_owned(h, [20, 21, 22]) == []
+    assert pc.index[h[0]] == 3 and 20 not in pc.refcount
+    # a disjoint continuation past the indexed prefix does land
+    assert pc.insert_owned(h[2:], [22]) == [22]
+
+
+def test_prefix_cache_accounting_envelope():
+    trace = _shared_trace(0, 8)
+    acc = prefix_cache_accounting(LlamaConfig.tiny(), trace, 4, dtype_bytes=4)
+    assert acc["cacheable_pages_total"] >= acc["cacheable_pages_unique"] > 0
+    assert 0.0 < acc["dedup_frac"] < 1.0
+    assert acc["prefill_tokens_skippable"] > 0
+    assert 0.0 < acc["hit_rate_upper"] <= 1.0
+    assert acc["shared_bytes_peak_upper"] == \
+        acc["cacheable_pages_unique"] * acc["bytes_per_page"]
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance pins: bitwise parity with reuse on/off
+# ---------------------------------------------------------------------------
+
+
+def test_generate_paged_bitwise_prefix_on_off(tiny_model):
+    model, params = tiny_model
+    rng = np.random.default_rng(1)
+    pre = tuple(int(x) for x in rng.integers(1, 255, 9))
+    prompts = [pre + tuple(int(x) for x in rng.integers(1, 255, k))
+               for k in (3, 5, 4)]
+    width = max(len(p) for p in prompts)
+    ids = np.zeros((3, width), np.int32)
+    lens = []
+    for i, p in enumerate(prompts):
+        ids[i, :len(p)] = p
+        lens.append(len(p))
+    gc = GenerationConfig(max_new_tokens=MAX_NEW)
+    plug = _plugin(num_slots=3)
+    off = generate_paged(model, params, ids, gc, prompt_lengths=lens,
+                         serving_plugin=plug)
+    on = generate_paged(model, params, ids, gc, prompt_lengths=lens,
+                        serving_plugin=plug, prefix_cache=True)
+    ref = generate(model, params, jnp.asarray(ids), gc,
+                   prompt_lengths=jnp.asarray(lens))
+    assert np.array_equal(np.asarray(on), np.asarray(off))
+    assert np.array_equal(np.asarray(on), np.asarray(ref))
+
+
+def test_eviction_pressure_parity_hits_and_invariants(tiny_model):
+    """Recompute-on-readmit under pool pressure: reuse changes WHERE K/V
+    comes from, never the tokens; LRU reclaim fires (index-only pages are
+    cheaper capacity than any live sequence) and the refcounted
+    conservation contract holds after the storm."""
+    trace = _shared_trace(7, 12, new=(4, 10))
+    res = {}
+    for mode in ("off", "on"):
+        eng = _engine(tiny_model, num_pages=24, prefix_cache=mode)
+        rep = replay(eng, trace, verify_invariants=True)
+        res[mode] = (rep["results"], rep)
+        _assert_clean(eng)
+    on_rep = res["on"][1]
+    assert res["on"][0] == res["off"][0]
+    assert on_rep["prefix_hit_rate"] > 0.0
+    assert on_rep["prefill_tokens_skipped"] > 0
+    assert on_rep["pages_shared_peak"] > 0
+    assert on_rep["compiles_measured"] == 0
+
+
+def test_speculative_rollback_never_frees_aliased_page(tiny_model):
+    """Speculation + prefix reuse composed: the verify pass's worst-case
+    allocate → rollback cycle only ever touches pages popped THIS pass
+    (always private by construction), so tokens stay bitwise and the
+    refcounted invariants hold with both armed."""
+    trace = _shared_trace(7, 10, new=(4, 10))
+    res = {}
+    for mode in ("off", "on"):
+        eng = _engine(tiny_model, num_pages=24, prefix_cache=mode,
+                      speculate="ngram", speculate_k=4)
+        rep = replay(eng, trace, verify_invariants=True)
+        res[mode] = rep
+        _assert_clean(eng)
+    assert res["on"]["results"] == res["off"]["results"]
+    assert res["on"]["verify_steps"] > 0
+    assert res["on"]["compiles_measured"] == 0
+    # plain engine equality too: speculation is already pinned bitwise
+    base = _engine(tiny_model, num_pages=24)
+    assert replay(base, trace)["results"] == res["on"]["results"]
+
+
+def test_mixed_lora_tenants_never_alias_and_stay_bitwise(tiny_model):
+    """The hash chain is keyed by adapter_id: two tenants sending the SAME
+    prompt must not share pages (their K/V differ under their adapters),
+    and the multi-tenant serve stays bitwise with reuse on."""
+    import tempfile
+
+    from accelerate_tpu.serving import AdapterStore
+    from accelerate_tpu.utils.dataclasses import LoraPlugin
+
+    model, params = tiny_model
+    trace = synthesize_trace(
+        11, 10, vocab_size=256, mean_interarrival_steps=1.0,
+        prompt_len_range=(4, 10), new_tokens_range=(3, 6), adapters=2,
+        prefix_share=0.9, shared_prefix_len=9,
+    )
+    res = {}
+    for mode in ("off", "on"):
+        with tempfile.TemporaryDirectory() as d:
+            store = AdapterStore(
+                params, LoraPlugin(rank=4, pool_slots=2, kernel="native"),
+                dtype=model.config.dtype, offload_dir=d,
+            )
+            for t in (1, 2):
+                store.publish_random(t, jax.random.PRNGKey(1000 + t))
+            eng = ServingEngine(model, params, _plugin(prefix_cache=mode),
+                                GenerationConfig(max_new_tokens=MAX_NEW),
+                                adapters=store)
+            rep = replay(eng, trace, verify_invariants=True)
+            res[mode] = rep
+            _assert_clean(eng)
+    assert res["on"]["results"] == res["off"]["results"]
+    # cross-tenant isolation: the same preamble under different tenants
+    # hashes to different chains, so any page every tenant hit is its own
+    pc = PrefixCache(4)
+    pre = trace[0].prompt[:8]
+    assert pc.block_hashes(pre, 1) != pc.block_hashes(pre, 2)
+
+
+def test_chaos_prefix_fault_interplay(tiny_model):
+    """The chaos soak extended with the ``prefix`` fault (an index flush
+    mid-traffic) interleaved with cancel + deadline storms: survivors'
+    tokens BITWISE equal a fault-free replay of the same surviving set,
+    zero post-warmup compiles, refcounted invariants green after every
+    engine life."""
+    model, params = tiny_model
+    plug = _plugin(prefix_cache="on")
+    gc = GenerationConfig(max_new_tokens=MAX_NEW)
+    trace = _shared_trace(9, 10, new=(4, 8))
+    engines = []
+
+    def factory():
+        eng = ServingEngine(model, params, plug, gc)
+        engines.append(eng)
+        return eng
+
+    plan = FaultPlan([
+        FaultEvent("prefix", at=6),
+        FaultEvent("cancel", at=12),
+        FaultEvent("prefix", at=18),
+    ])
+    rep = chaos_replay(factory, trace, plan)
+    assert rep["token_parity"]
+    assert rep["compiles_measured"] == 0
+    assert not rep["invariant_problems"]
+    assert rep["completed"] > 0
+    flushes = [e for eng in engines for e in eng.sched.events
+               if e[0] == "prefix_flush"]
+    assert flushes, "the prefix fault never flushed the index"
+
+
+def test_invariant_checker_detects_planted_refcount_corruption(tiny_model):
+    """The refcount-aware checker flags exactly the corruption a refcount
+    bug causes: a referenced page on the free stack (double-free), a
+    phantom refcount, and a diverged shared-prefix mirror."""
+    eng = _engine(tiny_model, prefix_cache="on")
+    trace = _shared_trace(5, 6)
+    replay(eng, trace, verify_invariants=True)
+    # plant 1: a still-referenced page pushed onto the device free stack
+    eng.prefix.ref_pages([3])
+    problems = verify_serving_invariants(eng)
+    assert any("refcount" in p or "double-free" in p or "conservation" in p
+               for p in problems), problems
+    eng.prefix.unref_pages([3])
+    eng.prefix.pending_free.clear()
+    _assert_clean(eng)
+    # plant 2: an undrained pending push across the tick boundary
+    eng.prefix.pending_free.append(99)
+    problems = verify_serving_invariants(eng)
+    assert any("pending_free" in p for p in problems), problems
+    eng.prefix.pending_free.clear()
+
+
+def test_replay_report_prefix_fields_zeros_clean_and_twin(tiny_model):
+    """The idle contract: every prefix field present and zero with the
+    cache off; with it on, the scheduler-replay predicted twin agrees
+    with the measured hit rate within its registered tolerance (it models
+    concurrency and reclaim exactly — on a clean replay they are equal)."""
+    eng = _engine(tiny_model)  # prefix off
+    rep = replay(eng, [])
+    for k in ("prefix_hit_rate", "prefix_hit_rate_predicted",
+              "pages_shared_peak", "cow_forks", "prefill_tokens_skipped",
+              "prefix_evictions", "page_transfers", "page_transfer_bytes"):
+        assert rep[k] == 0, (k, rep[k])
+    assert rep["prefix_cache"] == "off"
+    eng = _engine(tiny_model, prefix_cache="on")
+    trace = _shared_trace(3, 10)
+    rep = replay(eng, trace)
+    assert rep["prefix_cache"] == "on"
+    assert rep["prefix_hit_rate"] > 0
+    twin = twin_registry().get("prefix_cache.hit_rate")
+    assert twin is not None and twin.rel_err <= twin.tolerance, twin.row()
+    assert rep["cow_forks"] >= 0 and rep["ttft_p50_ticks"] > 0
+
+
+def test_scheduler_determinism_includes_prefix_events(tiny_model):
+    """Same seed → identical decision log, prefix_hit / cow_fork /
+    prefix_evict events included (the determinism contract extends to the
+    sharing machinery)."""
+    trace = _shared_trace(13, 10, new=(4, 10))
+    logs = []
+    for _ in range(2):
+        eng = _engine(tiny_model, prefix_cache="on")
+        replay(eng, trace)
+        logs.append(list(eng.sched.events))
+    assert logs[0] == logs[1]
+    kinds = {e[0] for e in logs[0]}
+    assert "prefix_hit" in kinds
+
+
+def test_ttft_improves_with_reuse_on_shared_trace(tiny_model):
+    """The deterministic TTFT comparison (virtual ticks): reuse skips the
+    shared region's prefill, so time-to-first-token on the seeded shared
+    trace must not regress — and real prefill work must be saved."""
+    trace = _shared_trace(7, 12, new=(4, 10))
+    ticks = {}
+    steps = {}
+    for mode in ("off", "on"):
+        eng = _engine(tiny_model, prefix_cache=mode)
+        rep = replay(eng, trace)
+        ticks[mode] = rep["ttft_p50_ticks"]
+        steps[mode] = rep["engine_steps"]
+    assert ticks["on"] <= ticks["off"]
+    assert steps["on"] < steps["off"]  # skipped chunks = fewer engine ticks
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill→decode
+# ---------------------------------------------------------------------------
+
+
+def test_disaggregated_pair_bitwise_and_transfer_twin(tiny_model):
+    """The handoff slice: pair tokens BITWISE equal the fused engine's,
+    page_transfer_bytes exactly matches the dcn accounting model, zero
+    post-warmup compiles on either engine, invariants green on both."""
+    model, params = tiny_model
+    gc = GenerationConfig(max_new_tokens=MAX_NEW)
+    trace = _shared_trace(15, 8, new=(3, 8))
+    fused = _engine(tiny_model)
+    fused_results = replay(fused, trace)["results"]
+    pair = DisaggregatedPair(model, params, _plugin(), gc)
+    pair.warmup()
+    out = pair.run(trace)
+    assert out == fused_results
+    rep = pair.report()
+    assert rep["compiles_prefill"] == 0 and rep["compiles_decode"] == 0
+    acc = transfer_accounting(
+        model.config, trace, 4,
+        dtype_bytes=jnp.dtype(model.config.dtype).itemsize,
+    )
+    assert rep["page_transfer_bytes"] == acc["page_transfer_bytes"] > 0
+    twin = twin_registry().get("transfer.page_bytes")
+    assert twin.rel_err == 0.0, twin.row()
+    _assert_clean(pair.prefill_engine)
+    _assert_clean(pair.decode_engine)
+    # the decode engine's metrics carry the wire bytes for the report
+    assert pair.decode_engine.metrics["page_transfer_bytes"] == \
+        rep["page_transfer_bytes"]
+
+
+def test_disaggregated_pair_composes_with_prefix_cache(tiny_model):
+    """Prefix reuse on the prefill engine: the transferred pages are the
+    CACHED bytes — parity must hold end to end."""
+    model, params = tiny_model
+    gc = GenerationConfig(max_new_tokens=MAX_NEW)
+    trace = _shared_trace(15, 8, new=(3, 8))
+    fused = _engine(tiny_model)
+    fused_results = replay(fused, trace)["results"]
+    pair = DisaggregatedPair(model, params, _plugin(prefix_cache="on"), gc)
+    pair.warmup()
+    assert pair.run(trace) == fused_results
+    assert pair.prefill_engine.prefix.stats["prefill_tokens_skipped"] > 0
+    _assert_clean(pair.prefill_engine)
+    _assert_clean(pair.decode_engine)
+
+
+def test_pair_immune_to_default_deadline(tiny_model):
+    """``submit()`` re-stamps ``default_deadline_ticks`` onto any request
+    carrying 0 — the pair must disarm the DEFAULT too, or an env/plugin
+    deadline silently cancels prefills mid-hold and run() returns an
+    incomplete results dict (review regression)."""
+    model, params = tiny_model
+    gc = GenerationConfig(max_new_tokens=MAX_NEW)
+    trace = _shared_trace(15, 6, new=(3, 8))
+    fused = _engine(tiny_model)
+    fused_results = replay(fused, trace)["results"]
+    pair = DisaggregatedPair(model, params,
+                             _plugin(default_deadline_ticks=2), gc)
+    pair.warmup()
+    out = pair.run(trace)
+    assert set(out) == {r.uid for r in trace}
+    assert out == fused_results
+
+
+def test_held_finished_slot_never_evicted_or_cancelled(tiny_model):
+    """A hold_finished (prefill-role) engine parks finished sequences with
+    their pages intact until the KV transfer: page pressure, deadline
+    sweeps and cancels must all pass over a held slot (review regression —
+    evicting one requeues an already-finished request and orphans the
+    held-slot bookkeeping)."""
+    model, params = tiny_model
+    rng = np.random.default_rng(23)
+    eng = ServingEngine(model, params, _plugin(),
+                        GenerationConfig(max_new_tokens=MAX_NEW),
+                        hold_finished=True)
+    eng.warmup()
+    # three 21-token prompts hold 6 pages each (18 of 24) once parked; the
+    # deadline expires AFTER they park (a mid-prefill expiry is a
+    # legitimate cancel) — the sweep must then pass over the held slots
+    for uid in range(3):
+        eng.add_request(Request(
+            uid=uid, prompt=tuple(int(x) for x in rng.integers(1, 255, 21)),
+            max_new_tokens=1, deadline_ticks=20,
+        ))
+    for _ in range(100):
+        if len(eng.held) == 3:
+            break
+        eng.step()
+    assert len(eng.held) == 3
+    held_uids = {eng.sched.slots[s].request.uid for s in eng.held}
+    # a 28-token prompt needs 7 pages; only 6 are free — page pressure with
+    # every other slot held.  The prefilling slot must cancel ITSELF rather
+    # than evict a parked sequence.
+    eng.add_request(Request(
+        uid=9, prompt=tuple(int(x) for x in rng.integers(1, 255, 28)),
+        max_new_tokens=1,
+    ))
+    for s in list(eng.held):
+        eng.cancel(eng.sched.slots[s].request.uid)  # raced finishes: no-ops
+    for _ in range(50):
+        # keep stepping past tick 20 so the deadline sweep runs against
+        # the (expired) held slots too
+        if eng.steps > 25 and (9 in eng.sched.retired_uids
+                               or 9 in eng.results):
+            break
+        eng.step()
+    assert len(eng.held) == 3
+    assert {eng.sched.slots[s].request.uid for s in eng.held} == held_uids
+    assert all(e[1] not in held_uids for e in eng.sched.events
+               if e[0] == "evict")
+    _assert_clean(eng)
+    for s in list(eng.held):
+        eng.release_held(s)
+    assert not eng.held and 9 not in eng.sched.slots
+    _assert_clean(eng)
+
+
+# ---------------------------------------------------------------------------
+# plugin / env plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_serving_plugin_prefix_knob(monkeypatch):
+    assert ServingPlugin().prefix_cache == "off"
+    assert ServingPlugin(prefix_cache=True).prefix_cache == "on"
+    assert ServingPlugin(prefix_cache="1").prefix_cache == "on"
+    monkeypatch.setenv("ACCELERATE_SERVE_PREFIX_CACHE", "on")
+    assert ServingPlugin().prefix_cache == "on"
+    assert ServingPlugin(prefix_cache=False).prefix_cache == "off"
+    with pytest.raises(ValueError):
+        ServingPlugin(prefix_cache="sideways")

@@ -238,6 +238,39 @@ def test_all_standard_twins_register_from_their_accounting_sites():
     _overload_fields(_OverloadEng(),
                      [Request(uid=0, prompt=(1,), max_new_tokens=2)])
 
+    # 15-17. prefix cache hit rate (serving/harness._prefix_fields), the
+    # bench ttft with/without-reuse baseline, and the disaggregation
+    # transfer accounting (serving/transfer)
+    from accelerate_tpu.serving.harness import _prefix_fields
+    from accelerate_tpu.serving.prefix_cache import PrefixCache
+    from accelerate_tpu.serving.transfer import transfer_accounting
+
+    class _PrefixPlugin:
+        num_slots, num_pages, page_size = 2, 8, 4
+        pages_per_slot, prefill_chunk = 4, 4
+
+    class _PrefixEng:
+        metrics = {"page_transfers": 0, "page_transfer_pages": 0,
+                   "page_transfer_bytes": 0}
+        prefix = PrefixCache(4)
+        plugin = _PrefixPlugin()
+
+    _prefix_fields(_PrefixEng(),
+                   [Request(uid=0, prompt=(1, 2, 3, 4, 5), max_new_tokens=2)])
+    # the bench --prefix-share baseline records the ttft pair; the
+    # transport records the measured transfer bytes — stand in for both
+    reg.record("prefix_cache.ttft_ticks", predicted=4.0, measured=3.0,
+               source="bench.serve prefix baseline")
+
+    class _Cfg:
+        num_hidden_layers, num_key_value_heads, head_dim = 2, 2, 4
+
+    transfer_accounting(
+        _Cfg(), [Request(uid=0, prompt=(1, 2, 3, 4, 5), max_new_tokens=2)], 4
+    )
+    reg.record_measured("transfer.page_bytes", 256,
+                        source="serving/transfer.PagedKVTransport")
+
     rows = reg.drift_report()
     for name in STANDARD_TWINS:
         assert name in rows, name
