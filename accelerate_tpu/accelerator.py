@@ -68,6 +68,7 @@ from .utils.dataclasses import (
     AutocastKwargs,
     ContextParallelConfig,
     DataLoaderConfiguration,
+    FP8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     GradSyncKwargs,
@@ -118,6 +119,11 @@ if _HAS_FLAX:
         # scalars, resilience/guard.py) — carried in the state so they
         # survive checkpoint/resume; None unless ResiliencePlugin.nan_guard
         guard_state: Any = None
+        # fp8 delayed-scaling metas (per-kernel amax history + scale,
+        # ops/fp8.py) — None unless mixed_precision="fp8" arms the delayed
+        # recipe; rides the state comm_state-style (checkpointed, updated
+        # functionally by the jitted step)
+        fp8_state: Any = None
         apply_fn: Callable = flax.struct.field(pytree_node=False, default=None)
         tx: Any = flax.struct.field(pytree_node=False, default=None)
         # .replace(**kwargs) is provided by flax.struct.dataclass
@@ -280,6 +286,7 @@ class Accelerator:
         self.grad_sync_kwargs = GradSyncKwargs()
         self.init_process_group_kwargs: Optional[InitProcessGroupKwargs] = None
         self.profile_kwargs = ProfileKwargs()
+        self.fp8_recipe: Optional[FP8RecipeKwargs] = None
         for handler in kwargs_handlers or []:
             if isinstance(handler, AutocastKwargs):
                 self.autocast_handler = handler
@@ -289,6 +296,8 @@ class Accelerator:
                 self.init_process_group_kwargs = handler
             elif isinstance(handler, ProfileKwargs):
                 self.profile_kwargs = handler
+            elif isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe = handler
 
         state_kwargs = {}
         if self.init_process_group_kwargs is not None:
@@ -868,6 +877,25 @@ class Accelerator:
                 qs = jax.tree_util.tree_map(lambda q: jax.device_put(q, rep), qs)
                 errs = jax.tree_util.tree_map(lambda e: jax.device_put(e, err_sh), errs)
             comm_state = (qs, errs)
+        fp8_state = None
+        if str(self.mixed_precision) == "fp8":
+            from .ops.fp8 import fp8_delayed_enabled, init_fp8_state
+
+            if fp8_delayed_enabled():
+                recipe = self.fp8_recipe
+                fp8_state = init_fp8_state(
+                    params,
+                    history_len=recipe.amax_history_len if recipe else None,
+                    margin=recipe.margin if recipe else None,
+                )
+                if fp8_state is not None and sharded:
+                    # metas are tiny (history vector + scalar scale) —
+                    # replicate them onto the mesh's device set so the
+                    # jitted step sees one device set end-to-end
+                    rep = NamedSharding(self.mesh, PartitionSpec())
+                    fp8_state = jax.tree_util.tree_map(
+                        jax.jit(lambda x: x, out_shardings=rep), fp8_state
+                    )
         state = TrainState(
             step=jnp.int32(0),
             params=params,
@@ -880,6 +908,7 @@ class Accelerator:
             guard_state=(
                 _guard.init_guard_state() if self.resilience_plugin.nan_guard else None
             ),
+            fp8_state=fp8_state,
             apply_fn=apply_fn,
             tx=tx,
         )
@@ -1035,7 +1064,7 @@ class Accelerator:
                     f"mixed_precision={self.mixed_precision!r}"
                 )
 
-        def compute_grads(params, batch, rng, loss_scale):
+        def compute_grads(params, batch, rng, loss_scale, fp8_state=None):
             if compute_width_grads:
                 # differentiate wrt the compute-width copy: every grad leaf is
                 # born bf16 and the fp32 grad tree never exists in HBM — the
@@ -1045,6 +1074,17 @@ class Accelerator:
             def scaled_loss(p, mb):
                 if not compute_width_grads:
                     p = policy.cast_to_compute(p)
+                if use_fp8 and fp8_state is not None \
+                        and isinstance(p, dict) and "params" in p:
+                    # delayed scaling: the meta tree rides into the trace as
+                    # the read-only "fp8" collection (ops/fp8.py) — flax
+                    # apply ignores extra collections, so the user loss_fn
+                    # signature is untouched.  Bare param trees (no variables
+                    # wrapper) can't carry a collection and simply stay on
+                    # current scaling.
+                    from .ops.fp8 import merge_fp8_collection
+
+                    p = merge_fp8_collection(p, fp8_state)
                 mb_args = (p, mb, rng) if wants_rng else (p, mb)
                 if use_fp8:
                     # trace the model under the fp8 region: QuantizableDense
@@ -1335,12 +1375,21 @@ class Accelerator:
                     metrics = _guard.guard_metrics(metrics, finite, new_guard_state)
                 else:
                     metrics["nan_skipped"] = jnp.logical_not(finite)
+            new_fp8_state = state.fp8_state
+            if new_fp8_state is not None:
+                # delayed-scaling tick: the history rolls against the
+                # POST-update kernels, so the scale used at step t+1 was
+                # derived from amaxes observed through step t (TE contract)
+                from .ops.fp8 import update_fp8_state
+
+                new_fp8_state = update_fp8_state(new_fp8_state, new_params)
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,
                 opt_state=new_opt,
                 loss_scale=new_scale,
                 guard_state=new_guard_state,
+                fp8_state=new_fp8_state,
             )
             return new_state, metrics
 
@@ -1587,7 +1636,8 @@ class Accelerator:
 
                 def microbatch(carry, mb):
                     grads_acc, loss_acc, _prev_aux = carry
-                    loss, aux, grads = compute_grads(params_c, mb, use_rng, state.loss_scale)
+                    loss, aux, grads = compute_grads(params_c, mb, use_rng, state.loss_scale,
+                                                      state.fp8_state)
                     # the carry accumulates in fp32 regardless of the grad
                     # wire dtype: summing accum_steps microbatches in bf16
                     # would lose ~log2(accum_steps) mantissa bits
@@ -1642,7 +1692,9 @@ class Accelerator:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)
-                loss, aux, grads = compute_grads(fetch_params(state.params), batch, use_rng, state.loss_scale)
+                loss, aux, grads = compute_grads(
+                    fetch_params(state.params), batch, use_rng,
+                    state.loss_scale, state.fp8_state)
                 grad_accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
                 accum_step = state.accum_step + 1
                 is_boundary = accum_step >= accum_steps
@@ -1674,7 +1726,9 @@ class Accelerator:
 
             def step_fn(state: TrainState, batch):
                 rng, use_rng = jax.random.split(state.rng)
-                loss, aux, grads = compute_grads(fetch_params(state.params), batch, use_rng, state.loss_scale)
+                loss, aux, grads = compute_grads(
+                    fetch_params(state.params), batch, use_rng,
+                    state.loss_scale, state.fp8_state)
                 new_state, metrics = apply_update(state.replace(rng=rng), grads, loss)
                 if has_aux:
                     metrics["aux"] = aux

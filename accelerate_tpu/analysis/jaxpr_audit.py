@@ -36,6 +36,10 @@ invisible to a source-level linter:
   cross-slice leg carries one redundant full-size copy per intra-slice
   device over DCN; ``parallel/hierarchical.py`` is the decomposition
   (reduce-scatter over ICI, slab all-reduce over dcn, all-gather back).
+- **GL110 unscaled fp8 dot** — a ``dot_general`` over float8 operands whose
+  result reaches downstream math with no dequantizing ``mul``/``div`` in
+  the chain: fp8 codes are meaningless without their scale, and only the
+  traced program shows whether the accumulator was rescaled before use.
 - **GL304 donated promotion drift** — a donated input whose only same-shape
   outputs differ in dtype or weak_type (a python/numpy scalar promoted the
   update): feeding the result back re-keys the jit cache every step, and
@@ -483,6 +487,97 @@ def _audit_hierarchical_reduce(closed, threshold: int) -> list[Finding]:
     return findings
 
 
+_FP8_DTYPES = ("float8_e4m3fn", "float8_e5m2", "float8_e4m3fnuz",
+               "float8_e5m2fnuz", "float8_e4m3b11fnuz")
+
+# ops that pass a dot result through without changing its VALUES — the
+# dequantizing multiply may legitimately sit on the far side of them
+_FP8_TRANSPARENT = frozenset({
+    "convert_element_type", "transpose", "reshape", "broadcast_in_dim",
+    "squeeze", "slice", "stop_gradient",
+})
+
+
+def _is_fp8_aval(aval) -> bool:
+    return str(getattr(aval, "dtype", "")) in _FP8_DTYPES
+
+
+def _audit_fp8_scaling(closed) -> list[Finding]:
+    """GL110: a ``dot_general`` with a float8 operand whose result reaches a
+    non-multiplicative consumer with no ``mul``/``div`` anywhere in the
+    chain.  fp8 codes are fixed-point residue — ``q = x * scale`` cast to
+    e4m3/e5m2 — so a correct fp8 matmul ALWAYS dequantizes its accumulator
+    (``out * (1 / (x_scale * w_scale))``, the ops/fp8.py contract) before
+    downstream math sees it.  The chain is followed through value-preserving
+    ops (convert/transpose/reshape/...); a result that escapes its scope
+    stays quiet (conservative, the GL106 discipline) since the consumer is
+    not visible here."""
+    findings = []
+
+    def scan(jaxpr):
+        consumers: dict[int, list] = {}
+        fp8_dots = []
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                if not isinstance(v, jax.core.Literal):
+                    consumers.setdefault(id(v), []).append(eqn)
+            if eqn.primitive.name == "dot_general" and any(
+                _is_fp8_aval(v.aval) for v in eqn.invars
+                if not isinstance(v, jax.core.Literal)
+            ):
+                fp8_dots.append(eqn)
+            for sub in _sub_jaxprs(eqn):
+                scan(sub.jaxpr)
+        escaped = {id(v) for v in jaxpr.outvars
+                   if not isinstance(v, jax.core.Literal)}
+
+        def chain_is_scaled(var, depth=0) -> Optional[bool]:
+            """True: a mul/div consumes the value (possibly through
+            transparent ops).  False: a value-consuming primitive reads it
+            unscaled.  None: undecidable (escapes scope / no consumers) —
+            stays quiet."""
+            if id(var) in escaped or depth > 16:
+                return None
+            cons = consumers.get(id(var), [])
+            if not cons:
+                return None
+            verdicts = []
+            for c in cons:
+                if c.primitive.name in ("mul", "div"):
+                    verdicts.append(True)
+                elif c.primitive.name in _FP8_TRANSPARENT:
+                    verdicts.append(chain_is_scaled(c.outvars[0], depth + 1))
+                else:
+                    verdicts.append(False)
+            if any(v is False for v in verdicts):
+                return False  # at least one consumer reads raw codes
+            if any(v is None for v in verdicts):
+                return None
+            return True
+
+        for d in fp8_dots:
+            if chain_is_scaled(d.outvars[0]) is not False:
+                continue
+            path, line = _eqn_location(d)
+            dts = "x".join(
+                str(getattr(v.aval, "dtype", "?")) for v in d.invars
+                if not isinstance(v, jax.core.Literal)
+            )
+            findings.append(
+                _finding(
+                    "GL110",
+                    f"dot_general over fp8 operands ({dts}) feeds a "
+                    "non-multiplicative consumer with no dequantizing "
+                    "mul/div in the chain: downstream math runs on raw fp8 "
+                    "codes, off by the combined scale factor",
+                    path=path, line=line,
+                )
+            )
+
+    scan(closed.jaxpr)
+    return findings
+
+
 def _audit_output_sharding(jaxpr, threshold: int, path_hint) -> list[Finding]:
     """GL105: large outputs whose producing equation is not a sharding pin."""
     producer = {}
@@ -565,6 +660,7 @@ def audit_traced(
     findings += _audit_transfers(closed.jaxpr, default_memory_kind)
     findings += _audit_key_reuse(closed)
     findings += _audit_collective_matmul(closed)
+    findings += _audit_fp8_scaling(closed)
     findings += _audit_hierarchical_reduce(closed, dcn_reduce_bytes_threshold)
     findings += _audit_output_sharding(closed.jaxpr, output_bytes_threshold, path_hint)
     return Report(apply_suppressions(findings))

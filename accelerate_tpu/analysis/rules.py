@@ -135,6 +135,19 @@ RULES: dict[str, Rule] = {
             "timed-loop idiom",
         ),
         Rule(
+            "GL110", "unscaled-fp8-dot", Severity.ERROR, "jaxpr",
+            "a dot_general with a float8 operand whose result is consumed "
+            "with no dequantizing multiply/divide in the chain: fp8 CODES "
+            "are only meaningful next to their scale, so the downstream "
+            "math silently runs on values off by the (x_scale * w_scale) "
+            "factor — the loss still goes down, just slower, which is why "
+            "nothing else catches it",
+            "multiply the dot result by the combined inverse scale before "
+            "anything else consumes it (ops/fp8.fp8_delayed_dot / "
+            "fp8_current_scaled_dot are the model), or route the layer "
+            "through QuantizableDense with mixed_precision='fp8'",
+        ),
+        Rule(
             "GL105", "unsharded-output", Severity.WARNING, "jaxpr",
             "a large output with no sharding constraint on its producer: "
             "GSPMD may resolve it fully replicated, costing a full copy of "

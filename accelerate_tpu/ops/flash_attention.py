@@ -551,6 +551,41 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 # ---------------------------------------------------------------------------
 
 
+def _paged_tile_update(scores, v, row_pos, kv_start, m_scratch, l_scratch,
+                       acc_scratch):
+    """One online-softmax update shared by every paged kernel: mask the
+    page's kv indices against per-row positions, rescale the running
+    max/sum/accumulator.  ``scores``: [rows, page_size] f32 (pre-scaled);
+    ``v``: [page_size, D] f32; ``row_pos``: [rows, 1] int32."""
+    idx = kv_start + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(idx <= row_pos, scores, DEFAULT_MASK_VALUE)
+    m_prev = m_scratch[:]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+    p = jnp.exp(scores - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_scratch[:] = alpha * l_scratch[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    m_scratch[:] = m_new
+
+
+def _paged_finalize(o_ref, l_scratch, acc_scratch):
+    l = l_scratch[:]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_scratch[:] / safe_l).astype(o_ref.dtype)
+
+
+def _dequant_tile(tile_ref, scale_ref, kv_qmax):
+    """In-kernel page dequant: codes stream HBM->VMEM at one byte per
+    element and widen in-tile (``codes * amax / QMAX``) — the full-width
+    page never exists in HBM."""
+    t = tile_ref[0, 0].astype(jnp.float32)
+    if scale_ref is not None:
+        t = t * (scale_ref[0, 0] / kv_qmax)
+    return t
+
+
 def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
                          m_scratch, l_scratch, acc_scratch,
                          *, page_size, sm_scale):
@@ -561,6 +596,25 @@ def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
     BlockSpec index_map already routed the right physical page into VMEM —
     this body only sees a contiguous ``[page_size, D]`` tile).  Online
     softmax accumulates across pages exactly like the dense flash kernel."""
+    _paged_decode_body(bt_ref, pos_ref, q_ref, k_ref, v_ref, None, None,
+                       o_ref, m_scratch, l_scratch, acc_scratch,
+                       page_size=page_size, sm_scale=sm_scale, kv_qmax=None)
+
+
+def _paged_decode_kernel_quant(bt_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                               vs_ref, o_ref, m_scratch, l_scratch,
+                               acc_scratch, *, page_size, sm_scale, kv_qmax):
+    """Quantized-page variant: the per-(kv-head, page) scale rides as its
+    own scalar-sized block (same block-table index map as the page) and the
+    codes dequantize in-tile."""
+    _paged_decode_body(bt_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                       o_ref, m_scratch, l_scratch, acc_scratch,
+                       page_size=page_size, sm_scale=sm_scale, kv_qmax=kv_qmax)
+
+
+def _paged_decode_body(bt_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                       o_ref, m_scratch, l_scratch, acc_scratch,
+                       *, page_size, sm_scale, kv_qmax):
     s = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -575,30 +629,47 @@ def _paged_decode_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(kv_start <= pos)
     def _compute():
-        q = q_ref[0, 0]  # [group, D]
-        k = k_ref[0, 0]  # [page_size, D]
-        v = v_ref[0, 0]
+        q = q_ref[0, 0].astype(jnp.float32)      # [group, D]
+        k = _dequant_tile(k_ref, ks_ref, kv_qmax)  # [page_size, D]
+        v = _dequant_tile(v_ref, vs_ref, kv_qmax)
         scores = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [group, page_size]
-        idx = kv_start + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(idx <= pos, scores, DEFAULT_MASK_VALUE)
-        m_prev = m_scratch[:]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
-        p = jnp.exp(scores - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scratch[:] = alpha * l_scratch[:] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scratch[:] = acc_scratch[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scratch[:] = m_new
+        _paged_tile_update(scores, v, pos, kv_start, m_scratch, l_scratch,
+                           acc_scratch)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _finalize():
-        l = l_scratch[:]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_scratch[:] / safe_l).astype(o_ref.dtype)
+        _paged_finalize(o_ref, l_scratch, acc_scratch)
+
+
+# Max code magnitude per quantized page dtype (mirrors
+# models/llama.py:KV_QUANT_QMAX): symmetric int8 uses the full [-127, 127]
+# band; fp8 pages store e4m3 codes whose saturation point is 448.
+_KV_QMAX = {"int8": 127.0, "float8_e4m3fn": 448.0}
+
+
+def _kv_qmax_for(pages) -> float:
+    name = jnp.dtype(pages.dtype).name
+    if name not in _KV_QMAX:
+        raise ValueError(
+            f"quantized KV pages must be int8 or float8_e4m3fn, got {name}"
+        )
+    return _KV_QMAX[name]
+
+
+def _page_specs(page_size, d, n, quantized):
+    """K/V page BlockSpecs (+ per-page scale specs when quantized), all
+    routed through the scalar-prefetched block table."""
+    page = lambda s, h, j, bt, *_: (h, bt[s * n + j], 0, 0)
+    scale = lambda s, h, j, bt, *_: (h, bt[s * n + j])
+    specs = [
+        pl.BlockSpec((1, 1, page_size, d), page),
+        pl.BlockSpec((1, 1, page_size, d), page),
+    ]
+    if quantized:
+        specs += [pl.BlockSpec((1, 1), scale), pl.BlockSpec((1, 1), scale)]
+    return specs
 
 
 def paged_decode_attention(
@@ -608,6 +679,8 @@ def paged_decode_attention(
     block_tables,
     positions,
     *,
+    k_scales=None,
+    v_scales=None,
     sm_scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ):
@@ -627,14 +700,16 @@ def paged_decode_attention(
     without repeating K/V, like :func:`flash_attention`.  Returns
     ``[S, H, D]``.
 
-    T = 1 only by design: the speculative verify pass (``[S, k+1]`` — the
-    multi-token draft-and-verify window) takes the native ragged path
-    (``paged_gather_kv`` + ``cached_attention``), which is bitwise-exact to
-    the dense cache — the property the greedy-prefix acceptance pin rests
-    on.  A multi-token Pallas verify kernel would need the same
-    block-tables-as-scalar-prefetch treatment with a ``k+1``-wide query
-    tile; measure on a chip before writing it — at small k the verify op
-    stays HBM-bound on the page reads, exactly like decode.
+    **Quantized pages** (``serving/paged_cache.py`` int8/fp8 pools): pass
+    the per-(kv-head, page) amax arrays ``k_scales``/``v_scales``
+    (``[Hkv, P]`` f32).  Each page's scale rides as its own block through
+    the same block-table index map and the codes dequantize in-tile
+    (``codes * amax / QMAX``) — decode reads half the KV bytes of bf16 and
+    the full-width page never exists in HBM.
+
+    Multi-token windows (speculative verify's ``[S, k+1]``, chunked
+    prefill) go through :func:`paged_multitoken_attention` — same grid
+    family, ``k+1``-wide query tile.
     """
     s_slots, h, d = q.shape
     hkv, num_pages, page_size, _ = k_pages.shape
@@ -648,6 +723,7 @@ def paged_decode_attention(
         interpret = not _on_tpu()
     if not _HAS_PLTPU:  # pragma: no cover
         raise RuntimeError("pallas tpu backend unavailable")
+    quantized = k_scales is not None
 
     qg = q.reshape(s_slots, hkv, group, d)
     bt_flat = block_tables.reshape(-1).astype(jnp.int32)
@@ -658,8 +734,7 @@ def paged_decode_attention(
         grid=(s_slots, hkv, n),
         in_specs=[
             pl.BlockSpec((1, 1, group, d), lambda s, h, j, bt, p: (s, h, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), lambda s, h, j, bt, p: (h, bt[s * n + j], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), lambda s, h, j, bt, p: (h, bt[s * n + j], 0, 0)),
+            *_page_specs(page_size, d, n, quantized),
         ],
         out_specs=pl.BlockSpec((1, 1, group, d), lambda s, h, j, bt, p: (s, h, 0, 0)),
         scratch_shapes=[
@@ -668,15 +743,392 @@ def paged_decode_attention(
             pltpu.VMEM((group, d), jnp.float32),
         ],
     )
+    if quantized:
+        kernel = functools.partial(
+            _paged_decode_kernel_quant, page_size=page_size,
+            sm_scale=sm_scale, kv_qmax=_kv_qmax_for(k_pages),
+        )
+        operands = (bt_flat, pos, qg, k_pages, v_pages,
+                    k_scales.astype(jnp.float32), v_scales.astype(jnp.float32))
+    else:
+        kernel = functools.partial(
+            _paged_decode_kernel, page_size=page_size, sm_scale=sm_scale
+        )
+        operands = (bt_flat, pos, qg, k_pages, v_pages)
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, page_size=page_size, sm_scale=sm_scale),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((s_slots, hkv, group, d), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(bt_flat, pos, qg, k_pages, v_pages)
+    )(*operands)
+    return out.reshape(s_slots, h, d)
+
+
+def _paged_multitoken_body(bt_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                           vs_ref, o_ref, m_scratch, l_scratch, acc_scratch,
+                           *, page_size, sm_scale, group, width, kv_qmax):
+    """Grid: (slots, kv_heads, pages_per_slot).  The query tile is the
+    slot's whole ``[width * group, D]`` window (``width`` contiguous
+    tokens x the GQA group, token-major rows); each row masks kv indices
+    against its own live position ``pos0 + row // group``."""
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    pos0 = pos_ref[s]
+    kv_start = j * page_size
+
+    # pages past the window's LAST row are dead for every row; pages in
+    # between are handled by the per-row mask below
+    @pl.when(kv_start <= pos0 + width - 1)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)        # [width*group, D]
+        k = _dequant_tile(k_ref, ks_ref, kv_qmax)  # [page_size, D]
+        v = _dequant_tile(v_ref, vs_ref, kv_qmax)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [width*group, page_size]
+        rows = width * group
+        lane = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0) // group
+        _paged_tile_update(scores, v, pos0 + lane, kv_start, m_scratch,
+                           l_scratch, acc_scratch)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        _paged_finalize(o_ref, l_scratch, acc_scratch)
+
+
+def _paged_multitoken_kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                             m_scratch, l_scratch, acc_scratch,
+                             *, page_size, sm_scale, group, width):
+    _paged_multitoken_body(bt_ref, pos_ref, q_ref, k_ref, v_ref, None, None,
+                           o_ref, m_scratch, l_scratch, acc_scratch,
+                           page_size=page_size, sm_scale=sm_scale,
+                           group=group, width=width, kv_qmax=None)
+
+
+def _paged_multitoken_kernel_quant(bt_ref, pos_ref, q_ref, k_ref, v_ref,
+                                   ks_ref, vs_ref, o_ref, m_scratch,
+                                   l_scratch, acc_scratch,
+                                   *, page_size, sm_scale, group, width,
+                                   kv_qmax):
+    _paged_multitoken_body(bt_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref,
+                           vs_ref, o_ref, m_scratch, l_scratch, acc_scratch,
+                           page_size=page_size, sm_scale=sm_scale,
+                           group=group, width=width, kv_qmax=kv_qmax)
+
+
+def paged_multitoken_attention(
+    q,
+    k_pages,
+    v_pages,
+    block_tables,
+    positions,
+    *,
+    k_scales=None,
+    v_scales=None,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """Multi-token paged attention: the Pallas verify/chunked-prefill kernel.
+
+    Same block-tables-as-scalar-prefetch grid as
+    :func:`paged_decode_attention`, with a ``T``-token query tile per slot:
+    the speculative verify window (``T = k+1`` — draft + bonus token) and
+    fixed-chunk prefill both attend ``T`` contiguous tokens per slot
+    against that slot's paged K/V.  The query tile is ``[T * group, D]``
+    (token-major rows); each row causal-masks against its own position
+    ``positions[s, 0] + token_index``, and whole pages beyond the window's
+    last row are skipped by predication, so at small ``T`` the op stays
+    HBM-bound on the same page reads as decode.
+
+    q: ``[S, T, H, D]``; positions: ``[S, T]`` int32 — **contiguous per
+    row** (``positions[s, i] == positions[s, 0] + i``), which both the
+    verify and prefill callers guarantee by construction; only column 0 is
+    read.  Quantized pools pass ``k_scales``/``v_scales`` ``[Hkv, P]``
+    exactly as in decode.  Returns ``[S, T, H, D]``.
+    """
+    s_slots, width, h, d = q.shape
+    hkv, num_pages, page_size, _ = k_pages.shape
+    if h % hkv != 0:
+        raise ValueError(f"num q heads {h} not divisible by kv heads {hkv}")
+    group = h // hkv
+    n = block_tables.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("pallas tpu backend unavailable")
+    quantized = k_scales is not None
+
+    # [S, T, Hkv, group, D] -> [S, Hkv, T*group, D]: token-major rows so
+    # row // group recovers the token lane in-kernel
+    qg = (
+        q.reshape(s_slots, width, hkv, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(s_slots, hkv, width * group, d)
+    )
+    bt_flat = block_tables.reshape(-1).astype(jnp.int32)
+    pos0 = positions[:, 0].astype(jnp.int32)
+    rows = width * group
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_slots, hkv, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, rows, d), lambda s, h, j, bt, p: (s, h, 0, 0)),
+            *_page_specs(page_size, d, n, quantized),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rows, d), lambda s, h, j, bt, p: (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+        ],
+    )
+    if quantized:
+        kernel = functools.partial(
+            _paged_multitoken_kernel_quant, page_size=page_size,
+            sm_scale=sm_scale, group=group, width=width,
+            kv_qmax=_kv_qmax_for(k_pages),
+        )
+        operands = (bt_flat, pos0, qg, k_pages, v_pages,
+                    k_scales.astype(jnp.float32), v_scales.astype(jnp.float32))
+    else:
+        kernel = functools.partial(
+            _paged_multitoken_kernel, page_size=page_size,
+            sm_scale=sm_scale, group=group, width=width,
+        )
+        operands = (bt_flat, pos0, qg, k_pages, v_pages)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, hkv, rows, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*operands)
+    return (
+        out.reshape(s_slots, hkv, width, group, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(s_slots, width, h, d)
+    )
+
+
+def _fused_bgmv_decode_body(bt_ref, pos_ref, ids_ref, q_ref, x_ref, a_ref,
+                            b_ref, cos_ref, sin_ref, k_ref, v_ref, ks_ref,
+                            vs_ref, o_ref, q_scratch, m_scratch, l_scratch,
+                            acc_scratch, *, page_size, sm_scale, group,
+                            kv_qmax):
+    """Grid: (slots, kv_heads, pages_per_slot).  At ``j == 0`` the slot's
+    LoRA query delta for THIS kv-head's group — ``(x @ A[ids]) @ B[ids]``,
+    roped in-kernel at the slot's position — lands in ``q_scratch`` on top
+    of the pre-roped base query; the page loop then attends out of scratch.
+    Rope is linear, so ``rope(base + delta) == rope(base) + rope(delta)``
+    and adding the in-kernel-roped delta to the already-roped base is
+    exact.  Id-0 rows gate the delta to zero (the ``lora_apply``
+    bitwise-unchanged contract), not by branching — the gather and dots run
+    unconditionally, so the step keeps one shape for any tenant mix."""
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _project():
+        m_scratch[:] = jnp.full_like(m_scratch, -jnp.inf)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+        xv = x_ref[...].astype(jnp.float32)          # [1, d_in]
+        a = a_ref[0].astype(jnp.float32)             # [d_in, r]
+        b = b_ref[0, :, 0].astype(jnp.float32)       # [r, group, D]
+        t = jax.lax.dot_general(
+            xv, a, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [1, r]
+        delta = jax.lax.dot_general(
+            t, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )[0]  # [group, D]
+        dh = delta.shape[-1] // 2
+        c = cos_ref[...]                             # [1, D/2]
+        sn = sin_ref[...]
+        d1, d2 = delta[:, :dh], delta[:, dh:]
+        delta_roped = jnp.concatenate(
+            [d1 * c - d2 * sn, d2 * c + d1 * sn], axis=1
+        )
+        gate = (ids_ref[s] != 0).astype(jnp.float32)
+        q_scratch[:] = q_ref[0, 0].astype(jnp.float32) + gate * delta_roped
+
+    pos = pos_ref[s]
+    kv_start = j * page_size
+
+    @pl.when(kv_start <= pos)
+    def _compute():
+        q = q_scratch[:]                           # [group, D]
+        k = _dequant_tile(k_ref, ks_ref, kv_qmax)  # [page_size, D]
+        v = _dequant_tile(v_ref, vs_ref, kv_qmax)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        _paged_tile_update(scores, v, pos, kv_start, m_scratch, l_scratch,
+                           acc_scratch)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finalize():
+        _paged_finalize(o_ref, l_scratch, acc_scratch)
+
+
+def _fused_bgmv_decode_kernel(bt_ref, pos_ref, ids_ref, q_ref, x_ref, a_ref,
+                              b_ref, cos_ref, sin_ref, k_ref, v_ref, o_ref,
+                              q_scratch, m_scratch, l_scratch, acc_scratch,
+                              *, page_size, sm_scale, group):
+    _fused_bgmv_decode_body(bt_ref, pos_ref, ids_ref, q_ref, x_ref, a_ref,
+                            b_ref, cos_ref, sin_ref, k_ref, v_ref, None,
+                            None, o_ref, q_scratch, m_scratch, l_scratch,
+                            acc_scratch, page_size=page_size,
+                            sm_scale=sm_scale, group=group, kv_qmax=None)
+
+
+def _fused_bgmv_decode_kernel_quant(bt_ref, pos_ref, ids_ref, q_ref, x_ref,
+                                    a_ref, b_ref, cos_ref, sin_ref, k_ref,
+                                    v_ref, ks_ref, vs_ref, o_ref, q_scratch,
+                                    m_scratch, l_scratch, acc_scratch,
+                                    *, page_size, sm_scale, group, kv_qmax):
+    _fused_bgmv_decode_body(bt_ref, pos_ref, ids_ref, q_ref, x_ref, a_ref,
+                            b_ref, cos_ref, sin_ref, k_ref, v_ref, ks_ref,
+                            vs_ref, o_ref, q_scratch, m_scratch, l_scratch,
+                            acc_scratch, page_size=page_size,
+                            sm_scale=sm_scale, group=group, kv_qmax=kv_qmax)
+
+
+def fused_bgmv_paged_decode(
+    x,
+    q_base,
+    a_stack,
+    b_stack,
+    adapter_ids,
+    cos,
+    sin,
+    k_pages,
+    v_pages,
+    block_tables,
+    positions,
+    *,
+    k_scales=None,
+    v_scales=None,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+):
+    """Fused per-tenant LoRA query projection + paged decode attention.
+
+    The tenant-mix decode step's two Pallas trips — bgmv (``ops/lora.py``)
+    for the per-slot query adapter delta, then :func:`paged_decode_attention`
+    — consolidated into one kernel: the adapter's A/B blocks are gathered
+    by the scalar-prefetched ``adapter_ids`` through BlockSpec index maps
+    (the bgmv trick), the delta is roped in-kernel at the slot's position
+    and added to the pre-roped base query in VMEM scratch, and the page
+    loop attends out of scratch.  One kernel launch, no ``[S, H, D]``
+    delta round-trip through HBM, fixed shapes for any tenant mix.
+
+    x: ``[S, d_in]`` attention input (post-norm hidden states);
+    q_base: ``[S, H, D]`` base queries, already roped; a_stack:
+    ``[N, d_in, r]``; b_stack: ``[N, r, H*D]`` (the AdapterStore pool
+    layout — row 0 is the id-0 base slot); adapter_ids: ``[S]`` int32;
+    cos/sin: ``[max_len, D/2]`` rope tables; remaining operands as in
+    :func:`paged_decode_attention`, including quantized-page
+    ``k_scales``/``v_scales``.  Returns ``[S, H, D]``.
+    """
+    s_slots, h, d = q_base.shape
+    hkv, num_pages, page_size, _ = k_pages.shape
+    if h % hkv != 0:
+        raise ValueError(f"num q heads {h} not divisible by kv heads {hkv}")
+    group = h // hkv
+    n = block_tables.shape[1]
+    d_in = x.shape[-1]
+    num_adapters, _, rank = a_stack.shape
+    if b_stack.shape != (num_adapters, rank, h * d):
+        raise ValueError(
+            f"b_stack shape {b_stack.shape} != {(num_adapters, rank, h * d)}"
+        )
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not _HAS_PLTPU:  # pragma: no cover
+        raise RuntimeError("pallas tpu backend unavailable")
+    quantized = k_scales is not None
+
+    qg = q_base.reshape(s_slots, hkv, group, d)
+    # [N, r, H*D] -> [N, r, Hkv, group, D] so each program blocks out only
+    # its kv-head group's columns
+    b5 = b_stack.reshape(num_adapters, rank, hkv, group, d)
+    bt_flat = block_tables.reshape(-1).astype(jnp.int32)
+    pos = positions.astype(jnp.int32)
+    ids = adapter_ids.astype(jnp.int32)
+    cos = jnp.asarray(cos, jnp.float32)
+    sin = jnp.asarray(sin, jnp.float32)
+    max_len = cos.shape[0]
+
+    def rope_idx(s, h, j, bt, p, ids_):
+        # dead slots can carry stale positions; clamp to the table
+        return (jnp.minimum(p[s], max_len - 1), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s_slots, hkv, n),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d), lambda s, h, j, bt, p, ids_: (s, h, 0, 0)),
+            pl.BlockSpec((1, d_in), lambda s, h, j, bt, p, ids_: (s, 0)),
+            pl.BlockSpec((1, d_in, rank), lambda s, h, j, bt, p, ids_: (ids_[s], 0, 0)),
+            pl.BlockSpec((1, rank, 1, group, d), lambda s, h, j, bt, p, ids_: (ids_[s], 0, h, 0, 0)),
+            pl.BlockSpec((1, d // 2), rope_idx),
+            pl.BlockSpec((1, d // 2), rope_idx),
+            pl.BlockSpec((1, 1, page_size, d), lambda s, h, j, bt, p, ids_: (h, bt[s * n + j], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda s, h, j, bt, p, ids_: (h, bt[s * n + j], 0, 0)),
+            *([
+                pl.BlockSpec((1, 1), lambda s, h, j, bt, p, ids_: (h, bt[s * n + j])),
+                pl.BlockSpec((1, 1), lambda s, h, j, bt, p, ids_: (h, bt[s * n + j])),
+            ] if quantized else []),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d), lambda s, h, j, bt, p, ids_: (s, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    if quantized:
+        kernel = functools.partial(
+            _fused_bgmv_decode_kernel_quant, page_size=page_size,
+            sm_scale=sm_scale, group=group, kv_qmax=_kv_qmax_for(k_pages),
+        )
+        operands = (bt_flat, pos, ids, qg, x, a_stack, b5, cos, sin,
+                    k_pages, v_pages,
+                    k_scales.astype(jnp.float32), v_scales.astype(jnp.float32))
+    else:
+        kernel = functools.partial(
+            _fused_bgmv_decode_kernel, page_size=page_size,
+            sm_scale=sm_scale, group=group,
+        )
+        operands = (bt_flat, pos, ids, qg, x, a_stack, b5, cos, sin,
+                    k_pages, v_pages)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_slots, hkv, group, d), q_base.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*operands)
     return out.reshape(s_slots, h, d)
 
 

@@ -1,0 +1,271 @@
+"""fp8 end-to-end: per-tensor delayed scaling riding :class:`TrainState`
+plus the HYBRID (e4m3 fwd / e5m2 bwd) matmul the recipe engines ship.
+
+Built on the primitives in :mod:`~accelerate_tpu.ops.precision`
+(``Fp8Meta``/``quantize_fp8``/``_fp8_matmul``); this module adds the three
+pieces an engine-free fp8 recipe needs (reference capabilities: TE's
+DelayedScaling, MS-AMP's O1, torchao's float8 rowwise — SURVEY §2.6):
+
+1. **State that rides the train state.**  :func:`init_fp8_state` mirrors
+   the param tree — every >=2-D floating ``kernel`` leaf gets an
+   :class:`~accelerate_tpu.ops.precision.Fp8Meta` (amax history + derived
+   scale) under the same module path — and the result is carried in
+   ``TrainState.fp8_state`` exactly the way the PowerSGD ``comm_state``
+   is: initialized by ``create_train_state`` when ``mixed_precision="fp8"``
+   arms the delayed recipe, updated functionally by the jitted step
+   (:func:`update_fp8_state`), checkpointed with the rest of the state.
+
+2. **Trace-time delivery.**  The prepared step merges the meta tree into
+   the variables dict as the ``"fp8"`` collection
+   (:func:`merge_fp8_collection`); ``QuantizableDense``/``LMHead`` detect
+   ``has_variable("fp8", "w_meta")`` and switch from stateless current
+   scaling to the delayed weight scale.  Modules never mutate the
+   collection — the history update happens outside the model, from the
+   params themselves, so the user's loss function keeps its plain
+   ``loss_fn(params, batch)`` signature.
+
+3. **HYBRID backward.**  :func:`fp8_delayed_dot` routes through
+   :func:`_fp8_hybrid_matmul`: e4m3 storage on both forward operands,
+   e5m2 current-scaled quantization of the incoming cotangent, fp8 dots
+   for both dx and dw.  The stateless
+   :func:`~accelerate_tpu.ops.precision.fp8_current_scaled_dot` keeps its
+   bf16 straight-through backward — its gradient contract is pinned by
+   tests/test_fp8.py — so the e5m2 backward is an opt-in that arrives
+   with the delayed state, never a silent change to the existing path.
+
+Scaling split (documented design choice): **weights are delayed,
+activations are current-scaled**.  Weight amaxes are observable outside
+the trace (the history update reads the param tree directly — no
+mutable-collection threading through user code), while activation amaxes
+only exist in-trace, where the amax reduction fuses into the producing
+op on TPU and current scaling is free (see
+``fp8_current_scaled_dot``'s note).  This is the accuracy-conservative
+corner of the TE recipe space: the delayed history only ever smooths the
+slow-moving tensor.
+
+Env knobs (the ``ACCELERATE_FP8_*`` surface, all read at recipe
+construction): ``ACCELERATE_FP8_AMAX_HISTORY_LEN`` (default 16),
+``ACCELERATE_FP8_MARGIN`` (default 0), ``ACCELERATE_FP8_DELAYED``
+(default on; ``0``/``false`` pins the stateless current-scaling path),
+plus ``ACCELERATE_FP8_FALLBACK_BF16`` handled by the hardware gate in
+``state.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .precision import E4M3_MAX, E5M2_MAX, Fp8Meta
+
+DEFAULT_AMAX_HISTORY_LEN = 16
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "off", "no", "")
+
+
+def fp8_delayed_enabled() -> bool:
+    """Whether the delayed-scaling recipe is armed (``ACCELERATE_FP8_DELAYED``,
+    default on).  Off pins the stateless current-scaling path everywhere."""
+    return _env_flag("ACCELERATE_FP8_DELAYED", True)
+
+
+def amax_history_len() -> int:
+    return int(os.environ.get("ACCELERATE_FP8_AMAX_HISTORY_LEN",
+                              DEFAULT_AMAX_HISTORY_LEN))
+
+
+def fp8_margin() -> int:
+    return int(os.environ.get("ACCELERATE_FP8_MARGIN", 0))
+
+
+# ---------------------------------------------------------------------------
+# Delayed-scaling state (rides TrainState.fp8_state, comm_state-style)
+# ---------------------------------------------------------------------------
+
+
+def _is_kernel_leaf(name: str, leaf: Any) -> bool:
+    return (
+        name == "kernel"
+        and hasattr(leaf, "ndim")
+        and leaf.ndim >= 2
+        and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    )
+
+
+def _param_collection(params: Any) -> Any:
+    """The module-path tree: ``create_train_state`` stores the full
+    variables dict (``{"params": {...}}``); accept either form."""
+    if isinstance(params, Mapping) and "params" in params \
+            and isinstance(params["params"], Mapping):
+        return params["params"]
+    return params
+
+
+def init_fp8_state(params, history_len: Optional[int] = None,
+                   margin: Optional[int] = None):
+    """Mirror the param tree into a per-tensor ``Fp8Meta`` tree.
+
+    Every >=2-D floating ``kernel`` leaf gets a ``{"w_meta": Fp8Meta}``
+    entry under the same module path, so the result is directly usable as
+    the ``"fp8"`` flax variable collection (module paths line up with the
+    ``"params"`` collection).  The history is seeded with the kernel's
+    current amax — step 0 therefore quantizes with exactly the
+    current-scaling scale and the history only smooths from there.
+
+    Returns ``None`` when the tree holds no matmul kernels (nothing to
+    scale — the caller skips fp8 state entirely)."""
+    history_len = amax_history_len() if history_len is None else history_len
+    margin = fp8_margin() if margin is None else margin
+
+    def walk(tree):
+        out = {}
+        for name, leaf in tree.items():
+            if isinstance(leaf, Mapping):
+                sub = walk(leaf)
+                if sub:
+                    out[name] = sub
+            elif _is_kernel_leaf(name, leaf):
+                amax = jnp.max(jnp.abs(leaf)).astype(jnp.float32)
+                out["w_meta"] = Fp8Meta.init(history_len).updated(
+                    amax, E4M3_MAX, margin
+                )
+        return out
+
+    state = walk(_param_collection(params))
+    return state or None
+
+
+def update_fp8_state(fp8_state, params, margin: Optional[int] = None):
+    """One delayed-scaling tick: roll each tensor's amax history with the
+    kernel's current amax and re-derive the scale.  Runs inside the jitted
+    train step against the post-update params — the history entry observed
+    at step ``t`` feeds the scale used at step ``t+1``, TE's
+    DelayedScaling contract."""
+    if fp8_state is None:
+        return None
+    margin = fp8_margin() if margin is None else margin
+
+    def walk(meta_tree, param_tree):
+        out = {}
+        for name, node in meta_tree.items():
+            if name == "w_meta":
+                amax = jnp.max(jnp.abs(param_tree["kernel"])).astype(jnp.float32)
+                out[name] = node.updated(amax, E4M3_MAX, margin)
+            else:
+                out[name] = walk(node, param_tree[name])
+        return out
+
+    return walk(fp8_state, _param_collection(params))
+
+
+def merge_fp8_collection(variables, fp8_state):
+    """Attach the meta tree to a variables dict as the read-only ``"fp8"``
+    collection (under ``stop_gradient`` — scales are never differentiated).
+    No-op when there is no state."""
+    if fp8_state is None:
+        return variables
+    return {**variables, "fp8": jax.lax.stop_gradient(fp8_state)}
+
+
+# ---------------------------------------------------------------------------
+# HYBRID matmul: e4m3 forward, e5m2 current-scaled backward
+# ---------------------------------------------------------------------------
+
+
+def _saturate_cast(t, scale, fp8_max, dtype):
+    return jnp.clip(t.astype(jnp.float32) * scale, -fp8_max, fp8_max).astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fp8_hybrid_matmul(x, w, x_scale, w_scale, preferred_element_type):
+    """Scaled-e4m3 matmul with the TE-HYBRID e5m2 backward: the incoming
+    cotangent is current-scaled to e5m2 (wide-range format — gradients
+    overflow e4m3's 448 ceiling long before they underflow) and both grad
+    dots run on fp8 operands."""
+    qx = _saturate_cast(x, x_scale, E4M3_MAX, jnp.float8_e4m3fn)
+    qw = _saturate_cast(w, w_scale, E4M3_MAX, jnp.float8_e4m3fn)
+    out = jax.lax.dot_general(
+        qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (out / (x_scale * w_scale)).astype(preferred_element_type)
+
+
+def _fp8_hybrid_fwd(x, w, x_scale, w_scale, preferred_element_type):
+    qx = _saturate_cast(x, x_scale, E4M3_MAX, jnp.float8_e4m3fn)
+    qw = _saturate_cast(w, w_scale, E4M3_MAX, jnp.float8_e4m3fn)
+    out = jax.lax.dot_general(
+        qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    out = (out / (x_scale * w_scale)).astype(preferred_element_type)
+    # residuals: the already-quantized operands (fp8 storage — half the
+    # bf16 residency a straight-through bwd would keep), their scales, and
+    # zero-size dtype carriers (residual pytrees hold arrays only)
+    return out, (qx, qw, x_scale, w_scale,
+                 jnp.zeros((0,), x.dtype), jnp.zeros((0,), w.dtype))
+
+
+def _fp8_hybrid_bwd(preferred_element_type, res, g):
+    qx, qw, x_scale, w_scale, x_sent, w_sent = res
+    x_dtype, w_dtype = x_sent.dtype, w_sent.dtype
+    g32 = g.astype(jnp.float32)
+    g_amax = jnp.maximum(jnp.max(jnp.abs(g32)), 1e-12)
+    g_scale = E5M2_MAX / g_amax
+    qg = _saturate_cast(g32, g_scale, E5M2_MAX, jnp.float8_e5m2)
+    # dx = g @ w^T over the shared output dim
+    dx = jax.lax.dot_general(
+        qg, qw, (((qg.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dx = (dx / (g_scale * w_scale)).astype(x_dtype)
+    # dw = x^T @ g over all leading (batch/sequence) dims
+    qx2 = qx.reshape(-1, qx.shape[-1])
+    qg2 = qg.reshape(-1, qg.shape[-1])
+    dw = jax.lax.dot_general(
+        qx2, qg2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dw = (dw / (x_scale * g_scale)).astype(w_dtype)
+    return dx, dw, None, None
+
+
+_fp8_hybrid_matmul.defvjp(_fp8_hybrid_fwd, _fp8_hybrid_bwd)
+
+
+def fp8_delayed_dot(x, w, w_meta: Fp8Meta, *, preferred_element_type=None):
+    """The delayed-scaling matmul ``QuantizableDense``/``LMHead`` route
+    through when the ``"fp8"`` collection is present: the weight uses its
+    history-derived scale (``w_meta.scale``), the activation is
+    current-scaled (free on TPU — the amax fuses into the producer), and
+    the backward is HYBRID e5m2."""
+    pet = preferred_element_type or x.dtype
+    x_amax = jnp.maximum(jnp.max(jnp.abs(x.astype(jnp.float32))), 1e-12)
+    x_scale = E4M3_MAX / x_amax
+    w_scale = w_meta.scale.astype(jnp.float32)
+    return _fp8_hybrid_matmul(x, w, x_scale, w_scale, pet)
+
+
+def fp8_fake_quantize(t, fp8_max: float = E4M3_MAX):
+    """Quantize-dequantize through e4m3 storage in the input dtype.
+
+    The collective-matmul composition hook: the ring schedules
+    (``ops/collective_matmul.py``) own their partial dots, so the fp8
+    path hands them operands already rounded to e4m3 values — the ring's
+    numerics then match "fp8 storage, wide accumulate" and the latency
+    hiding is preserved.  Casts are linear in JAX, so gradients flow
+    straight through (the rounding is invisible to the bwd trace)."""
+    t32 = t.astype(jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(t32)), 1e-12)
+    scale = fp8_max / amax
+    q = jnp.clip(t32 * scale, -fp8_max, fp8_max).astype(jnp.float8_e4m3fn)
+    return (q.astype(jnp.float32) / scale).astype(t.dtype)

@@ -47,6 +47,29 @@ from .scheduler import ContinuousBatchingScheduler, Request, SlotState
 from .speculate import Speculator, make_draft_provider, speculative_page_need
 
 
+def _layer_view(layer, block_tables):
+    """One layer's model-facing cache dict.  Quantized pools
+    (``ServingPlugin.kv_dtype``) carry their per-(kv-head, page) scale
+    arrays alongside the pages — the model detects ``k_scales`` and routes
+    quantize-on-write / dequant-on-read."""
+    view = {"k_pages": layer["k_pages"], "v_pages": layer["v_pages"],
+            "block_tables": block_tables}
+    if "k_scales" in layer:
+        view["k_scales"] = layer["k_scales"]
+        view["v_scales"] = layer["v_scales"]
+    return view
+
+
+def _layer_keep(layer):
+    """The engine-side carry of one layer returned by the model (drop the
+    per-step block-table alias, keep pages + scales)."""
+    keep = {"k_pages": layer["k_pages"], "v_pages": layer["v_pages"]}
+    if "k_scales" in layer:
+        keep["k_scales"] = layer["k_scales"]
+        keep["v_scales"] = layer["v_scales"]
+    return keep
+
+
 def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
                      lora_kernel_mode: str = "auto"):
     """The raw (un-jitted) device-program bodies.  :func:`_engine_fns`
@@ -85,11 +108,7 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
             cache["block_tables"], cache["free_stack"], cache["free_top"],
             jnp.arange(n_slots, dtype=jnp.int32), pos // page_size, need,
         )
-        layer_caches = [
-            {"k_pages": l["k_pages"], "v_pages": l["v_pages"],
-             "block_tables": block_tables}
-            for l in cache["layers"]
-        ]
+        layer_caches = [_layer_view(l, block_tables) for l in cache["layers"]]
         variables = {**params, "lora": lora_pool} if lora else params
         kwargs = {"adapter_ids": adapter_slots} if lora else {}
         logits, new_layers = apply(
@@ -98,8 +117,7 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
         )
         next_tok = sample_logits(logits[:, 0], rng, gen_config)
         new_cache = {
-            "layers": [{"k_pages": l["k_pages"], "v_pages": l["v_pages"]}
-                       for l in new_layers],
+            "layers": [_layer_keep(l) for l in new_layers],
             "block_tables": block_tables,
             "seq_lens": seq_lens + active.astype(jnp.int32),
             "free_stack": cache["free_stack"],
@@ -121,10 +139,7 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
             jnp.full((width,), slot, jnp.int32), positions // page_size, need,
         )
         row = jax.lax.dynamic_slice_in_dim(block_tables, slot, 1, axis=0)
-        layer_caches = [
-            {"k_pages": l["k_pages"], "v_pages": l["v_pages"], "block_tables": row}
-            for l in cache["layers"]
-        ]
+        layer_caches = [_layer_view(l, row) for l in cache["layers"]]
         variables = {**params, "lora": lora_pool} if lora else params
         kwargs = {"adapter_ids": jnp.reshape(adapter_slot, (1,))} if lora else {}
         logits, new_layers = apply(
@@ -133,8 +148,7 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
         )
         last = jnp.take(logits[0], chunk_len - 1, axis=0)
         new_cache = {
-            "layers": [{"k_pages": l["k_pages"], "v_pages": l["v_pages"]}
-                       for l in new_layers],
+            "layers": [_layer_keep(l) for l in new_layers],
             "block_tables": block_tables,
             "seq_lens": cache["seq_lens"].at[slot].set(start + chunk_len),
             "free_stack": cache["free_stack"],
@@ -168,11 +182,7 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
             jnp.repeat(jnp.arange(n, dtype=jnp.int32), w),
             logical.reshape(-1), need.reshape(-1),
         )
-        layer_caches = [
-            {"k_pages": l["k_pages"], "v_pages": l["v_pages"],
-             "block_tables": block_tables}
-            for l in cache["layers"]
-        ]
+        layer_caches = [_layer_view(l, block_tables) for l in cache["layers"]]
         variables = {**params, "lora": lora_pool} if lora else params
         kwargs = {"adapter_ids": adapter_slots} if lora else {}
         logits, new_layers = apply(
@@ -203,8 +213,7 @@ def _engine_step_fns(model, gen_config, page_size: int, lora: bool = False,
             give_back.reshape(-1),
         )
         new_cache = {
-            "layers": [{"k_pages": l["k_pages"], "v_pages": l["v_pages"]}
-                       for l in new_layers],
+            "layers": [_layer_keep(l) for l in new_layers],
             "block_tables": block_tables,
             "seq_lens": new_seq_lens,
             "free_stack": free_stack,
@@ -402,8 +411,24 @@ class ServingEngine:
         self.adapters = adapters
         p = self.plugin
         self.cache = init_paged_cache(
-            cfg, p.num_pages, p.page_size, p.num_slots, p.pages_per_slot
+            cfg, p.num_pages, p.page_size, p.num_slots, p.pages_per_slot,
+            kv_dtype=p.kv_dtype,
         )
+        if p.kv_dtype in ("int8", "fp8"):
+            # measured side of the kv_quant.page_bytes twin: the pool
+            # arrays as actually allocated (codes + per-page scales),
+            # counted per physical page — the predicted side is
+            # kv_pool_accounting's kv_page_bytes arithmetic
+            pool_nbytes = sum(
+                int(arr.nbytes) for layer in self.cache["layers"]
+                for arr in layer.values()
+            )
+            from ..telemetry import twin_registry
+
+            twin_registry().record_measured(
+                "kv_quant.page_bytes", pool_nbytes / p.num_pages,
+                source="serving/engine.ServingEngine",
+            )
         # speculative multi-token decode (serving/speculate.py): a draft
         # provider proposes k tokens per slot and the verify program accepts
         # the longest greedy-matching prefix — greedy only, because the
@@ -428,7 +453,7 @@ class ServingEngine:
         # allocator arithmetic keyed by page geometry
         self.prefix: Optional[PrefixCache] = None
         if p.prefix_cache == "on":
-            self.prefix = PrefixCache(p.page_size)
+            self.prefix = PrefixCache(p.page_size, kv_dtype=p.kv_dtype)
             self._adopt, self._release_cow, self._push_free = _prefix_fns(
                 p.page_size
             )

@@ -102,21 +102,43 @@ def push_pages(free_stack, free_top, pages, mask):
     return free_stack, free_top + jnp.sum(mask.astype(jnp.int32))
 
 
-def kv_pool_accounting(config, num_pages: int, page_size: int, dtype_bytes: int = 2) -> dict:
+def kv_page_bytes(config, page_size: int, dtype_bytes: int = 2,
+                  kv_dtype: str = "") -> int:
+    """Bytes of ONE physical page across all layers — the unit the
+    allocator hands out AND the disaggregated transfer wire unit
+    (``serving/transfer.py`` computes its ``transfer.page_bytes`` twin
+    through this same function, so the twin stays exact by construction).
+
+    Dense pages: ``2 (K+V) * L * page_size * Hkv * D * dtype_bytes``.
+    Quantized pages (``kv_dtype`` "int8"/"fp8"): 1-byte codes plus the
+    per-(kv-head, page) float32 scale that is part of the page's content
+    (``2 * L * Hkv * 4`` bytes — it travels with the page on the wire and
+    feeds the prefix-cache hash)."""
+    if kv_dtype in ("int8", "fp8"):
+        data = (2 * config.num_hidden_layers * page_size
+                * config.num_key_value_heads * config.head_dim)
+        scales = 2 * config.num_hidden_layers * config.num_key_value_heads * 4
+        return data + scales
+    return (2 * config.num_hidden_layers * page_size
+            * config.num_key_value_heads * config.head_dim * dtype_bytes)
+
+
+def kv_pool_accounting(config, num_pages: int, page_size: int,
+                       dtype_bytes: int = 2, kv_dtype: str = "") -> dict:
     """Predicted KV-HBM ladder for a pool geometry (CheckFreq-style
     predicted twin; the measured counterpart is the harness's
     ``kv_pool_utilization``).
 
     bytes/page is per *physical page across all layers* — the unit the
-    allocator hands out: ``2 (K+V) * L * page_size * Hkv * D * dtype``.
-    """
-    per_page = (
-        2 * config.num_hidden_layers * page_size
-        * config.num_key_value_heads * config.head_dim * dtype_bytes
-    )
+    allocator hands out: ``2 (K+V) * L * page_size * Hkv * D * dtype``
+    (:func:`kv_page_bytes`; quantized pools count the 1-byte codes plus
+    the per-page scales).  ``capacity_vs_bf16`` reports the quantized
+    pool's token-capacity multiple at equal HBM — the ladder headline
+    (~1.9-2x for int8/fp8 once ``page_size * D`` amortizes the scales)."""
+    per_page = kv_page_bytes(config, page_size, dtype_bytes, kv_dtype)
     total = per_page * num_pages
     gib = lambda b: round(b / 2**30, 4)
-    return {
+    out = {
         "page_size_tokens": page_size,
         "num_pages": num_pages,
         "bytes_per_page": per_page,
@@ -130,3 +152,18 @@ def kv_pool_accounting(config, num_pages: int, page_size: int, dtype_bytes: int 
             "v6e_32GiB": round(total / (32 * 2**30), 6),
         },
     }
+    if kv_dtype in ("int8", "fp8"):
+        bf16_page = kv_page_bytes(config, page_size, 2)
+        out["kv_dtype"] = kv_dtype
+        out["capacity_vs_bf16"] = round(bf16_page / per_page, 4)
+        # predicted side of the kv_quant.page_bytes twin — the measured
+        # side is the engine's allocated pool arrays (nbytes per page);
+        # exact by construction since both route through kv_page_bytes'
+        # codes+scales arithmetic
+        from ..telemetry import twin_registry
+
+        twin_registry().record_predicted(
+            "kv_quant.page_bytes", per_page,
+            source="serving/paged_cache.kv_pool_accounting",
+        )
+    return out

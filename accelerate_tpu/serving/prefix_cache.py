@@ -59,17 +59,24 @@ def _block_digest(parent: bytes, tokens: Sequence[int], adapter_id: int) -> byte
 
 
 def block_hashes(prompt: Sequence[int], page_size: int,
-                 adapter_id: int = 0) -> list[bytes]:
+                 adapter_id: int = 0, kv_dtype: str = "") -> list[bytes]:
     """The prompt's chained block-hash ladder, one entry per CACHEABLE full
     page.  Capped at ``(len(prompt) - 1) // page_size``: the last page is
     never cacheable even when the prompt is page-aligned, so a fully-cached
     admission still prefills at least one real token (the decode loop needs
     the prompt's last-token logits — the COW contract's "first
     partially-filled page is always private" extends to "the last prompt
-    token is always prefilled")."""
+    token is always prefilled").
+
+    ``kv_dtype`` seeds the chain: a quantized pool's page *content* is
+    codes + scales, not bf16 rows, so an int8 pool's hashes must never
+    collide with a bf16 or fp8 pool's — the scales are part of what the
+    hash addresses."""
     full = max(0, (len(prompt) - 1)) // page_size
     out: list[bytes] = []
     parent = b"prefix-cache-v1"
+    if kv_dtype and kv_dtype != "bf16":
+        parent += b":kv=" + kv_dtype.encode("ascii")
     for j in range(full):
         parent = _block_digest(
             parent, prompt[j * page_size:(j + 1) * page_size], adapter_id
@@ -90,8 +97,9 @@ class PrefixCache:
     dispatch.
     """
 
-    def __init__(self, page_size: int):
+    def __init__(self, page_size: int, kv_dtype: str = ""):
         self.page_size = page_size
+        self.kv_dtype = kv_dtype  # seeds the hash chain: codes+scales content
         self.index: dict[bytes, int] = {}        # chain hash -> physical page
         self.page_hash: dict[int, bytes] = {}    # reverse map
         self.refcount: dict[int, int] = {}       # page -> index hold + slot holds
@@ -114,7 +122,7 @@ class PrefixCache:
     # -- hashing / lookup ----------------------------------------------------
 
     def block_hashes(self, prompt: Sequence[int], adapter_id: int = 0) -> list[bytes]:
-        return block_hashes(prompt, self.page_size, adapter_id)
+        return block_hashes(prompt, self.page_size, adapter_id, self.kv_dtype)
 
     def match(self, hashes: Sequence[bytes]) -> list[int]:
         """Physical page ids of the longest indexed prefix of ``hashes``.

@@ -41,27 +41,33 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine import ServingEngine
-from .paged_cache import allocate, pages_for
+from .paged_cache import allocate, kv_page_bytes, pages_for
 from .scheduler import Request
 
 
-def page_bytes(config, page_size: int, dtype_bytes: int = 2) -> int:
+def page_bytes(config, page_size: int, dtype_bytes: int = 2,
+               kv_dtype: str = "") -> int:
     """Wire bytes of ONE physical page across all layers — the unit the
-    transfer twin counts in (``kv_pool_accounting``'s bytes/page)."""
-    return (2 * config.num_hidden_layers * page_size
-            * config.num_key_value_heads * config.head_dim * dtype_bytes)
+    transfer twin counts in (``kv_pool_accounting``'s bytes/page; one
+    shared formula, :func:`~.paged_cache.kv_page_bytes`, so predicted and
+    measured twins can only agree exactly).  Quantized pools
+    (``kv_dtype`` "int8"/"fp8") ship 1-byte codes plus the per-(kv-head,
+    page) scales — the scales are page content and travel on the wire."""
+    return kv_page_bytes(config, page_size, dtype_bytes, kv_dtype)
 
 
 def transfer_accounting(config, trace, page_size: int, dtype_bytes: int = 2,
-                        dcn_gbps: float = 25.0) -> dict:
+                        dcn_gbps: float = 25.0, kv_dtype: str = "") -> dict:
     """Predicted ``dcn``-axis byte model for a disaggregated replay of
     ``trace`` (the ``dcn_comm_accounting`` pattern): every request ships
     ``pages_for(prompt_len)`` live pages exactly once, prefill→decode.
     The measured twin (``transfer.page_bytes``) comes from the transport's
     executed transfers — the two agree exactly unless a request never made
     it to the handoff (shed, cancelled, drained).  ``dcn_gbps`` turns the
-    bytes into a stream-time envelope per the reference DCN link rate."""
-    per_page = page_bytes(config, page_size, dtype_bytes)
+    bytes into a stream-time envelope per the reference DCN link rate.
+    Pass the pool's ``kv_dtype`` for quantized pages — the wire unit is
+    roughly halved (codes + scales instead of bf16)."""
+    per_page = page_bytes(config, page_size, dtype_bytes, kv_dtype)
     pages = sum(int(pages_for(r.prompt_len, page_size)) for r in trace)
     total = pages * per_page
     from ..telemetry import twin_registry
@@ -84,13 +90,24 @@ def _transfer_step_fns():
     def send_step(cache, slot):
         # one slot's pages, gathered contiguous through its block-table row
         # — the wire payload a DCN stream would carry (dead pages ride as
-        # padding; the byte twin counts live pages only)
+        # padding; the byte twin counts live pages only).  Quantized pools
+        # also ship the per-(kv-head, page) scales: they are page content
+        # (the codes are meaningless without them), so they ride the same
+        # payload — the byte twin counts them via kv_page_bytes.
         row = jax.lax.dynamic_slice_in_dim(cache["block_tables"], slot, 1)[0]
-        ks = jnp.stack([l["k_pages"][:, row] for l in cache["layers"]])
-        vs = jnp.stack([l["v_pages"][:, row] for l in cache["layers"]])
-        return ks, vs  # [L, Hkv, pps, page, D] each
+        payload = {
+            "k": jnp.stack([l["k_pages"][:, row] for l in cache["layers"]]),
+            "v": jnp.stack([l["v_pages"][:, row] for l in cache["layers"]]),
+        }  # [L, Hkv, pps, page, D] each
+        if "k_scales" in cache["layers"][0]:
+            payload["k_scales"] = jnp.stack(
+                [l["k_scales"][:, row] for l in cache["layers"]])
+            payload["v_scales"] = jnp.stack(
+                [l["v_scales"][:, row] for l in cache["layers"]])
+            # [L, Hkv, pps] each
+        return payload
 
-    def recv_step(cache, slot, ks, vs, n_pages, seq_len):
+    def recv_step(cache, slot, payload, n_pages, seq_len):
         # pop n_pages fresh pages, install the block-table row, scatter the
         # payload into the popped pages — one donated fixed-shape program
         pps = cache["block_tables"].shape[1]
@@ -103,11 +120,21 @@ def _transfer_step_fns():
         row = jax.lax.dynamic_slice_in_dim(block_tables, slot, 1)[0]
         num_pages = cache["layers"][0]["k_pages"].shape[1]
         dst = jnp.where(need, row, num_pages)  # OOB -> drop (write-mask rule)
-        new_layers = [
-            {"k_pages": l["k_pages"].at[:, dst].set(ks[i], mode="drop"),
-             "v_pages": l["v_pages"].at[:, dst].set(vs[i], mode="drop")}
-            for i, l in enumerate(cache["layers"])
-        ]
+        quantized = "k_scales" in payload
+        new_layers = []
+        for i, l in enumerate(cache["layers"]):
+            layer = {
+                "k_pages": l["k_pages"].at[:, dst].set(payload["k"][i],
+                                                       mode="drop"),
+                "v_pages": l["v_pages"].at[:, dst].set(payload["v"][i],
+                                                       mode="drop"),
+            }
+            if quantized:
+                layer["k_scales"] = l["k_scales"].at[:, dst].set(
+                    payload["k_scales"][i], mode="drop")
+                layer["v_scales"] = l["v_scales"].at[:, dst].set(
+                    payload["v_scales"][i], mode="drop")
+            new_layers.append(layer)
         return {
             "layers": new_layers,
             "block_tables": block_tables,
@@ -144,13 +171,23 @@ class PagedKVTransport:
                 f"handoff: src=({ps.page_size}, {ps.pages_per_slot}) vs "
                 f"dst=({pd.page_size}, {pd.pages_per_slot})"
             )
+        src_kvd = getattr(ps, "kv_dtype", "") or "bf16"
+        dst_kvd = getattr(pd, "kv_dtype", "") or "bf16"
+        if src_kvd != dst_kvd:
+            raise ValueError(
+                "prefill/decode KV page dtypes must match for the handoff "
+                "(the wire payload is the raw page codes + scales): "
+                f"src={src_kvd!r} vs dst={dst_kvd!r}"
+            )
         self.src, self.dst = src, dst
+        quantized = src_kvd in ("int8", "fp8")
         self._send, self._recv = _transfer_fns(
-            (ps.page_size, ps.pages_per_slot)
+            (ps.page_size, ps.pages_per_slot, src_kvd)
         )
         cfg = src.model.config
         self._page_bytes = page_bytes(
-            cfg, ps.page_size, jnp.dtype(cfg.dtype).itemsize
+            cfg, ps.page_size, jnp.dtype(cfg.dtype).itemsize,
+            kv_dtype=src_kvd if quantized else "",
         )
         self.transfers = 0
         self.pages_moved = 0
@@ -159,9 +196,9 @@ class PagedKVTransport:
     def warmup(self) -> None:
         """Compile both wire programs before traffic (no-op passes: the
         send gathers slot 0, the recv installs zero pages)."""
-        ks, vs = self._send(self.src.cache, jnp.asarray(0, jnp.int32))
+        payload = self._send(self.src.cache, jnp.asarray(0, jnp.int32))
         self.dst.cache = self._recv(
-            self.dst.cache, jnp.asarray(0, jnp.int32), ks, vs,
+            self.dst.cache, jnp.asarray(0, jnp.int32), payload,
             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
         )
 
@@ -172,10 +209,10 @@ class PagedKVTransport:
         side frees only at refcount zero).  Returns the decode slot."""
         src, dst = self.src, self.dst
         n_pages = int(pages_for(request.prompt_len, src.plugin.page_size))
-        ks, vs = self._send(src.cache, jnp.asarray(src_slot, jnp.int32))
+        payload = self._send(src.cache, jnp.asarray(src_slot, jnp.int32))
         dst_slot = dst.adopt_prefilled(request, first_token)
         dst.cache = self._recv(
-            dst.cache, jnp.asarray(dst_slot, jnp.int32), ks, vs,
+            dst.cache, jnp.asarray(dst_slot, jnp.int32), payload,
             jnp.asarray(n_pages, jnp.int32),
             jnp.asarray(request.prompt_len, jnp.int32),
         )

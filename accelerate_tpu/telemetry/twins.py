@@ -94,6 +94,12 @@ STANDARD_TWINS: dict[str, tuple] = {
     # transport's executed byte counter — exact by construction unless a
     # request never reached the handoff
     "transfer.page_bytes": ("bytes", 0.01, None),
+    # serving/paged_cache.kv_page_bytes (codes + per-page scales for
+    # int8/fp8 pools) vs the allocated pool arrays' actual nbytes per page
+    # — one formula feeds the allocator, the transfer wire unit and this
+    # row, so the sides agree EXACTLY; tolerance 0.0 makes any drift
+    # (a scale array the formula forgot, a dtype change) an error
+    "kv_quant.page_bytes": ("bytes/page", 0.0, 0.0),
 }
 
 

@@ -551,6 +551,16 @@ class ServingPlugin(KwargsHandler):
                                              # pool; env
                                              # ACCELERATE_SERVE_LADDER_RESERVE,
                                              # default 0.125)
+    kv_dtype: str = ""                       # KV page storage dtype: "bf16"
+                                             # (model dtype, dense pages) |
+                                             # "int8" | "fp8" — quantized pages
+                                             # store 1-byte codes + per-(kv-
+                                             # head, page) scales, ~1.9-2x the
+                                             # token capacity per HBM byte
+                                             # (serving/paged_cache.py
+                                             # kv_pool_accounting ladder).  env
+                                             # ACCELERATE_SERVE_KV_DTYPE,
+                                             # default bf16
 
     def __post_init__(self):
         env = os.environ
@@ -664,6 +674,14 @@ class ServingPlugin(KwargsHandler):
             raise ValueError(
                 f"num_pages={self.num_pages} must cover at least one sequence "
                 f"(pages_per_slot={self.pages_per_slot})"
+            )
+        if not self.kv_dtype:
+            self.kv_dtype = env.get("ACCELERATE_SERVE_KV_DTYPE", "bf16")
+        self.kv_dtype = self.kv_dtype.lower()
+        if self.kv_dtype not in ("bf16", "int8", "fp8"):
+            raise ValueError(
+                f"kv_dtype must be 'bf16', 'int8' or 'fp8', got "
+                f"{self.kv_dtype!r}"
             )
         if self.prefill_buckets is None:
             buckets, b = [], 16
@@ -940,14 +958,33 @@ class FP8RecipeKwargs(KwargsHandler):
     """
 
     fp8_format: FP8Format = FP8Format.HYBRID
-    amax_history_len: int = 16
+    amax_history_len: Optional[int] = None   # env ACCELERATE_FP8_AMAX_HISTORY_LEN,
+                                             # default 16
     amax_compute_algo: str = "max"
-    margin: int = 0
+    margin: Optional[int] = None             # env ACCELERATE_FP8_MARGIN, default 0
     module_filter: Optional[Callable[[str], bool]] = None
 
     def __post_init__(self):
         if isinstance(self.fp8_format, str):
             self.fp8_format = FP8Format(self.fp8_format.upper())
+        env = os.environ
+        if self.amax_history_len is None:
+            self.amax_history_len = int(
+                env.get("ACCELERATE_FP8_AMAX_HISTORY_LEN", 16)
+            )
+        if self.margin is None:
+            self.margin = int(env.get("ACCELERATE_FP8_MARGIN", 0))
+        if self.amax_history_len < 1:
+            raise ValueError(
+                f"amax_history_len must be >= 1, got {self.amax_history_len}"
+            )
+        if self.margin < 0:
+            raise ValueError(f"margin must be >= 0, got {self.margin}")
+        if self.amax_compute_algo != "max":
+            raise ValueError(
+                "amax_compute_algo: only 'max' is implemented "
+                f"(got {self.amax_compute_algo!r})"
+            )
 
 
 @dataclass

@@ -469,6 +469,10 @@ def serve_report(args) -> dict:
     if prefix_share:
         # --prefix-share arms the COW prefix cache on the serving engine
         spec_kw["prefix_cache"] = "on"
+    kv_dtype = getattr(args, "kv_dtype", "bf16") or "bf16"
+    if kv_dtype != "bf16":
+        # --kv-dtype arms the quantized page pool (codes + per-page scales)
+        spec_kw["kv_dtype"] = kv_dtype
     if on_tpu:
         # the 600m-class decode shape (the headline bench's model family);
         # pool sized off the KV-HBM ladder, paged Pallas decode kernel
@@ -582,6 +586,7 @@ def serve_report(args) -> dict:
         rep["transfer_accounting"] = transfer_accounting(
             cfg, trace, plugin.page_size,
             dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+            kv_dtype=plugin.kv_dtype if plugin.kv_dtype != "bf16" else "",
         )
     else:
         rep["disaggregated"] = {"page_transfers": 0, "page_transfer_bytes": 0,
@@ -639,7 +644,22 @@ def serve_report(args) -> dict:
     rep["kv_pool"] = kv_pool_accounting(
         cfg, plugin.num_pages, plugin.page_size,
         dtype_bytes=jnp.dtype(cfg.dtype).itemsize,
+        kv_dtype=plugin.kv_dtype if plugin.kv_dtype != "bf16" else "",
     )
+    # ALWAYS emitted, zeros-clean: the pool's page dtype and the capacity
+    # ladder (token-capacity multiple vs bf16 at equal HBM for each page
+    # dtype this geometry supports — pure kv_page_bytes arithmetic)
+    from accelerate_tpu.serving.paged_cache import kv_page_bytes as _kpb
+
+    _bf16_page = _kpb(cfg, plugin.page_size,
+                      jnp.dtype(cfg.dtype).itemsize)
+    rep["kv_dtype"] = plugin.kv_dtype or "bf16"
+    rep["fp8_amax_history_len"] = 0  # train-bench field; zeros-clean here
+    rep["kv_pool_capacity_ladder"] = {
+        "bf16": 1.0,
+        "int8": round(_bf16_page / _kpb(cfg, plugin.page_size, 1, "int8"), 4),
+        "fp8": round(_bf16_page / _kpb(cfg, plugin.page_size, 1, "fp8"), 4),
+    }
     rep["serve_seed"] = args.serve_seed
     rep["decode_kernel"] = engine.model.config.attn_implementation
     rep["backend"] = jax.default_backend()
@@ -702,6 +722,20 @@ def main():
                          "shrinking the pinned-host residual buffer (the 131k lever)")
     ap.add_argument("--precision", choices=["bf16", "fp8"], default="bf16",
                     help="mixed_precision for the train step (fp8: scaled-e4m3 matmuls)")
+    ap.add_argument("--fp8", action="store_true",
+                    help="shorthand for --precision fp8: fp8 train-step matmuls "
+                         "with delayed scaling (e4m3 forward / e5m2 backward, "
+                         "per-tensor amax history riding TrainState.fp8_state; "
+                         "ops/fp8.py).  The report always carries "
+                         "fp8_amax_history_len (0 when fp8 is off)")
+    ap.add_argument("--kv-dtype", choices=["bf16", "int8", "fp8"], default="bf16",
+                    help="with --serve: quantized KV page pool — int8/fp8 codes "
+                         "with per-(kv-head, page) scales beside the block "
+                         "tables (~1.9-2x token capacity at equal HBM; the "
+                         "kv_pool_capacity_ladder field).  Greedy tokens stay "
+                         "within the pinned decode tolerance; the "
+                         "kv_quant.page_bytes twin pins allocated vs modeled "
+                         "bytes exactly")
     ap.add_argument("--optimizer",
                     choices=["lion", "adamw", "lion-sr", "adamw-sr",
                              "lion-sr8", "adamw-sr8"],
@@ -843,6 +877,8 @@ def main():
                          "jaxpr-audit summary (analysis/jaxpr_audit.py; pure "
                          "trace, CPU-safe, no device execution)")
     args = ap.parse_args()
+    if args.fp8:
+        args.precision = "fp8"
 
     if args.plan:
         if args.plan_task == "infer":
@@ -1415,6 +1451,12 @@ def main():
     # retention is 1.0 (goodput_accounting covers cadence-model predictions)
     reg.record_predicted("goodput.goodput_frac", 1.0,
                          source="bench.train clean-run model")
+    # ALWAYS emitted, zeros-clean: the delayed-scaling window when fp8 is
+    # armed (the amax history riding TrainState.fp8_state), 0 otherwise
+    from accelerate_tpu.ops.fp8 import amax_history_len as _amax_hist_len
+
+    fp8_hist_len = (_amax_hist_len()
+                    if getattr(state, "fp8_state", None) is not None else 0)
     telemetry_fields = {
         "schema_version": BENCH_SCHEMA_VERSION,
         "telemetry_overhead_frac": (
@@ -1439,6 +1481,7 @@ def main():
             **telemetry_fields,
             **extra_report,
             "precision": args.precision,
+            "fp8_amax_history_len": fp8_hist_len,
             "optimizer": args.optimizer,
             "mfu": round(mfu, 4),
             "params": count_params(state.params),

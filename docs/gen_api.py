@@ -95,6 +95,11 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "send_to_device", "concatenate",
     ]),
     "kernels": ("accelerate_tpu.ops.flash_attention", None),
+    "fp8": ("accelerate_tpu.ops.fp8", [
+        "init_fp8_state", "update_fp8_state", "merge_fp8_collection",
+        "fp8_delayed_dot", "fp8_fake_quantize", "fp8_delayed_enabled",
+        "amax_history_len", "fp8_margin",
+    ]),
     "quantization": ("accelerate_tpu.utils.quantization", [
         "QuantizationConfig", "QuantizedTensor", "quantize", "dequantize",
         "quantize_params", "quantized_apply",
@@ -135,7 +140,7 @@ PAGES: dict[str, tuple[str, list[str] | None]] = {
         "GradSyncKwargs", "ProfileKwargs", "GradientAccumulationPlugin",
         "FullyShardedDataParallelPlugin", "ResiliencePlugin", "ServingPlugin",
         "LoraPlugin", "ProjectConfiguration", "DataLoaderConfiguration",
-        "InitProcessGroupKwargs",
+        "InitProcessGroupKwargs", "FP8RecipeKwargs",
     ]),
     "memory": ("accelerate_tpu.utils.memory", None),
 }

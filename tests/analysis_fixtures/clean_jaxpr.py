@@ -106,6 +106,19 @@ def collective_matmul_rs_hint_step(x, w):
                       out_specs=P(None, "x", None), **_no_check)(x, w)
 
 
+def unscaled_fp8_dot_step(x, w):
+    """GL110 fixed: the accumulator is multiplied by the combined inverse
+    scale before anything else consumes it — the ops/fp8.py contract
+    (fp8_current_scaled_dot is the model)."""
+    x_scale = 448.0 / jnp.maximum(jnp.max(jnp.abs(x)), 1e-12)
+    w_scale = 448.0 / jnp.maximum(jnp.max(jnp.abs(w)), 1e-12)
+    qx = (x * x_scale).astype(jnp.float8_e4m3fn)
+    qw = (w * w_scale).astype(jnp.float8_e4m3fn)
+    y = jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return y * (1.0 / (x_scale * w_scale)) + 1.0
+
+
 def flat_dcn_reduce_step(g):
     """GL108 fixed: the hierarchical decomposition — reduce-scatter inside
     the slice over ICI, all-reduce only the 1/p slab over dcn, all-gather
@@ -149,5 +162,6 @@ def example_args():
         "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
         "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
         "collective_matmul_rs_hint_step": (jnp.ones((1, 8, 16)), jnp.ones((16, 4))),
+        "unscaled_fp8_dot_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
         "flat_dcn_reduce_step": (jax.ShapeDtypeStruct((4, 520, 520), jnp.float32),),
     }

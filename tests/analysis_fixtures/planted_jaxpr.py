@@ -112,6 +112,19 @@ def collective_matmul_rs_hint_step(x, w):
                       out_specs=P(None, "x", None), **_no_check)(x, w)
 
 
+def unscaled_fp8_dot_step(x, w):
+    """GL110: both operands cast to fp8 codes, matmul'd, and the
+    accumulator consumed by an add with NO dequantizing mul/div — the
+    downstream math runs on values off by the combined scale factor (the
+    loss still goes down, just slower, which is why only the trace catches
+    it)."""
+    qx = (x * 448.0).astype(jnp.float8_e4m3fn)
+    qw = (w * 448.0).astype(jnp.float8_e4m3fn)
+    y = jax.lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return y + 1.0  # raw fp8 codes flow into the add
+
+
 def flat_dcn_reduce_step(g):
     """GL108 (hint): a >= 1 MiB gradient psum over the JOINT ('dcn',
     'dp_shard') axes — the flat reduction whose cross-slice leg moves one
@@ -150,6 +163,7 @@ def example_args():
         "unsharded_output_step": (jax.ShapeDtypeStruct((1024, 1024), jnp.float32),),
         "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
         "collective_matmul_rs_hint_step": (jnp.ones((1, 8, 16)), jnp.ones((16, 4))),
+        "unscaled_fp8_dot_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
         # per-device operand after the leading world-axis index: 520*520*4
         # ≈ 1.03 MiB — above the 1 MiB GL108 threshold
         "flat_dcn_reduce_step": (jax.ShapeDtypeStruct((4, 520, 520), jnp.float32),),

@@ -116,9 +116,9 @@ def test_report_counts_exit_code_and_json():
 def test_every_emitted_rule_is_in_the_catalog():
     # all three engines draw severities/hints from rules.RULES; ids must resolve
     for rule_id in ("GL001", "GL002", "GL101", "GL102", "GL103", "GL104",
-                    "GL105", "GL106", "GL107", "GL108", "GL201", "GL202",
-                    "GL203", "GL204", "GL205", "GL301", "GL302", "GL303",
-                    "GL304", "GL305", "GL306"):
+                    "GL105", "GL106", "GL107", "GL108", "GL110", "GL201",
+                    "GL202", "GL203", "GL204", "GL205", "GL301", "GL302",
+                    "GL303", "GL304", "GL305", "GL306"):
         assert rule_id in RULES
         assert RULES[rule_id].summary and RULES[rule_id].fix_hint
 
@@ -137,6 +137,7 @@ _JAXPR_CASES = [
     ("collective_matmul_hint_step", "GL106", {}),
     ("collective_matmul_rs_hint_step", "GL107", {}),
     ("flat_dcn_reduce_step", "GL108", {}),
+    ("unscaled_fp8_dot_step", "GL110", {}),
 ]
 
 

@@ -150,8 +150,18 @@ def test_bench_serve_smoke():
                   "p99_token_latency_ms", "kv_pool_utilization",
                   "kv_pool_utilization_predicted", "padding_waste_frac",
                   "scheduled_token_efficiency", "scheduler_occupancy",
-                  "evictions", "static_baseline", "kv_pool"):
+                  "evictions", "static_baseline", "kv_pool",
+                  "kv_dtype", "kv_pool_capacity_ladder",
+                  "fp8_amax_history_len"):
         assert field in extra, field
+    # quantized-KV fields ride every serve report zeros-clean: bf16 pool
+    # by default, the capacity ladder always present (pure arithmetic),
+    # the quant twin idle
+    assert extra["kv_dtype"] == "bf16"
+    assert extra["kv_pool_capacity_ladder"]["bf16"] == 1.0
+    assert extra["kv_pool_capacity_ladder"]["int8"] > 1.5
+    assert extra["fp8_amax_history_len"] == 0
+    assert extra["twins"]["kv_quant.page_bytes"]["status"] == "idle"
     assert extra["completed"] == extra["requests"] > 0
     assert extra["tokens_per_sec_per_chip"] > 0
     assert extra["kv_pool_utilization"] > 0
@@ -539,3 +549,88 @@ def test_bench_serve_trace_requests(tmp_path):
     for field in ("generated_tokens", "prompt_tokens", "engine_steps",
                   "decode_steps", "prefill_steps", "evictions", "completed"):
         assert extra[field] == rep_off["extra"][field], field
+
+
+@pytest.mark.slow
+def test_bench_fp8_smoke():
+    """``--fp8`` (shorthand for --precision fp8): the train bench runs the
+    delayed-scaling recipe end to end on CPU — loss finite, the amax
+    history window reported (the always-emitted field), and the
+    steady-state recompile guard still green (the delayed-scaling state
+    update must not re-key the jit cache between steps)."""
+    rep = _run(["bench.py", "--fp8", "--iters", "2", "--batch", "8",
+                "--no-selftest"])
+    extra = rep["extra"]
+    assert extra["precision"] == "fp8"
+    assert extra["fp8_amax_history_len"] >= 1
+    assert extra["loss"] > 0
+    assert extra["twins"]["compiles.steady_state"]["status"] == "ok"
+
+    # bf16 default: the fp8 field still rides the report, zeros-clean
+    rep_bf16 = _run(["bench.py", "--iters", "2", "--batch", "8",
+                     "--no-selftest"])
+    assert rep_bf16["extra"]["precision"] == "bf16"
+    assert rep_bf16["extra"]["fp8_amax_history_len"] == 0
+
+
+@pytest.mark.slow
+def test_bench_serve_kv_quant_smoke():
+    """``--serve --kv-dtype int8``: the quantized KV page pool serves the
+    seeded trace end to end — strict_compiles holds (warmup compiles every
+    program, the replay then measures ZERO compile events over quantized
+    pages), the kv_quant.page_bytes twin is EXACT (allocated pool arrays
+    vs the kv_page_bytes model, tolerance 0.0), and the capacity ladder
+    reports the quantized pool's token-capacity multiple."""
+    rep = _run(["bench.py", "--serve", "--batch", "8", "--kv-dtype", "int8"])
+    extra = rep["extra"]
+    assert extra["kv_dtype"] == "int8"
+    assert extra["completed"] == extra["requests"] > 0
+    assert extra["tokens_per_sec_per_chip"] > 0
+    assert extra["compiles_measured"] == 0  # strict_compiles over int8 pages
+    row = extra["twins"]["kv_quant.page_bytes"]
+    assert row["status"] == "ok" and row["rel_err"] == 0.0, row
+    assert row["predicted"] == row["measured"] > 0
+    assert extra["kv_pool"]["kv_dtype"] == "int8"
+    assert extra["kv_pool"]["capacity_vs_bf16"] > 1.5
+    assert extra["kv_pool_capacity_ladder"]["int8"] == \
+        extra["kv_pool"]["capacity_vs_bf16"]
+
+
+@pytest.mark.slow
+def test_bench_serve_kv_quant_disaggregate_transfer_twin():
+    """``--serve --kv-dtype int8 --disaggregate``: quantized pages travel
+    the prefill→decode wire (codes + per-page scales), the pair's greedy
+    tokens match the fused engine BITWISE, and the transfer.page_bytes
+    twin is exact at the roughly-halved quantized wire unit."""
+    rep = _run(["bench.py", "--serve", "--batch", "4", "--serve-requests",
+                "6", "--kv-dtype", "int8", "--disaggregate"])
+    extra = rep["extra"]
+    assert extra["disaggregated"]["token_parity_vs_fused"] is True
+    row = extra["twins"]["transfer.page_bytes"]
+    assert row["status"] == "ok" and row["predicted"] == row["measured"] > 0
+    # the quantized wire unit is well under the bf16 one for this geometry
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.serving.paged_cache import kv_page_bytes
+
+    cfg = LlamaConfig.tiny()
+    page_size = 4  # the CPU-tiny serve geometry bench.py pins
+    assert extra["transfer_accounting"]["bytes_per_page"] == \
+        kv_page_bytes(cfg, page_size, 2, "int8")
+    assert kv_page_bytes(cfg, page_size, 2, "int8") < \
+        kv_page_bytes(cfg, page_size, 2)
+
+
+@pytest.mark.slow
+def test_fp8_quality_harness_runs():
+    """The fp8-vs-bf16 loss-envelope harness (benchmarks/fp8_quality.py,
+    the sr_quality.py discipline): identical Zipf stream, held-out batch,
+    both envelope numbers emitted.  The documented 240-step envelope
+    (docs/performance.md) comes from the full run; this smoke pins the
+    harness stays executable."""
+    rep = _run(["benchmarks/fp8_quality.py", "--cpu", "--steps", "4",
+                "--eval-every", "2"])
+    assert rep["metric"] == "fp8_quality_shuffled_stream"
+    assert rep["scaling"] == "delayed"
+    assert rep["model"] == "tiny-cpu" and rep["backend"] == "cpu"
+    assert rep["final_held_out_gap_pct"] is not None
+    assert rep["train_envelope_max_pct"] >= 0.0

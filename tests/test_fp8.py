@@ -151,6 +151,112 @@ def test_fp8_hardware_probe_kinds():
         assert _tpu_kind_has_fp8(kind) is want, kind
 
 
+# ---------------------------------------------------------------------------
+# delayed scaling: fp8_state rides TrainState (ISSUE 17 tentpole leg 1)
+# ---------------------------------------------------------------------------
+
+
+def test_fp8_recipe_kwargs_env_and_validation(monkeypatch):
+    from accelerate_tpu import FP8RecipeKwargs
+
+    assert FP8RecipeKwargs().amax_history_len == 16  # TE default
+    monkeypatch.setenv("ACCELERATE_FP8_AMAX_HISTORY_LEN", "32")
+    monkeypatch.setenv("ACCELERATE_FP8_MARGIN", "2")
+    r = FP8RecipeKwargs()
+    assert r.amax_history_len == 32 and r.margin == 2
+    assert FP8RecipeKwargs(amax_history_len=8).amax_history_len == 8  # explicit wins
+    with pytest.raises(ValueError, match="amax_history_len"):
+        FP8RecipeKwargs(amax_history_len=0)
+    with pytest.raises(ValueError, match="margin"):
+        FP8RecipeKwargs(margin=-1)
+    with pytest.raises(ValueError, match="amax_compute_algo"):
+        FP8RecipeKwargs(amax_compute_algo="mean")
+
+
+def test_fp8_state_rides_train_state_and_checkpoints(tmp_path):
+    """The delayed-scaling amax histories are TrainState citizens: sized by
+    the FP8RecipeKwargs recipe, seeded with each kernel's current amax,
+    rolled once per optimizer step (TE DelayedScaling contract), and they
+    survive a save_state/load_state roundtrip."""
+    import optax as _optax
+
+    from accelerate_tpu import FP8RecipeKwargs
+    from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=str(tmp_path), automatic_checkpoint_naming=True
+        ),
+        mixed_precision="fp8",
+        kwargs_handlers=[FP8RecipeKwargs(amax_history_len=4, margin=1)],
+    )
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    state = acc.create_train_state(params, _optax.adamw(1e-3), apply_fn=model.apply)
+    assert state.fp8_state is not None
+    # snapshot with a REAL copy: the jitted step donates the state's
+    # buffers, and on CPU np.asarray aliases them zero-copy — a donated
+    # buffer would mutate the "snapshot" in place
+    hists = [np.array(x, copy=True)
+             for x in jax.tree_util.tree_leaves(state.fp8_state)
+             if getattr(x, "ndim", 0) == 1]
+    assert hists and all(h.shape == (4,) for h in hists)  # recipe honored
+    # seeded with the kernel's current amax: step 0 quantizes with exactly
+    # the current-scaling scale
+    assert all(float(h[0]) > 0 for h in hists)
+
+    step = acc.prepare_train_step(make_llama_loss_fn(model), max_grad_norm=1.0)
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(toks), "labels": jnp.asarray(toks)}
+    state2, _ = step(state, batch)
+    new_hists = [x for x in jax.tree_util.tree_leaves(state2.fp8_state)
+                 if getattr(x, "ndim", 0) == 1]
+    # one tick: the history rolled, slot 1 now carries the seed amax
+    for old, new in zip(hists, new_hists):
+        assert float(new[1]) == float(old[0])
+        assert float(new[0]) > 0
+
+    ckpt = acc.save_state(train_state=state2)
+    template = acc.create_train_state(
+        model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)),
+        _optax.adamw(1e-3), apply_fn=model.apply,
+    )
+    restored = acc.load_state(ckpt, train_state=template)
+    for a, b in zip(jax.tree_util.tree_leaves(restored.fp8_state),
+                    jax.tree_util.tree_leaves(state2.fp8_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+
+
+def test_fp8_ops_pass_gl110_scaling_audit():
+    """Clean sweep: the repo's own fp8 matmuls carry their descale through
+    the GL110 jaxpr audit (every fp8 dot's output feeds a mul/div by the
+    combined scale before any other consumer)."""
+    from accelerate_tpu.analysis.jaxpr_audit import audit_traced
+    from accelerate_tpu.ops.fp8 import fp8_delayed_dot
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 64)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(size=(64, 32)), jnp.bfloat16)
+    meta = Fp8Meta.init(4).updated(jnp.float32(2.0), 448.0, 0)
+    reports = {
+        "current": audit_traced(
+            jax.jit(lambda a, b: fp8_current_scaled_dot(a, b)).trace(x, w)),
+        "delayed": audit_traced(
+            jax.jit(lambda a, b: fp8_delayed_dot(a, b, meta)).trace(x, w)),
+        "delayed_grad": audit_traced(jax.jit(jax.grad(
+            lambda a, b: jnp.sum(fp8_delayed_dot(a, b, meta).astype(jnp.float32))
+        )).trace(x, w)),
+    }
+    for name, rep in reports.items():
+        hits = [f for f in rep.findings if f.rule == "GL110"]
+        assert not hits, (name, [f.message for f in hits])
+
+
 @pytest.mark.slow
 def test_fp8_training_tracks_bf16():
     """mixed_precision="fp8" trains the tiny Llama to parity-class loss with

@@ -155,6 +155,90 @@ def test_paged_flash_decode_matches_gather_reference():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def _quantized_pool(rng, hkv, num_pages, page, d):
+    """Emulate the write path's per-(head, page) int8 quantization."""
+    f = rng.normal(size=(hkv, num_pages, page, d)).astype(np.float32)
+    amax = np.abs(f).max(axis=(2, 3))                        # [Hkv, P]
+    codes = np.rint(f * (127.0 / amax[:, :, None, None]))
+    return (jnp.asarray(np.clip(codes, -127, 127), jnp.int8),
+            jnp.asarray(amax, jnp.float32))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_paged_multitoken_matches_cached_reference(kv_dtype):
+    """Interpret-mode parity for the multi-token Pallas kernel at the
+    speculative-verify width T = k+1, ragged positions, dense AND
+    quantized pools: kernel == gather(+dequant) + dense cached attention
+    with per-row causal masking."""
+    from accelerate_tpu.ops.flash_attention import paged_multitoken_attention
+
+    rng = np.random.default_rng(0)
+    hkv, num_pages, page, d, slots, n, h, width = 2, 16, 8, 32, 4, 4, 4, 4
+    if kv_dtype:
+        kp, ks = _quantized_pool(rng, hkv, num_pages, page, d)
+        vp, vs = _quantized_pool(rng, hkv, num_pages, page, d)
+    else:
+        kp = jnp.asarray(rng.normal(size=(hkv, num_pages, page, d)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(hkv, num_pages, page, d)), jnp.float32)
+        ks = vs = None
+    bt = jnp.asarray(rng.permutation(num_pages)[: slots * n].reshape(slots, n), jnp.int32)
+    # per-slot verify windows starting at ragged depths (last one ends at
+    # the pool's final token, exercising the page-skip predicate edge)
+    pos = jnp.asarray([0, 5, 17, 28], jnp.int32)[:, None] + jnp.arange(width)[None]
+    q = jnp.asarray(rng.normal(size=(slots, width, h, d)), jnp.float32)
+    out = paged_multitoken_attention(q, kp, vp, bt, pos, k_scales=ks, v_scales=vs)
+    k_lin, v_lin, kvpos = paged_gather_kv(kp, vp, bt, ks, vs, kv_dtype, jnp.float32)
+    ref = cached_attention(q, k_lin, v_lin, kvpos, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_fused_bgmv_paged_decode_matches_composed_reference(kv_dtype):
+    """The consolidated LoRA-query + paged-decode kernel == the two-trip
+    composition it replaces: bgmv adapter delta, roped at the slot's
+    position, added to the pre-roped base query, then paged decode."""
+    from accelerate_tpu.models.llama import apply_rope, rope_frequencies
+    from accelerate_tpu.ops.flash_attention import (
+        fused_bgmv_paged_decode,
+        paged_decode_attention,
+    )
+
+    rng = np.random.default_rng(1)
+    hkv, num_pages, page, d, slots, n, h = 2, 16, 8, 32, 4, 4, 4
+    d_in, rank, n_adapters = 48, 4, 3
+    if kv_dtype:
+        kp, ks = _quantized_pool(rng, hkv, num_pages, page, d)
+        vp, vs = _quantized_pool(rng, hkv, num_pages, page, d)
+    else:
+        kp = jnp.asarray(rng.normal(size=(hkv, num_pages, page, d)), jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(hkv, num_pages, page, d)), jnp.float32)
+        ks = vs = None
+    bt = jnp.asarray(rng.permutation(num_pages)[: slots * n].reshape(slots, n), jnp.int32)
+    pos = jnp.asarray([0, 5, 17, 31], jnp.int32)
+    x = jnp.asarray(rng.normal(size=(slots, d_in)), jnp.float32)
+    q_base = jnp.asarray(rng.normal(size=(slots, h, d)), jnp.float32)
+    # AdapterStore pool layout: row 0 is the zero base slot
+    a_np = rng.normal(size=(n_adapters, d_in, rank)).astype(np.float32) * 0.1
+    b_np = rng.normal(size=(n_adapters, rank, h * d)).astype(np.float32) * 0.1
+    a_np[0] = 0.0
+    b_np[0] = 0.0
+    a_stack, b_stack = jnp.asarray(a_np), jnp.asarray(b_np)
+    ids = jnp.asarray([0, 1, 2, 1], jnp.int32)
+    cos, sin = map(jnp.asarray, rope_frequencies(d, 64, 10000.0))
+
+    out = fused_bgmv_paged_decode(x, q_base, a_stack, b_stack, ids, cos, sin,
+                                  kp, vp, bt, pos, k_scales=ks, v_scales=vs)
+    # composed reference: per-slot bgmv, rope the delta, add, paged decode
+    delta = jnp.einsum("sr,srm->sm", jnp.einsum("si,sir->sr", x, a_stack[ids]),
+                       b_stack[ids]).reshape(slots, h, d)
+    delta = apply_rope(delta[:, None], cos, sin, pos[:, None])[:, 0]
+    ref = paged_decode_attention(q_base + delta, kp, vp, bt, pos,
+                                 k_scales=ks, v_scales=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+
 # ---------------------------------------------------------------------------
 # the acceptance pin: serving tokens == generate() tokens
 # ---------------------------------------------------------------------------

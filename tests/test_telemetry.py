@@ -271,6 +271,19 @@ def test_all_standard_twins_register_from_their_accounting_sites():
     reg.record_measured("transfer.page_bytes", 256,
                         source="serving/transfer.PagedKVTransport")
 
+    # 18. quantized KV page bytes (serving/paged_cache + engine): the
+    # accounting records the predicted codes+scales arithmetic; the
+    # engine's allocated-pool nbytes stands in for the measured side
+    from accelerate_tpu.serving.paged_cache import (
+        kv_page_bytes,
+        kv_pool_accounting,
+    )
+
+    kv_pool_accounting(_Cfg(), 8, 4, 2, kv_dtype="int8")
+    reg.record_measured("kv_quant.page_bytes",
+                        kv_page_bytes(_Cfg(), 4, 2, "int8"),
+                        source="serving/engine.ServingEngine")
+
     rows = reg.drift_report()
     for name in STANDARD_TWINS:
         assert name in rows, name
@@ -278,8 +291,11 @@ def test_all_standard_twins_register_from_their_accounting_sites():
     for paired in ("dcn_comm.dcn_bytes", "kv_pool.utilization",
                    "adapter_pool.hit_rate", "goodput.goodput_frac",
                    "compiles.steady_state", "speculate.accept_rate",
-                   "speculate.tokens_per_step"):
+                   "speculate.tokens_per_step", "kv_quant.page_bytes"):
         assert rows[paired]["status"] != "idle", (paired, rows[paired])
+    # predicted and measured route through the same kv_page_bytes
+    # arithmetic — exact by construction (tolerance 0.0)
+    assert rows["kv_quant.page_bytes"]["status"] == "ok"
     # dcn predicted (psum slab model) vs the traced psum agree exactly:
     # 4 fp32 = 16 bytes * ring factor 1.0 on both sides of a 2-slice tree
     # of 64 fp32... the MODELS differ (tree vs traced fn) so only pairing,
