@@ -38,15 +38,27 @@ quality:
 	python -m pytest tests/test_example_drift.py tests/test_docs.py -q
 
 # graft-lint: AST rule sweep of the tree + jaxpr audit of the canonical
-# train step (docs/static_analysis.md).  Non-zero exit on any unsuppressed
-# error-severity finding — wire it ahead of `make test` in CI.
+# train step + distributed pair audit (docs/static_analysis.md).  Non-zero
+# exit on any unsuppressed error-severity finding — wire it ahead of
+# `make test` in CI.  The second command re-runs with --json and proves
+# the report round-trips losslessly (Report.from_json re-renders
+# identically) so downstream tooling can consume the artifact.
 lint:
 	JAX_PLATFORMS=cpu python -m accelerate_tpu lint
+	@JAX_PLATFORMS=cpu python -m accelerate_tpu lint --json > /tmp/graft-lint.json; \
+	rc=$$?; [ $$rc -eq 0 ] || exit $$rc; \
+	JAX_PLATFORMS=cpu python -c "import json, pathlib; \
+from accelerate_tpu.analysis import Report; \
+text = pathlib.Path('/tmp/graft-lint.json').read_text(); \
+rep = Report.from_json(text); \
+assert json.loads(rep.to_json()) == json.loads(text), 'lint --json did not round-trip'; \
+print(f'lint --json round-trip ok ({len(rep.findings)} findings)')"
 
 # deploy preflight: the lint sweep + AOT compile of every production
 # program (train step + the serving bucket ladder) + the compiled-artifact
-# audit (GL301-GL303; docs/static_analysis.md "Deploy preflight").  The
-# go-live order is lint -> preflight -> warm cache -> take traffic
+# audit (GL301-GL303) + the trace-only distributed pair audit
+# (GL401-GL404; docs/static_analysis.md "Deploy preflight").  The go-live
+# order is lint -> preflight -> warm both roles -> take traffic
 # (docs/serving.md).
 preflight:
-	JAX_PLATFORMS=cpu python -m accelerate_tpu preflight --train --serve
+	JAX_PLATFORMS=cpu python -m accelerate_tpu preflight --train --serve --disaggregate

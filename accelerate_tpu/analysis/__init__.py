@@ -20,6 +20,12 @@ device):
   not alias (GL301), HBM footprint over budget (GL302), compiled program
   set vs the predicted bucket ladder (GL303), plus the flops/bytes cost
   report and the runtime compile-event counter.
+- :mod:`.distributed_audit` — cross-program, cross-role contracts over
+  PAIRS/SETS of programs (trace-only, zero compiles): collective-schedule
+  divergence between mesh roles (GL401), implicit-reshard blowups
+  (GL402), prefill/decode wire-schema incompatibility (GL403), and
+  role-asymmetric warmup coverage (GL404) — the ``preflight --serve
+  --disaggregate`` pair gate and the multichip dryrun's distributed leg.
 
 Surfaces: ``python -m accelerate_tpu lint`` / ``preflight``
 (``commands/lint.py``, ``commands/preflight.py``),
@@ -45,11 +51,27 @@ from .compiled_audit import (
     device_hbm_bytes,
     install_global_compile_counter,
 )
+from .distributed_audit import (
+    CollectiveOp,
+    audit_collective_schedules,
+    audit_compiled_resharding,
+    audit_resharding,
+    audit_warmup_coverage,
+    audit_wire_schema,
+    check_wire_schemas,
+    collective_schedule,
+    handoff_schedule,
+    pair_preflight,
+    role_programs,
+    warmup_plan,
+    wire_schema,
+)
 from .jaxpr_audit import audit_fn, audit_jitted, audit_traced, iter_eqns
 from .report import Finding, Report, Severity, apply_suppressions, parse_marker
 from .rules import RULES, Rule, rule
 
 __all__ = [
+    "CollectiveOp",
     "CompileCounter",
     "DEFAULT_EXCLUDE_DIRS",
     "DEFAULT_EXCLUDES",
@@ -61,18 +83,30 @@ __all__ = [
     "aot_compile_program",
     "apply_suppressions",
     "audit_aot",
+    "audit_collective_schedules",
     "audit_compiled",
+    "audit_compiled_resharding",
     "audit_fn",
     "audit_jitted",
     "audit_program_set",
+    "audit_resharding",
     "audit_traced",
+    "audit_warmup_coverage",
+    "audit_wire_schema",
+    "check_wire_schemas",
+    "collective_schedule",
     "device_hbm_bytes",
+    "handoff_schedule",
     "install_global_compile_counter",
     "iter_eqns",
     "iter_python_files",
     "lint_paths",
     "lint_source",
+    "pair_preflight",
     "parse_marker",
     "resolve_targets",
+    "role_programs",
     "rule",
+    "warmup_plan",
+    "wire_schema",
 ]

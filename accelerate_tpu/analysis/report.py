@@ -23,8 +23,10 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import io
 import json
 import re
+import tokenize
 from pathlib import Path
 from typing import Iterable, Optional
 
@@ -75,6 +77,14 @@ class Finding:
         d["severity"] = self.severity.name
         return d
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        """Inverse of :meth:`to_dict` — ``from_dict(f.to_dict()) == f``,
+        so a JSON report round-trips losslessly (the CI contract)."""
+        d = dict(d)
+        d["severity"] = Severity.parse(d["severity"])
+        return cls(**d)
+
 
 # ``# graft-lint: disable=GL101 -- why this is fine`` (one or more comma-
 # separated rule ids; the rationale after ``--`` is what keeps suppressions
@@ -114,19 +124,65 @@ def _markers_for_file(path: str, _cache: dict) -> dict:
     return markers
 
 
+def _stmt_starts_for_file(path: str, _cache: dict) -> dict:
+    """line number -> first line of the logical statement it belongs to,
+    for every line of a multi-line statement in ``path``.
+
+    Jaxpr findings anchor at the equation's ``source_info`` line, which for
+    a statement wrapped across several lines can be a CONTINUATION line —
+    while the author's suppression marker naturally sits on (or above) the
+    statement's FIRST line.  This map lets :func:`apply_suppressions`
+    normalize the finding back to the statement start so the marker is
+    honored.  Tokenize-based: ``NEWLINE`` tokens terminate logical lines,
+    ``NL`` tokens (blank/continuation breaks) do not."""
+    if path in _cache:
+        return _cache[path]
+    mapping: dict = {}
+    try:
+        text = Path(path).read_text()
+    except OSError:
+        _cache[path] = mapping
+        return mapping
+    skip = (tokenize.NL, tokenize.COMMENT, tokenize.INDENT, tokenize.DEDENT,
+            tokenize.ENDMARKER)
+    try:
+        start = None
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type in skip:
+                continue
+            if tok.type == tokenize.NEWLINE:
+                start = None
+                continue
+            if start is None:
+                start = tok.start[0]
+            for lineno in range(tok.start[0], tok.end[0] + 1):
+                mapping.setdefault(lineno, start)
+    except (tokenize.TokenError, SyntaxError, IndentationError):
+        pass  # an unparseable file falls back to exact-line matching
+    _cache[path] = mapping
+    return mapping
+
+
 def apply_suppressions(findings: Iterable[Finding]) -> list[Finding]:
     """Resolve inline markers: mark matching findings suppressed, and emit a
     GL001 finding for every marker that omits its rationale.  A marker
     suppresses findings on its own line and the line below (so it can sit
-    above a long expression)."""
+    above a long expression).  A finding anchored on a CONTINUATION line of
+    a multi-line statement is normalized to the statement's first line, so
+    a marker there (or directly above) still suppresses it."""
     findings = list(findings)
     cache: dict = {}
+    stmt_cache: dict = {}
     bare_marker_sites: set = set()
     for f in findings:
         if f.path is None or f.line is None:
             continue
         markers = _markers_for_file(f.path, cache)
-        for lineno in (f.line, f.line - 1):
+        candidates = [f.line, f.line - 1]
+        stmt_start = _stmt_starts_for_file(f.path, stmt_cache).get(f.line)
+        if stmt_start is not None and stmt_start != f.line:
+            candidates += [stmt_start, stmt_start - 1]
+        for lineno in candidates:
             entry = markers.get(lineno)
             if entry is None:
                 continue
@@ -220,3 +276,11 @@ class Report:
             {"findings": [f.to_dict() for f in self.findings], "summary": self.summary()},
             indent=2,
         )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Report":
+        """Inverse of :meth:`to_json`: a serialized report reloads into an
+        equal Report — same findings, same summary, identical re-render
+        (the ``make lint`` / preflight-CLI round-trip check)."""
+        payload = json.loads(text)
+        return cls(Finding.from_dict(d) for d in payload.get("findings", ()))

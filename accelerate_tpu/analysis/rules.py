@@ -9,7 +9,11 @@ reuse, impure calls the trace would bake silently), GL3xx
 compiled/recompile rules (hazards visible only in the lowered XLA
 executable — did the donation actually alias, does the footprint fit —
 plus the trace- and source-level shapes that cause mid-traffic
-recompiles).  ``docs/static_analysis.md`` renders this table;
+recompiles), GL4xx distributed rules (cross-program, cross-role contracts
+— collective schedules, reshard blowups, wire schemas, warmup coverage —
+audited over PAIRS/SETS of programs by
+:mod:`.distributed_audit`).  ``docs/static_analysis.md`` renders this
+table (generated from this registry by ``docs/gen_api.py``);
 ``tests/test_analysis.py`` pins that every finding any engine can emit
 carries an id registered here.
 """
@@ -26,7 +30,7 @@ class Rule:
     id: str
     name: str
     severity: Severity
-    engine: str  # "jaxpr" | "ast" | "meta" | "compiled"
+    engine: str  # "jaxpr" | "ast" | "meta" | "compiled" | "distributed"
     summary: str
     fix_hint: str
 
@@ -266,6 +270,66 @@ RULES: dict[str, Rule] = {
             "pad inputs to a fixed bucket ladder before the jit boundary "
             "(ServingPlugin.prefill_buckets is the model), or mark the "
             "driving argument static (static_argnums/static_argnames)",
+        ),
+        # ------------------------------------------------------------------
+        # distributed engine (GL401-404): cross-program, cross-role
+        # contracts — what the multi-host fabric would discover at launch
+        # time, proven (or refuted) before any process spawns
+        # ------------------------------------------------------------------
+        Rule(
+            "GL401", "collective-schedule-mismatch", Severity.ERROR,
+            "distributed",
+            "two mesh roles' traced programs disagree on the ordered "
+            "collective schedule (op, axis names, or payload bytes at some "
+            "rendezvous index): a launched gang meets mismatched "
+            "collectives at that index and deadlocks — or silently "
+            "corrupts the reduction.  Collectives under lax.cond execute "
+            "data-dependently and are reported, not proven (the "
+            "documented miss)",
+            "make every role trace the identical collective sequence: one "
+            "shared step builder per gang (parallel/hierarchical.py's "
+            "hierarchical_sync is the model), no role-conditional "
+            "collectives outside lax.cond branches every role shares",
+        ),
+        Rule(
+            "GL402", "implicit-reshard-blowup", Severity.WARNING,
+            "distributed",
+            "a >= 1 MiB tensor pinned to one sharding and re-pinned to a "
+            "different one (or fed back as an input with a drifted "
+            "compiled sharding): GSPMD materializes an un-requested "
+            "all-gather + re-slice between the pins — extra interconnect "
+            "bytes no comm accounting model (dcn_comm_accounting / "
+            "tp_comm_accounting) counts",
+            "make consecutive sharding pins agree (or drop the redundant "
+            "inner pin); for step feedback, pin the output to the input's "
+            "sharding so the loop is reshard-free",
+        ),
+        Rule(
+            "GL403", "wire-schema-incompatibility", Severity.ERROR,
+            "distributed",
+            "the prefill-role and decode-role engines derive different "
+            "static wire schemas for the KV page handoff (page geometry, "
+            "kv_dtype codes+scales, payload shapes/dtypes, per-page "
+            "bytes, prefix/adapter conventions): the decode side scatters "
+            "the payload into a pool that cannot parse it — KV corruption "
+            "at the first handoff",
+            "deploy both roles from one ServingPlugin geometry (page_size, "
+            "pages_per_slot, kv_dtype must agree; see "
+            "analysis/distributed_audit.wire_schema) — the same check the "
+            "transport enforces at runtime, moved before launch",
+        ),
+        Rule(
+            "GL404", "role-asymmetric-warmup", Severity.WARNING,
+            "distributed",
+            "a role's warmed program set does not cover the programs the "
+            "pair schedule can dispatch to it: the first dispatch of a "
+            "cold program is a guaranteed mid-traffic compile on that "
+            "role (the strict_compiles contract, checked statically per "
+            "role)",
+            "warm every dispatchable program per role "
+            "(ServingEngine.warmup() + PagedKVTransport.warmup(); "
+            "analysis/distributed_audit.role_programs is the ground "
+            "truth), or remove the program from the role's schedule",
         ),
         Rule(
             "GL306", "jit-in-hot-loop", Severity.WARNING, "ast",

@@ -5,7 +5,11 @@ minus the intentionally-buggy ``tests/analysis_fixtures``); the jaxpr
 auditor traces a canonical tiny train step through the real
 ``Accelerator.prepare_train_step`` machinery — same donation, pinning, and
 optimizer plumbing as production, CPU-safe, nothing executes on device —
-so the hot-path invariants are checked on every ``make lint``.
+so the hot-path invariants are checked on every ``make lint``; and the
+static slice of the distributed-contract audit (GL401/GL403/GL404 over the
+serving pair's wire schema, handoff schedule, and per-role warmup
+coverage) rides along so a role-incompatible geometry fails lint before it
+fails a launch (``--no-distributed`` opts out).
 
 Exit code 1 when any unsuppressed finding at or above ``--fail-on``
 severity (default: error) remains.
@@ -46,6 +50,12 @@ def lint_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument(
         "--optimizer", default="lion",
         help="optimizer recipe for the canonical step audit (default: lion)",
+    )
+    parser.add_argument(
+        "--no-distributed", action="store_true",
+        help="skip the distributed-contract sweep (GL401/GL403/GL404 over "
+             "the serving pair's wire schema, handoff schedule, and "
+             "per-role warmup coverage)",
     )
     if subparsers is not None:
         parser.set_defaults(func=lint_command)
@@ -90,12 +100,31 @@ def audit_canonical_step(optimizer: str = "lion"):
     return acc.audit_step(step, state, batch, log=False)
 
 
+def audit_distributed_contracts():
+    """The static (no-trace) slice of the GL4xx pair audit, cheap enough
+    for every ``make lint``: wire-schema agreement (GL403), the handoff's
+    collective schedule (GL401), and per-role warmup coverage (GL404) over
+    the dryrun legs' entry-point geometry — the same ``ACCELERATE_SERVE_*``
+    env family the multichip dryrun launches with.  The traced-wire GL402
+    pass stays on ``preflight --serve --disaggregate``."""
+    from .preflight import _prefill_role_plugin, _serve_setup
+    from ..analysis.distributed_audit import pair_preflight
+
+    cfg, plugin, _ = _serve_setup()
+    findings, _summary = pair_preflight(
+        cfg, _prefill_role_plugin(plugin), plugin, trace_wire=False
+    )
+    return findings
+
+
 def lint_command(args) -> None:
     from ..analysis import Report, Severity, lint_paths
 
     report: Report = lint_paths(args.paths)
     if not args.no_step_audit:
         report.extend(audit_canonical_step(args.optimizer).findings)
+    if not getattr(args, "no_distributed", False):
+        report.extend(audit_distributed_contracts())
 
     if args.json:
         print(report.to_json())

@@ -66,6 +66,15 @@ def preflight_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "per speculate bucket when ACCELERATE_SERVE_SPECULATE is on)",
     )
     parser.add_argument(
+        "--disaggregate", action="store_true",
+        help="with --serve: audit the prefill-role / decode-role pair as a "
+             "unit (GL401-GL404 — wire schema, handoff schedule, traced "
+             "wire programs, per-role warmup coverage).  The prefill role "
+             "starts from the same ACCELERATE_SERVE_* geometry and applies "
+             "ACCELERATE_SERVE_PREFILL_{PAGE_SIZE,PAGES_PER_SLOT,KV_DTYPE} "
+             "overrides on top.  Trace-only: adds zero backend compiles",
+    )
+    parser.add_argument(
         "--train", action="store_true",
         help="preflight the canonical train step (the real "
              "prepare_train_step machinery, donation on; --optimizer "
@@ -116,10 +125,16 @@ def _audit_program(prog, config: PreflightConfig, hbm_budget_bytes=None):
     handle precisely so the jaxpr audit rides the same single trace):
     GL1xx/GL304 off ``prog.traced``, GL301/GL302 + the cost row off
     ``prog.compiled``.  Returns ``(findings, [row])``."""
-    from ..analysis import audit_compiled, audit_traced
+    from ..analysis import audit_compiled, audit_compiled_resharding, audit_traced
 
     findings = list(
         audit_traced(prog.traced, path_hint=prog.path_hint).findings
+    )
+    # GL402 compiled side: XLA's actual input/output sharding decisions,
+    # read off the executable's metadata (quiet when the backend exposes
+    # none — single-device CPU runs)
+    findings += audit_compiled_resharding(
+        prog.compiled, label=prog.label, path_hint=prog.path_hint
     )
     f, row = audit_compiled(
         prog.compiled, label=prog.label, hbm_budget_bytes=hbm_budget_bytes,
@@ -269,6 +284,50 @@ def preflight_serve(config: PreflightConfig, hbm_budget_bytes=None,
     return findings, rows
 
 
+def _prefill_role_plugin(decode_plugin):
+    """The prefill-role geometry for the pair audit: the decode role's
+    plugin with ``ACCELERATE_SERVE_PREFILL_{PAGE_SIZE,PAGES_PER_SLOT,
+    KV_DTYPE}`` overrides applied on top.  With no overrides set the two
+    roles share one geometry — the in-tree :class:`DisaggregatedPair`
+    shape — and the pair audit is expected green."""
+    import dataclasses
+    import os
+
+    overrides = {}
+    for field, env, cast in (
+        ("page_size", "ACCELERATE_SERVE_PREFILL_PAGE_SIZE", int),
+        ("pages_per_slot", "ACCELERATE_SERVE_PREFILL_PAGES_PER_SLOT", int),
+        ("kv_dtype", "ACCELERATE_SERVE_PREFILL_KV_DTYPE", str),
+    ):
+        raw = os.environ.get(env, "")
+        if raw:
+            overrides[field] = cast(raw)
+    if not overrides:
+        return decode_plugin
+    return dataclasses.replace(decode_plugin, **overrides)
+
+
+def preflight_disaggregate(config: PreflightConfig, model_config=None,
+                           plugin=None, prefill_plugin=None):
+    """The GL4xx pair audit of a disaggregated prefill→decode deployment:
+    wire-schema agreement (GL403), the handoff's collective schedule
+    (GL401), the traced wire programs' sharding pins (GL402), and each
+    role's warmup coverage of its dispatchable set (GL404).
+
+    Trace-only — ``jax.jit(...).trace`` + ``eval_shape`` — so it adds
+    ZERO backend compiles to the preflight and sits outside the tier-1
+    compile budget.  Returns ``(findings, summary)``."""
+    from ..analysis.distributed_audit import pair_preflight
+
+    if model_config is None or plugin is None:
+        cfg, env_plugin, _ = _serve_setup()
+        model_config = model_config or cfg
+        plugin = plugin or env_plugin
+    if prefill_plugin is None:
+        prefill_plugin = _prefill_role_plugin(plugin)
+    return pair_preflight(model_config, prefill_plugin, plugin)
+
+
 def _parse_program_spec(spec: str):
     parts = spec.split("::")
     if len(parts) < 2:
@@ -332,8 +391,10 @@ def preflight_command(args) -> None:
     if not args.no_lint:
         findings += lint_paths(args.paths).findings
     flavors = []
-    run_train = args.train or not (args.serve or args.train or args.program)
-    run_serve = args.serve or not (args.serve or args.train or args.program)
+    explicit = (args.serve or args.train or args.program
+                or getattr(args, "disaggregate", False))
+    run_train = args.train or not explicit
+    run_serve = args.serve or not explicit
     if run_train:
         f, r = preflight_train(config, budget)
         findings += f
@@ -344,6 +405,11 @@ def preflight_command(args) -> None:
         findings += f
         rows += r
         flavors.append("serve")
+    distributed = None
+    if getattr(args, "disaggregate", False):
+        f, distributed = preflight_disaggregate(config)
+        findings += f
+        flavors.append("disaggregate")
     for spec in args.program:
         f, r = preflight_program(spec, config, budget)
         findings += f
@@ -352,15 +418,30 @@ def preflight_command(args) -> None:
 
     report = Report(apply_suppressions(findings))
     if args.json:
-        print(json.dumps({
+        payload = {
             "flavors": flavors,
             "hbm_budget_bytes": budget,
             "programs": rows,
             "findings": [f.to_dict() for f in report.findings],
             "summary": report.summary(),
-        }, indent=2))
+        }
+        if distributed is not None:
+            payload["distributed"] = distributed
+        print(json.dumps(payload, indent=2))
     else:
         print(report.render(show_suppressed=args.show_suppressed))
+        if distributed is not None:
+            roles = distributed.get("roles", {})
+            print(
+                "preflight pair: schema_ok="
+                f"{distributed.get('schema_ok')} kv_dtype="
+                f"{distributed.get('kv_dtype')} wire_legs="
+                f"{len(distributed.get('wire_legs', []))} "
+                + " ".join(
+                    f"{role}[warmed={r['warmed']} dispatch={r['dispatchable']}]"
+                    for role, r in roles.items()
+                )
+            )
         for row in rows:
             hbm = row.get("hbm") or {}
             print(

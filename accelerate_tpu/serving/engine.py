@@ -773,6 +773,16 @@ class ServingEngine:
         self.warmed_up = True
         return self._compile_counter.count - before
 
+    def warmup_programs(self) -> frozenset:
+        """The static set of program labels :meth:`warmup` compiles for
+        this engine's plugin — the same ``warmup_plan`` derivation the
+        GL404 pair audit checks dispatch coverage against
+        (``analysis/distributed_audit.py``), exposed on the engine so the
+        runtime warmup and the preflight gate read one source of truth."""
+        from ..analysis.distributed_audit import warmup_plan
+
+        return warmup_plan(self.plugin, adapters=self.adapters is not None)
+
     @property
     def compile_events(self) -> int:
         """Real XLA backend compiles observed since this engine was built

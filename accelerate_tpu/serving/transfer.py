@@ -164,34 +164,34 @@ class PagedKVTransport:
     scheduler's determinism log (the ``page_transfer`` span)."""
 
     def __init__(self, src: ServingEngine, dst: ServingEngine):
-        ps, pd = src.plugin, dst.plugin
-        if (ps.page_size, ps.pages_per_slot) != (pd.page_size, pd.pages_per_slot):
-            raise ValueError(
-                "prefill/decode page geometry must match for the in-process "
-                f"handoff: src=({ps.page_size}, {ps.pages_per_slot}) vs "
-                f"dst=({pd.page_size}, {pd.pages_per_slot})"
-            )
-        src_kvd = getattr(ps, "kv_dtype", "") or "bf16"
-        dst_kvd = getattr(pd, "kv_dtype", "") or "bf16"
-        if src_kvd != dst_kvd:
-            raise ValueError(
-                "prefill/decode KV page dtypes must match for the handoff "
-                "(the wire payload is the raw page codes + scales): "
-                f"src={src_kvd!r} vs dst={dst_kvd!r}"
-            )
+        # one schema derivation for gate and runtime: the GL403 preflight
+        # (analysis/distributed_audit.audit_wire_schema) and this runtime
+        # rejection read the SAME wire_schema() dict, so they cannot drift
+        # — a pair the gate passed constructs, a pair it failed raises here
+        from ..analysis.distributed_audit import check_wire_schemas, wire_schema
+
+        ps = src.plugin
+        schema_src = wire_schema(src.model.config, ps)
+        schema_dst = wire_schema(dst.model.config, dst.plugin)
+        check_wire_schemas(schema_src, schema_dst)
         self.src, self.dst = src, dst
-        quantized = src_kvd in ("int8", "fp8")
+        self.schema = schema_src
         self._send, self._recv = _transfer_fns(
-            (ps.page_size, ps.pages_per_slot, src_kvd)
+            (ps.page_size, ps.pages_per_slot, schema_src["kv_dtype"])
         )
-        cfg = src.model.config
-        self._page_bytes = page_bytes(
-            cfg, ps.page_size, jnp.dtype(cfg.dtype).itemsize,
-            kv_dtype=src_kvd if quantized else "",
-        )
+        self._page_bytes = schema_src["page_bytes"]
         self.transfers = 0
         self.pages_moved = 0
         self.bytes_moved = 0
+        from ..telemetry import twin_registry
+
+        # the static-vs-runtime wire-unit twin: pair_preflight records the
+        # predicted side from the schema alone; this is the measured side
+        # off the constructed transport
+        twin_registry().record_measured(
+            "distributed.wire_bytes_per_page", self._page_bytes,
+            source="serving/transfer.PagedKVTransport",
+        )
 
     def warmup(self) -> None:
         """Compile both wire programs before traffic (no-op passes: the
@@ -279,6 +279,22 @@ class DisaggregatedPair:
         )
         self.transport = PagedKVTransport(self.prefill_engine,
                                           self.decode_engine)
+
+    def preflight(self) -> tuple[list, dict]:
+        """Run the GL4xx pair audit (wire schema, handoff schedule, traced
+        wire programs, per-role warmup coverage) over this pair's configs.
+
+        Trace-only — zero backend compiles — so it is safe to call before
+        :meth:`warmup`; the dryrun's ``_distributed_audit_leg`` and
+        ``preflight --serve --disaggregate`` both route through here."""
+        from ..analysis.distributed_audit import pair_preflight
+
+        return pair_preflight(
+            self.prefill_engine.model.config,
+            self.prefill_engine.plugin,
+            self.decode_engine.plugin,
+            adapters=self.decode_engine.adapters is not None,
+        )
 
     def warmup(self) -> int:
         before = self.prefill_engine._compile_counter.count

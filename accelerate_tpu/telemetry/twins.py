@@ -100,6 +100,13 @@ STANDARD_TWINS: dict[str, tuple] = {
     # row, so the sides agree EXACTLY; tolerance 0.0 makes any drift
     # (a scale array the formula forgot, a dtype change) an error
     "kv_quant.page_bytes": ("bytes/page", 0.0, 0.0),
+    # analysis/distributed_audit.pair_preflight's static wire unit (the
+    # GL403 schema's page_bytes, predicted before any engine exists) vs
+    # the constructed PagedKVTransport's _page_bytes — gate and runtime
+    # read ONE wire_schema() derivation, so the sides agree EXACTLY;
+    # tolerance 0.0 turns any drift (the gate auditing a different schema
+    # than the transport enforces) into an error
+    "distributed.wire_bytes_per_page": ("bytes/page", 0.0, 0.0),
 }
 
 

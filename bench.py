@@ -916,6 +916,16 @@ def main():
             rep["extra"]["compiled_audit"] = {
                 **compiled_report.summary(), "programs": rows,
             }
+            # the distributed twin: the GL4xx pair audit of the serving
+            # handoff (wire schema + handoff schedule + warmup coverage),
+            # static slice only — trace-free, so the plan path stays cheap
+            from accelerate_tpu.commands.lint import audit_distributed_contracts
+
+            dist_findings = apply_suppressions(audit_distributed_contracts())
+            rep["extra"]["distributed_audit"] = {
+                **Report(dist_findings).summary(),
+                "rules": sorted({f.rule for f in dist_findings}),
+            }
         print(json.dumps(rep))
         return
 

@@ -1,5 +1,6 @@
 """Corrected twins of ``planted_ast_rules.py`` — graft-lint must stay
-quiet on every one of these."""
+quiet on every one of these (GL202 host syncs, GL203 shard_map import,
+GL204 impure calls under trace)."""
 
 import time
 

@@ -1,0 +1,107 @@
+"""Corrected twins of ``planted_distributed.py`` — the same scenarios with
+the distributed contracts honored, so every GL4xx rule stays quiet.
+
+GL401: both roles run the SAME collective schedule.  GL402: the pipeline
+re-states the SAME sharding (idempotent pin — no materialized reshard).
+GL403: both roles derive identical wire schemas.  GL404: the warmed set
+covers everything the schedule can dispatch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map as _shard_map
+
+    _no_check = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _no_check = {"check_rep": False}
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("x",))
+
+
+def gl401_role_a(x):
+    """GL401-quiet side A: psum then all_gather."""
+    mesh = _mesh()
+
+    def body(xl):
+        s = jax.lax.psum(xl, "x")
+        return jax.lax.all_gather(s, "x", axis=0, tiled=True)
+
+    return _shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P(None),
+                      **_no_check)(x)
+
+
+def gl401_role_b(x):
+    """GL401-quiet side B: the SAME psum-then-all_gather order — every
+    rendezvous index pairs identical collectives, so the gang converges."""
+    mesh = _mesh()
+
+    def body(xl):
+        s = jax.lax.psum(xl, "x")
+        return jax.lax.all_gather(s, "x", axis=0, tiled=True)
+
+    return _shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P(None),
+                      **_no_check)(x)
+
+
+def gl401_schedules():
+    """Role→schedule map whose sides agree — ``audit_collective_schedules``
+    returns no findings."""
+    from accelerate_tpu.analysis.distributed_audit import collective_schedule
+
+    x = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    return {
+        "role_a": collective_schedule(jax.jit(gl401_role_a).trace(x)),
+        "role_b": collective_schedule(jax.jit(gl401_role_b).trace(x)),
+    }
+
+
+def gl402_double_pin_step(x):
+    """GL402-quiet: the second constraint re-states the SAME row sharding
+    — an idempotent pin materializes nothing, so no reshard is predicted."""
+    mesh = _mesh()
+    spec = NamedSharding(mesh, P("x", None))
+    y = jax.lax.with_sharding_constraint(x * 2.0, spec)
+    y = jax.lax.with_sharding_constraint(y, spec)
+    return y.sum()
+
+
+def gl403_schemas():
+    """GL403-quiet: both roles derive the schema from the same geometry
+    and kv_dtype — ``audit_wire_schema`` finds nothing to flag."""
+    from accelerate_tpu.analysis.distributed_audit import wire_schema
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    cfg = LlamaConfig.tiny()
+    prefill = ServingPlugin(num_slots=4, page_size=4, pages_per_slot=16,
+                            num_pages=40, kv_dtype="int8")
+    decode = ServingPlugin(num_slots=4, page_size=4, pages_per_slot=16,
+                           num_pages=40, kv_dtype="int8")
+    return wire_schema(cfg, prefill), wire_schema(cfg, decode)
+
+
+def gl404_coverage():
+    """GL404-quiet: the decode role's warmed set covers its full
+    dispatchable set — no mid-traffic compile is possible."""
+    warmed = {"decode", "release", "wire_recv"}
+    return "decode", warmed, {"decode", "release", "wire_recv"}
+
+
+def example_args():
+    """Concrete example inputs for the traceable clean functions."""
+    return {
+        "gl401_role_a": (jnp.ones((8, 8)),),
+        "gl401_role_b": (jnp.ones((8, 8)),),
+        "gl402_double_pin_step": (
+            jax.ShapeDtypeStruct((1024, 1024), jnp.float32),
+        ),
+    }

@@ -119,6 +119,34 @@ def unscaled_fp8_dot_step(x, w):
     return y * (1.0 / (x_scale * w_scale)) + 1.0
 
 
+def fused_decode_unscaled_kv_step(q, k_codes, v_codes, k_scale, v_scale):
+    """GL110 fixed (the fused-decode shape): the in-kernel dequant of
+    ``fused_bgmv_paged_decode`` modeled at the jaxpr level — scores carry
+    ``k_scale`` and the weighted sum carries ``v_scale`` before anything
+    downstream consumes them (the kv_qmax contract)."""
+    qk = (q * 448.0).astype(jnp.float8_e4m3fn)
+    scores = jax.lax.dot_general(qk, k_codes, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    scores = scores * (k_scale / 448.0)
+    out = jax.lax.dot_general(scores, v_codes.astype(jnp.float32),
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out * v_scale + 1.0
+
+
+def fused_verify_unscaled_kv_step(q_tokens, k_codes, v_codes, k_scale, v_scale):
+    """GL110 fixed (the multi-token verify shape): every contraction over
+    the quantized pages is rescaled before the residual add sees it."""
+    qk = (q_tokens * 448.0).astype(jnp.float8_e4m3fn)
+    scores = jax.lax.dot_general(qk, k_codes, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    scores = scores * (k_scale / 448.0)
+    out = jax.lax.dot_general(scores, v_codes.astype(jnp.float32),
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    return out * v_scale + q_tokens
+
+
 def flat_dcn_reduce_step(g):
     """GL108 fixed: the hierarchical decomposition — reduce-scatter inside
     the slice over ICI, all-reduce only the 1/p slab over dcn, all-gather
@@ -163,5 +191,15 @@ def example_args():
         "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
         "collective_matmul_rs_hint_step": (jnp.ones((1, 8, 16)), jnp.ones((16, 4))),
         "unscaled_fp8_dot_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
+        "fused_decode_unscaled_kv_step": (
+            jnp.ones((4, 16)), jnp.ones((8, 16), jnp.float8_e4m3fn),
+            jnp.ones((8, 16), jnp.float8_e4m3fn), jnp.float32(0.1),
+            jnp.float32(0.1),
+        ),
+        "fused_verify_unscaled_kv_step": (
+            jnp.ones((5, 16)), jnp.ones((8, 16), jnp.float8_e4m3fn),
+            jnp.ones((8, 16), jnp.float8_e4m3fn), jnp.float32(0.1),
+            jnp.float32(0.1),
+        ),
         "flat_dcn_reduce_step": (jax.ShapeDtypeStruct((4, 520, 520), jnp.float32),),
     }

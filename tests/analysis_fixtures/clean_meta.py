@@ -1,0 +1,15 @@
+"""Corrected twins of ``planted_meta.py`` / ``planted_engine_error.py``.
+
+GL001-quiet: the suppression marker carries its rationale, so the GL204 it
+silences is documented.  GL002-quiet: the module parses — the engine has
+nothing to report about its own run.
+"""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step_with_documented_marker(x):
+    return x * time.time()  # graft-lint: disable=GL204 -- fixture: wall-clock scaling is this twin's point

@@ -3,7 +3,7 @@
 The snapshot (a sharding-preserving jit identity copy, exactly what
 ``checkpointing._sharded_copy_fn`` does) is taken BEFORE the donating call,
 so the background writer reads buffers the step never owned.  The donated
-name is dead after the call site: graft-lint must stay quiet here.
+name is dead after the call site: GL201 must stay quiet here.
 """
 
 import threading

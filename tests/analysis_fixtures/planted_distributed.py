@@ -1,0 +1,114 @@
+"""PLANTED BUGS for the distributed auditor — one scenario per GL4xx rule.
+
+Unlike the GL1xx fixtures these are PAIRS/SETS: each scenario builds the
+two role-sides whose *combination* carries the hazard (each side alone is
+clean — exactly why the single-program engines can't see it).  The
+builders return whatever the matching ``distributed_audit`` entry point
+consumes; ``tests/test_analysis.py`` drives them.  Corrected twins:
+``clean_distributed.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map as _shard_map
+
+    _no_check = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _no_check = {"check_rep": False}
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("x",))
+
+
+def gl401_role_a(x):
+    """GL401 side A: psum THEN all_gather over axis 'x'."""
+    mesh = _mesh()
+
+    def body(xl):
+        s = jax.lax.psum(xl, "x")
+        return jax.lax.all_gather(s, "x", axis=0, tiled=True)
+
+    return _shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P(None),
+                      **_no_check)(x)
+
+
+def gl401_role_b(x):
+    """GL401 side B: all_gather THEN psum — the reversed rendezvous order.
+    A gang launched with role A on half the hosts and role B on the other
+    half meets a psum opposite an all_gather at rendezvous 0 and deadlocks."""
+    mesh = _mesh()
+
+    def body(xl):
+        g = jax.lax.all_gather(xl, "x", axis=0, tiled=True)
+        return jax.lax.psum(g, "x")
+
+    return _shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P(None),
+                      **_no_check)(x)
+
+
+def gl401_schedules():
+    """The role→schedule map ``audit_collective_schedules`` consumes: the
+    two sides trace to collective sequences that diverge at index 0."""
+    from accelerate_tpu.analysis.distributed_audit import collective_schedule
+
+    x = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    return {
+        "role_a": collective_schedule(jax.jit(gl401_role_a).trace(x)),
+        "role_b": collective_schedule(jax.jit(gl401_role_b).trace(x)),
+    }
+
+
+def gl402_double_pin_step(x):
+    """GL402: a 4 MiB activation pinned to a row sharding and immediately
+    re-pinned to a column sharding — GSPMD materializes the un-requested
+    reshard (an all-to-all-shaped copy) between the two constraints."""
+    mesh = _mesh()
+    y = jax.lax.with_sharding_constraint(
+        x * 2.0, NamedSharding(mesh, P("x", None))
+    )
+    y = jax.lax.with_sharding_constraint(y, NamedSharding(mesh, P(None, "x")))
+    return y.sum()
+
+
+def gl403_schemas():
+    """GL403: the prefill role quantizes its KV pages to int8 codes+scales
+    while the decode role expects dense bf16 — the schemas disagree on
+    dtype, payload leaves, and bytes/page.  Returns ``(src, dst)`` for
+    ``audit_wire_schema``."""
+    from accelerate_tpu.analysis.distributed_audit import wire_schema
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    cfg = LlamaConfig.tiny()
+    prefill = ServingPlugin(num_slots=4, page_size=4, pages_per_slot=16,
+                            num_pages=40, kv_dtype="int8")
+    decode = ServingPlugin(num_slots=4, page_size=4, pages_per_slot=16,
+                           num_pages=40)
+    return wire_schema(cfg, prefill), wire_schema(cfg, decode)
+
+
+def gl404_coverage():
+    """GL404: the decode role warms only the decode program, but the
+    schedule can dispatch release and wire_recv to it — the first release
+    after warmup compiles mid-traffic (the strict_compiles violation).
+    Returns ``(role, warmed, dispatchable)`` for ``audit_warmup_coverage``."""
+    return "decode", {"decode"}, {"decode", "release", "wire_recv"}
+
+
+def example_args():
+    """Concrete example inputs for the traceable planted functions."""
+    return {
+        "gl401_role_a": (jnp.ones((8, 8)),),
+        "gl401_role_b": (jnp.ones((8, 8)),),
+        "gl402_double_pin_step": (
+            jax.ShapeDtypeStruct((1024, 1024), jnp.float32),
+        ),
+    }

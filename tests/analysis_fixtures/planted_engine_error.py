@@ -1,0 +1,6 @@
+# PLANTED GL002: this file is deliberately NOT valid Python — the AST
+# engine must report its own failure to parse a target loudly (GL002)
+# rather than silently skipping the file.  Clean twin: clean_meta.py
+# (a parseable module).  Never import this module.
+def broken(:
+    return

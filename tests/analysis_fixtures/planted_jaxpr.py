@@ -125,6 +125,36 @@ def unscaled_fp8_dot_step(x, w):
     return y + 1.0  # raw fp8 codes flow into the add
 
 
+def fused_decode_unscaled_kv_step(q, k_codes, v_codes, k_scale, v_scale):
+    """GL110 (the fused-decode shape of PR 17): the jaxpr model of
+    ``fused_bgmv_paged_decode``'s quantized-KV contraction — scores off an
+    fp8 K-page dot and the weighted sum over fp8 V-pages reach the output
+    add with NEITHER ``k_scale`` nor ``v_scale`` applied.  The fused kernel
+    dequantizes in-kernel (``kv_qmax`` scaling); this model drops it."""
+    qk = (q * 448.0).astype(jnp.float8_e4m3fn)
+    scores = jax.lax.dot_general(qk, k_codes, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    qs = (scores * 448.0).astype(jnp.float8_e4m3fn)
+    out = jax.lax.dot_general(qs, v_codes, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    del k_scale, v_scale  # the planted bug: scales never touch the chain
+    return out + 1.0
+
+
+def fused_verify_unscaled_kv_step(q_tokens, k_codes, v_codes, k_scale, v_scale):
+    """GL110 (the multi-token verify shape of PR 17): the verify window's
+    k+1 queries attend over the same quantized pages — one dot per
+    contraction, still no dequantizing mul before the residual add."""
+    qk = (q_tokens * 448.0).astype(jnp.float8_e4m3fn)
+    scores = jax.lax.dot_general(qk, k_codes, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    qs = (scores * 448.0).astype(jnp.float8_e4m3fn)
+    out = jax.lax.dot_general(qs, v_codes, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    del k_scale, v_scale
+    return out + q_tokens  # raw codes land in the residual stream
+
+
 def flat_dcn_reduce_step(g):
     """GL108 (hint): a >= 1 MiB gradient psum over the JOINT ('dcn',
     'dp_shard') axes — the flat reduction whose cross-slice leg moves one
@@ -164,6 +194,17 @@ def example_args():
         "collective_matmul_hint_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
         "collective_matmul_rs_hint_step": (jnp.ones((1, 8, 16)), jnp.ones((16, 4))),
         "unscaled_fp8_dot_step": (jnp.ones((8, 16)), jnp.ones((16, 4))),
+        # q [H, D] / q_tokens [T, D] against P quantized pages of width D
+        "fused_decode_unscaled_kv_step": (
+            jnp.ones((4, 16)), jnp.ones((8, 16), jnp.float8_e4m3fn),
+            jnp.ones((8, 16), jnp.float8_e4m3fn), jnp.float32(0.1),
+            jnp.float32(0.1),
+        ),
+        "fused_verify_unscaled_kv_step": (
+            jnp.ones((5, 16)), jnp.ones((8, 16), jnp.float8_e4m3fn),
+            jnp.ones((8, 16), jnp.float8_e4m3fn), jnp.float32(0.1),
+            jnp.float32(0.1),
+        ),
         # per-device operand after the leading world-axis index: 520*520*4
         # ≈ 1.03 MiB — above the 1 MiB GL108 threshold
         "flat_dcn_reduce_step": (jax.ShapeDtypeStruct((4, 520, 520), jnp.float32),),

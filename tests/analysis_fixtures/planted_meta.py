@@ -1,0 +1,19 @@
+"""PLANTED META-RULE VIOLATIONS — the engine-discipline rules themselves.
+
+GL001: a bare suppression marker (no ``-- rationale``) that DOES silence a
+real finding — the suppression works, but the missing rationale is itself
+reported.  GL002's planted twin is ``planted_engine_error.py`` (a file the
+AST engine cannot parse — referenced here because this module must stay
+importable).  Corrected twins: ``clean_meta.py``.
+"""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step_with_bare_marker(x):
+    # the marker below suppresses the GL204 wall-clock read but omits its
+    # rationale -- the GL001 shape
+    return x * time.time()  # graft-lint: disable=GL204

@@ -87,6 +87,51 @@ def test_suppression_same_line_and_line_above(tmp_path):
     assert out[0].suppress_reason == "same-line"
 
 
+def test_suppression_continuation_line_normalizes_to_statement_start(tmp_path):
+    """Regression: a jaxpr finding whose source_info points at a
+    CONTINUATION line of a multi-line statement must still honor a marker
+    anchored on the statement's FIRST line (or the line above it)."""
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "# graft-lint: disable=GL103 -- marker above the statement\n"
+        "a = some_call(  # graft-lint: disable=GL104 -- marker on first line\n"
+        "    one,\n"
+        "    two,\n"
+        ")\n"
+        "b = other_call(\n"
+        "    three,\n"
+        ")\n"
+    )
+    out = apply_suppressions([
+        # anchored at continuation lines 3/4 -> normalized to statement
+        # start (line 2), where both markers are in reach
+        Finding("GL104", Severity.ERROR, "m", path=str(f), line=3),
+        Finding("GL103", Severity.ERROR, "m", path=str(f), line=4),
+        # the second statement has no marker: normalization must not
+        # borrow the first statement's markers
+        Finding("GL104", Severity.ERROR, "m", path=str(f), line=7),
+    ])
+    assert [x.suppressed for x in out] == [True, True, False]
+    assert out[0].suppress_reason == "marker on first line"
+    assert out[1].suppress_reason == "marker above the statement"
+
+
+def test_finding_and_report_json_round_trip():
+    """to_json -> from_json -> to_json is the identity: same findings,
+    same summary, identical re-render (the CI round-trip contract)."""
+    rep = Report([
+        Finding("GL104", Severity.ERROR, "e", fix_hint="h", path="a.py",
+                line=3, engine="jaxpr"),
+        Finding("GL402", Severity.WARNING, "w", engine="distributed"),
+        Finding("GL103", Severity.WARNING, "s", suppressed=True,
+                suppress_reason="why"),
+    ])
+    back = Report.from_json(rep.to_json())
+    assert back.findings == rep.findings
+    assert back.to_json() == rep.to_json()
+    assert back.render(show_suppressed=True) == rep.render(show_suppressed=True)
+
+
 def test_bare_suppression_marker_reported_as_gl001(tmp_path):
     f = tmp_path / "mod.py"
     f.write_text("a = 1  # graft-lint: disable=GL204\n")
@@ -118,7 +163,8 @@ def test_every_emitted_rule_is_in_the_catalog():
     for rule_id in ("GL001", "GL002", "GL101", "GL102", "GL103", "GL104",
                     "GL105", "GL106", "GL107", "GL108", "GL110", "GL201",
                     "GL202", "GL203", "GL204", "GL205", "GL301", "GL302",
-                    "GL303", "GL304", "GL305", "GL306"):
+                    "GL303", "GL304", "GL305", "GL306", "GL401", "GL402",
+                    "GL403", "GL404"):
         assert rule_id in RULES
         assert RULES[rule_id].summary and RULES[rule_id].fix_hint
 
@@ -138,6 +184,8 @@ _JAXPR_CASES = [
     ("collective_matmul_rs_hint_step", "GL107", {}),
     ("flat_dcn_reduce_step", "GL108", {}),
     ("unscaled_fp8_dot_step", "GL110", {}),
+    ("fused_decode_unscaled_kv_step", "GL110", {}),
+    ("fused_verify_unscaled_kv_step", "GL110", {}),
 ]
 
 
@@ -690,6 +738,139 @@ def test_gl109_suppressible_with_rationale(tmp_path):
     rep = lint_paths([f])
     assert not rep.unsuppressed(), rep.render()
     assert any(x.rule == "GL109" and x.suppressed for x in rep.findings)
+
+
+def test_fixture_distributed_planted_gl401_schedule_divergence():
+    """Two roles whose traced collective schedules reverse the rendezvous
+    order: the comparator flags the first diverging index — the deadlock a
+    launched gang would hit, caught before any process spawns."""
+    from accelerate_tpu.analysis import audit_collective_schedules
+
+    mod = _load_fixture("planted_distributed")
+    findings = audit_collective_schedules(mod.gl401_schedules())
+    assert _rules_of(findings) == {"GL401"}, findings
+    assert "rendezvous 0" in findings[0].message
+    assert findings[0].severity == Severity.ERROR
+
+
+def test_fixture_distributed_planted_gl402_double_pin():
+    """A ≥1 MiB activation pinned to one sharding and re-pinned to another:
+    the predicted GSPMD reshard is flagged with its byte cost."""
+    from accelerate_tpu.analysis import audit_resharding
+
+    mod = _load_fixture("planted_distributed")
+    (x,) = mod.example_args()["gl402_double_pin_step"]
+    findings = audit_resharding(jax.jit(mod.gl402_double_pin_step).trace(x))
+    assert _rules_of(findings) == {"GL402"}, findings
+    assert "MiB" in findings[0].message
+
+
+def test_fixture_distributed_planted_gl403_schema_mismatch():
+    """int8-quantized prefill vs dense-bf16 decode: the schemas disagree on
+    dtype, payload leaves, and bytes/page — the gate flags it AND the
+    runtime (check_wire_schemas, the PagedKVTransport constructor's check)
+    raises with the pinned historical phrasing."""
+    from accelerate_tpu.analysis import audit_wire_schema, check_wire_schemas
+
+    mod = _load_fixture("planted_distributed")
+    src, dst = mod.gl403_schemas()
+    findings = audit_wire_schema(src, dst)
+    assert _rules_of(findings) == {"GL403"}, findings
+    assert "kv_dtype" in findings[0].message
+    with pytest.raises(ValueError, match="KV page dtypes must match"):
+        check_wire_schemas(src, dst)
+
+
+def test_fixture_distributed_planted_gl404_warmup_gap():
+    """The decode role warms only the decode program but can be dispatched
+    release + wire_recv — the statically-proven strict_compiles violation."""
+    from accelerate_tpu.analysis import audit_warmup_coverage
+
+    mod = _load_fixture("planted_distributed")
+    findings = audit_warmup_coverage(*mod.gl404_coverage())
+    assert _rules_of(findings) == {"GL404"}, findings
+    assert "release" in findings[0].message and "wire_recv" in findings[0].message
+
+
+def test_fixture_distributed_clean_twins_quiet():
+    """Every corrected GL4xx twin is quiet: matched schedules, idempotent
+    pins, identical schemas (check_wire_schemas passes), covering warmup."""
+    from accelerate_tpu.analysis import (
+        audit_collective_schedules,
+        audit_resharding,
+        audit_warmup_coverage,
+        audit_wire_schema,
+        check_wire_schemas,
+    )
+
+    mod = _load_fixture("clean_distributed")
+    assert audit_collective_schedules(mod.gl401_schedules()) == []
+    (x,) = mod.example_args()["gl402_double_pin_step"]
+    assert audit_resharding(jax.jit(mod.gl402_double_pin_step).trace(x)) == []
+    src, dst = mod.gl403_schemas()
+    assert audit_wire_schema(src, dst) == []
+    check_wire_schemas(src, dst)  # must not raise
+    assert audit_warmup_coverage(*mod.gl404_coverage()) == []
+
+
+def test_pair_preflight_matched_pair_clean_and_planted_mismatch_fires():
+    """The full pair gate: a matched prefill/decode pair audits clean
+    (schema_ok, symmetric wire legs, covered warmup on both roles); the
+    same pair with a planted kv_dtype skew fires GL403.  Trace-only —
+    nothing compiles."""
+    from accelerate_tpu.analysis import pair_preflight
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    cfg = LlamaConfig.tiny()
+    plugin = ServingPlugin(num_slots=4, page_size=4, pages_per_slot=16,
+                           num_pages=40, prefill_chunk=32,
+                           prefill_buckets=(16, 32), decode_kernel="native")
+    findings, summary = pair_preflight(cfg, plugin, plugin)
+    assert findings == [], findings
+    assert summary["schema_ok"] and summary["wire_legs"]
+    for role in ("prefill", "decode"):
+        r = summary["roles"][role]
+        assert set(r["dispatchable"]) <= set(r["warmed"]), r
+
+    import dataclasses
+    planted = dataclasses.replace(plugin, kv_dtype="fp8")
+    findings, summary = pair_preflight(cfg, planted, plugin, trace_wire=False)
+    assert "GL403" in _rules_of(findings), findings
+    assert summary["schema_ok"] is False
+
+
+def test_every_rule_has_planted_and_clean_fixture_twins():
+    """The fixture meta-gate: every registered GLxxx rule id appears in at
+    least one planted-fires fixture AND at least one clean-quiet twin under
+    ``tests/analysis_fixtures/`` — a future rule can't land untested."""
+    import re
+
+    planted, clean = set(), set()
+    for p in FIXTURES.glob("*.py"):
+        ids = set(re.findall(r"\bGL\d{3}\b", p.read_text()))
+        if p.name.startswith("planted_"):
+            planted |= ids
+        elif p.name.startswith(("clean_", "fixed_")):
+            clean |= ids
+    for rule_id in RULES:
+        assert rule_id in planted, f"{rule_id} has no planted-fires fixture"
+        assert rule_id in clean, f"{rule_id} has no clean-quiet fixture twin"
+
+
+def test_fixture_meta_planted_gl001_and_gl002_fire():
+    """The engine-discipline twins: a bare (rationale-less) marker that DOES
+    suppress a finding fires GL001; an unparseable target fires GL002."""
+    rep = lint_paths([FIXTURES / "planted_meta.py"], excludes=())
+    assert _rules_of(rep) == {"GL001"}, rep.render()
+    assert any(f.rule == "GL204" and f.suppressed for f in rep.findings)
+    rep2 = lint_paths([FIXTURES / "planted_engine_error.py"], excludes=())
+    assert _rules_of(rep2) == {"GL002"}, rep2.render()
+
+
+def test_fixture_meta_clean_twin_quiet():
+    rep = lint_paths([FIXTURES / "clean_meta.py"], excludes=())
+    assert not rep.unsuppressed(), rep.render()
 
 
 def test_fixtures_are_excluded_from_repo_sweeps_by_default():

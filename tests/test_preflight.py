@@ -339,13 +339,18 @@ def test_gl306_loop_variants():
 
 
 def test_new_rules_are_in_the_catalog():
-    for rule_id in ("GL107", "GL301", "GL302", "GL303", "GL304", "GL305", "GL306"):
+    for rule_id in ("GL107", "GL301", "GL302", "GL303", "GL304", "GL305", "GL306",
+                    "GL401", "GL402", "GL403", "GL404"):
         assert rule_id in RULES
         assert RULES[rule_id].summary and RULES[rule_id].fix_hint
     assert RULES["GL107"].severity == Severity.INFO
     assert RULES["GL301"].severity == Severity.ERROR
     assert RULES["GL302"].severity == Severity.ERROR
     assert RULES["GL301"].engine == RULES["GL302"].engine == "compiled"
+    assert RULES["GL401"].severity == RULES["GL403"].severity == Severity.ERROR
+    assert RULES["GL402"].severity == RULES["GL404"].severity == Severity.WARNING
+    assert all(RULES[r].engine == "distributed"
+               for r in ("GL401", "GL402", "GL403", "GL404"))
 
 
 # ---------------------------------------------------------------------------
@@ -491,6 +496,28 @@ def test_serving_warmup_is_a_scheduling_noop(tiny_serving):
     assert warm.compile_events == after_warmup
 
 
+def test_engine_warmup_programs_match_the_static_plan(tiny_serving):
+    """``ServingEngine.warmup_programs()`` is the GL404 audit's
+    ``warmup_plan`` read off the live engine — one derivation for the
+    runtime warmup body and the preflight gate, pinned here against the
+    exact label set the tiny ladder warms."""
+    from accelerate_tpu.analysis import warmup_plan
+    from accelerate_tpu.serving import ServingEngine
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    model, params, gen = tiny_serving
+    plugin = ServingPlugin(
+        num_slots=4, page_size=4, pages_per_slot=16, num_pages=40,
+        prefill_chunk=16, prefill_buckets=(8, 16), decode_kernel="native",
+    )
+    engine = ServingEngine(model, params, plugin, gen)
+    progs = engine.warmup_programs()
+    assert progs == frozenset(
+        {"decode", "sample_first", "prefill[8]", "prefill[16]", "release"}
+    )
+    assert progs == warmup_plan(plugin)
+
+
 def test_serving_warmup_refuses_mid_traffic(tiny_serving):
     from accelerate_tpu.serving import ServingEngine
     from accelerate_tpu.serving.scheduler import Request
@@ -525,10 +552,13 @@ _TINY_SERVE_ENV = {
 
 def test_preflight_cli_smoke_tier1():
     """The acceptance command: ``python -m accelerate_tpu preflight --serve
-    --train`` on the tiny CPU config compiles exactly len(buckets)+2
-    serving programs (+1 train step — 5 total, the tier-1 ceiling), reports
-    per-program HBM + flops, and exits 0 with zero unsuppressed findings."""
-    out = _cli(["--serve", "--train", "--json", "--no-lint"],
+    --train --disaggregate`` on the tiny CPU config compiles exactly
+    len(buckets)+2 serving programs (+1 train step — 5 total, the tier-1
+    ceiling; the pair audit is trace-only and adds NO compiled programs),
+    reports per-program HBM + flops, embeds the distributed pair summary,
+    exits 0 with zero unsuppressed findings, and its ``--json`` payload
+    round-trips losslessly through ``Finding.from_dict``."""
+    out = _cli(["--serve", "--train", "--disaggregate", "--json", "--no-lint"],
                env_extra=_TINY_SERVE_ENV)
     assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
     payload = json.loads(out.stdout)
@@ -536,13 +566,25 @@ def test_preflight_cli_smoke_tier1():
     assert payload["summary"]["error"] == payload["summary"]["warning"] == 0
     programs = payload["programs"]
     # tiny 2-bucket ladder (prefill_chunk=32 -> buckets (16, 32)): decode +
-    # release + 2 prefills + the train step — the tier-1 <=5 budget guard
+    # release + 2 prefills + the train step — the tier-1 <=5 budget guard.
+    # --disaggregate rides along without growing the compiled set.
     assert len(programs) == 2 + 2 + 1 <= 5
     serve_labels = {p["program"] for p in programs if "train" not in p["program"]}
     assert serve_labels == {"decode", "release", "prefill[16]", "prefill[32]"}
     for p in programs:
         assert p["hbm"]["total"] > 0, p
         assert "flops" in p and "bytes_accessed" in p and "compile_s" in p
+    dist = payload["distributed"]
+    assert dist["schema_ok"] is True and dist["findings"] == 0
+    assert set(dist["roles"]) == {"prefill", "decode"}
+    for role in dist["roles"].values():
+        assert role["page_bytes"] > 0
+    # the machine-readable findings list reconstructs to an identical report
+    from accelerate_tpu.analysis import Finding
+
+    rebuilt = Report([Finding.from_dict(d) for d in payload["findings"]])
+    assert rebuilt.summary() == payload["summary"]
+    assert [f.to_dict() for f in rebuilt.findings] == payload["findings"]
 
 
 def _run_inprocess_cli(argv):
@@ -576,6 +618,25 @@ def test_preflight_cli_planted_donation_exit_nonzero(capsys):
         ])
     assert code == 1
     assert "GL301" in capsys.readouterr().out
+
+
+def test_preflight_cli_disaggregate_pair_gate(monkeypatch, capsys):
+    """The pair gate, in-process (``--disaggregate`` alone is trace-only —
+    no train/serve compiles ride along): the in-tree matched pair exits 0;
+    an ``ACCELERATE_SERVE_PREFILL_KV_DTYPE`` role override plants a wire
+    schema mismatch and the same command exits 1 naming GL403."""
+    for key, value in _TINY_SERVE_ENV.items():
+        monkeypatch.setenv(key, value)
+    code = _run_inprocess_cli(["--disaggregate", "--no-lint"])
+    out = capsys.readouterr().out
+    assert code == 0, out
+    assert "schema_ok=True" in out
+
+    monkeypatch.setenv("ACCELERATE_SERVE_PREFILL_KV_DTYPE", "int8")
+    code = _run_inprocess_cli(["--disaggregate", "--no-lint"])
+    out = capsys.readouterr().out
+    assert code == 1, out
+    assert "GL403" in out and "schema_ok=False" in out
 
 
 def test_preflight_and_lint_share_loud_missing_target(tmp_path, capsys):

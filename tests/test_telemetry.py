@@ -284,6 +284,24 @@ def test_all_standard_twins_register_from_their_accounting_sites():
                         kv_page_bytes(_Cfg(), 4, 2, "int8"),
                         source="serving/engine.ServingEngine")
 
+    # 19. distributed wire unit (analysis/distributed_audit.pair_preflight
+    # vs serving/transfer.PagedKVTransport): the pair gate records the
+    # GL403 schema's page_bytes as predicted; the constructed transport's
+    # _page_bytes — the same wire_schema() derivation — is the measured
+    # side, so the row agrees exactly
+    from accelerate_tpu.analysis.distributed_audit import wire_schema
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    schema = wire_schema(LlamaConfig.tiny(), ServingPlugin(
+        num_slots=4, page_size=4, pages_per_slot=16, num_pages=40))
+    reg.record_predicted("distributed.wire_bytes_per_page",
+                         schema["page_bytes"],
+                         source="analysis/distributed_audit.pair_preflight")
+    reg.record_measured("distributed.wire_bytes_per_page",
+                        schema["page_bytes"],
+                        source="serving/transfer.PagedKVTransport")
+
     rows = reg.drift_report()
     for name in STANDARD_TWINS:
         assert name in rows, name
@@ -291,7 +309,8 @@ def test_all_standard_twins_register_from_their_accounting_sites():
     for paired in ("dcn_comm.dcn_bytes", "kv_pool.utilization",
                    "adapter_pool.hit_rate", "goodput.goodput_frac",
                    "compiles.steady_state", "speculate.accept_rate",
-                   "speculate.tokens_per_step", "kv_quant.page_bytes"):
+                   "speculate.tokens_per_step", "kv_quant.page_bytes",
+                   "distributed.wire_bytes_per_page"):
         assert rows[paired]["status"] != "idle", (paired, rows[paired])
     # predicted and measured route through the same kv_page_bytes
     # arithmetic — exact by construction (tolerance 0.0)
