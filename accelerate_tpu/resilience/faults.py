@@ -42,6 +42,12 @@ site                      kinds
                           mid-prefetch, inside the bounded-retry wrapper
 ``adapter_memmap``        ``transfer`` — the cold-tier memmap read fails,
                           inside its own retry wrapper
+``fleet_route``           ``replica_kill`` — the fleet router
+                          (``serving/router.py``) loses one replica
+                          mid-traffic: the victim drains through
+                          ``remaining_requests()`` and the router re-routes
+                          its survivors exactly once (tokens stay bitwise —
+                          the fleet chaos leg pins it)
 ========================  =====================================================
 
 Occurrence counting is per-site and 1-based: an event ``FaultEvent("preempt",
@@ -69,7 +75,7 @@ from .retry import TransientIOError
 logger = get_logger(__name__)
 
 FAULT_KINDS = ("preempt", "nan_grad", "transfer", "corrupt_ckpt", "cancel",
-               "deadline", "prefix")
+               "deadline", "prefix", "replica_kill")
 
 # default hook site per kind (a transfer event may override its site to
 # "checkpoint_io"/"adapter_transfer"/"adapter_memmap" to target checkpoint
@@ -88,6 +94,9 @@ KIND_DEFAULT_SITE = {
     # future admissions miss, tokens stay bitwise (the prefix interplay leg
     # of the chaos soak pins it)
     "prefix": "serve_step",
+    # fleet-replica loss: the router's per-tick hook drains the victim and
+    # re-routes its pending work to the surviving replicas (exactly once)
+    "replica_kill": "fleet_route",
 }
 
 CORRUPTION_MODES = ("truncate", "bitflip")

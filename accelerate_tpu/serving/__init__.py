@@ -30,7 +30,13 @@ The production-inference rebuild of the reference's
   eviction respects shared refcounts (the AdapterStore LRU rule);
 - :mod:`.transfer` — the first disaggregated prefill→decode slice: two
   fixed-shape wire programs stream finished KV pages between engines, with
-  the ``dcn``-axis byte-accounting twin (``transfer.page_bytes``).
+  the ``dcn``-axis byte-accounting twin (``transfer.page_bytes``);
+- :mod:`.router` — the fleet layer (ROADMAP item 1's scale-out step): N
+  replicas (fused engines or disaggregated pairs) behind deterministic
+  prefix-/adapter-affinity routing with load-aware tie-breaking, fleet-wide
+  degradation-ladder escalation, drain/respawn on ``replica_kill``, and
+  the :func:`~.router.fleet_replay` / :func:`~.router.fleet_chaos_replay`
+  harnesses (docs/serving.md "Fleet serving").
 """
 
 from .adapters import (
@@ -57,6 +63,7 @@ from .prefix_cache import (
     unbounded_prefix_hit_rate,
 )
 from .paged_cache import allocate, kv_pool_accounting, pages_for, push_pages, release
+from .router import FleetRouter, fleet_chaos_replay, fleet_replay
 from .scheduler import ContinuousBatchingScheduler, Request, SlotState
 from .speculate import (
     DraftModelDraft,
@@ -110,4 +117,7 @@ __all__ = [
     "DisaggregatedPair",
     "transfer_accounting",
     "page_bytes",
+    "FleetRouter",
+    "fleet_replay",
+    "fleet_chaos_replay",
 ]

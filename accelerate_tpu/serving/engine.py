@@ -595,6 +595,15 @@ class ServingEngine:
         st = SlotState(request, sched._admit_counter,
                        prefilled=request.prompt_len)
         st.tokens = [int(first_token)]
+        if self.adapters is not None and request.adapter_id:
+            # adapter routing across the split: the decode role pins the
+            # tenant's adapter in ITS pool (the prefill role's pin released
+            # with the held slot) — the normal finish path unpins, so the
+            # refcount contract balances per engine
+            adapter_slot, swapped = self.adapters.pin(request.adapter_id)
+            st.adapter_slot = adapter_slot
+            if swapped:
+                sched.events.append(("swap", request.adapter_id, adapter_slot))
         sched.slots[slot] = st
         sched._admit_counter += 1
         sched.free_pages -= n_pages

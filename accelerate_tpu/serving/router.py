@@ -1,0 +1,668 @@
+"""Fleet routing: N engine replicas behind deterministic prefix- and
+adapter-affinity placement (the scale-out layer above one engine or one
+disaggregated pair).
+
+One warmed engine is already deterministic end-to-end; a fleet of N must
+behave like ONE warm engine, and the router is where that either holds or
+breaks.  Three disciplines compose here:
+
+- **Prefix affinity** (the routing key is free): the prefix cache already
+  content-addresses every prompt as a chain of block hashes
+  (:meth:`~.prefix_cache.PrefixCache.block_hashes`), so the router can ask
+  each replica's index — a pure :meth:`~.prefix_cache.PrefixCache.match`
+  probe, no stats or LRU mutation — how many leading pages of THIS prompt
+  it already holds, and send the request where its preamble is hot.
+  Requests routed but not yet admitted are tracked in a per-replica
+  *planned* hash set so a burst of same-preamble arrivals converges on one
+  replica instead of scattering before the first insert lands.
+- **Adapter affinity** (the S-LoRA discipline): a tenant stays on replicas
+  whose :class:`~.adapters.AdapterStore` pool holds its weights resident —
+  a swap costs host→device bytes and can evict another hot tenant, so the
+  router prefers residency, then the tenant's sticky home replica, before
+  letting load win.
+- **Load-aware tie-breaking**: among equal-affinity replicas the shortest
+  queue and emptiest KV pool wins, lowest replica index as the final
+  deterministic tie-break — same trace, same fleet, same placement,
+  always (the scheduler-determinism contract lifted fleet-wide).
+
+Drain/respawn reuses the single-engine survivors contract: killing a
+replica (the ``replica_kill`` fault, site ``fleet_route``) drains it
+through ``remaining_requests()`` — completed work stays completed, every
+pending request re-routes **exactly once** — and the re-admitted survivors
+are pre-marked in the target scheduler's once-only offered-traffic set
+(:meth:`~.scheduler.ContinuousBatchingScheduler.mark_prefix_counted`) so
+the fleet prefix twin never double-counts a drained request's preamble.
+Surviving tokens stay BITWISE identical to the fault-free fleet replay
+(pinned by tests and the ``chaos_replay`` fleet leg).
+
+Fleet-wide degradation: :meth:`FleetRouter.attach` chains an
+:class:`~accelerate_tpu.telemetry.SLOMonitor`'s trip/recover callbacks to
+EVERY replica's :class:`~.overload.DegradationLadder` — one breached SLO
+escalates the whole fleet one stage, in lockstep, exactly as one engine
+would escalate itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses as _dc
+import os
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .scheduler import Request
+
+
+class _Replica:
+    """Uniform drive surface over one fleet member — a fused
+    :class:`~.engine.ServingEngine` or a
+    :class:`~.transfer.DisaggregatedPair` (duck-typed on
+    ``prefill_engine``).  Normalizes submit/tick/busy/results, the drain
+    contract, and the occupancy + affinity probes the router scores on."""
+
+    def __init__(self, index: int, backend):
+        self.index = index
+        self.backend = backend
+        self.is_pair = hasattr(backend, "prefill_engine")
+        self.alive = True
+        self.routed = 0
+        self.compiles_warmup = 0
+        # hash-chains routed here but possibly not yet admitted/inserted:
+        # the router's look-ahead prefix index (a burst of same-preamble
+        # arrivals must converge BEFORE the first admission inserts pages)
+        self.planned: set[bytes] = set()
+
+    # -- the engines under this replica --------------------------------------
+
+    @property
+    def engines(self) -> list:
+        if self.is_pair:
+            return [self.backend.prefill_engine, self.backend.decode_engine]
+        return [self.backend]
+
+    @property
+    def role(self) -> str:
+        return "pair" if self.is_pair else "engine"
+
+    @property
+    def _admit_engine(self):
+        """The engine whose scheduler admits routed traffic (and therefore
+        owns the prefix cache the affinity probe reads): the prefill role
+        of a pair, the engine itself otherwise."""
+        return self.backend.prefill_engine if self.is_pair else self.backend
+
+    # -- drive surface -------------------------------------------------------
+
+    def warmup(self) -> int:
+        return self.backend.warmup()
+
+    def submit(self, request: Request) -> None:
+        """Hand one routed request to the backend NOW: the arrival step is
+        rebased to the replica's own virtual clock (the fleet clock
+        delivered it; each replica keeps its own step time)."""
+        r = _dc.replace(request, arrival_step=self._admit_engine.steps)
+        if self.is_pair:
+            self.backend.submit(r)
+        else:
+            self.backend.add_request(r)
+        self.routed += 1
+
+    def busy(self) -> bool:
+        if self.is_pair:
+            return self.backend.busy()
+        return not self.backend.idle()
+
+    def tick(self) -> None:
+        if self.is_pair:
+            self.backend.tick()
+        else:
+            self.backend.step()
+
+    @property
+    def results(self) -> dict:
+        return self.backend.results
+
+    def remaining_requests(self) -> list[Request]:
+        return self.backend.remaining_requests()
+
+    def prefix_counted(self) -> set[int]:
+        """Uids whose cacheable preamble this replica already counted as
+        offered traffic (admitted at least once) — the set a drain carries
+        to the re-route target so the fleet prefix twin counts each
+        request exactly once."""
+        out: set[int] = set()
+        for eng in self.engines:
+            out |= eng.sched._prefix_counted
+        return out
+
+    def mark_prefix_counted(self, uids) -> None:
+        self._admit_engine.sched.mark_prefix_counted(uids)
+
+    # -- routing probes ------------------------------------------------------
+
+    def queue_len(self) -> int:
+        n = sum(len(eng.sched.waiting) for eng in self.engines)
+        if self.is_pair:
+            n += len(self.backend._pending) - self.backend._i
+        return n
+
+    def kv_occupancy(self) -> float:
+        return max(
+            eng.sched.used_pages / eng.sched.num_pages for eng in self.engines
+        )
+
+    def prefix_score(self, request: Request) -> int:
+        """Prompt tokens of ``request`` this replica's prefix index (live
+        pages + planned routes) already covers — 0 with the cache off."""
+        pc = self._admit_engine.prefix
+        if pc is None:
+            return 0
+        hashes = pc.block_hashes(request.prompt, request.adapter_id)
+        live = len(pc.match(hashes))
+        planned = 0
+        for h in hashes:
+            if h not in self.planned:
+                break
+            planned += 1
+        return max(live, planned) * pc.page_size
+
+    def plan_prefix(self, request: Request) -> None:
+        pc = self._admit_engine.prefix
+        if pc is not None:
+            self.planned.update(
+                pc.block_hashes(request.prompt, request.adapter_id)
+            )
+
+    def adapter_resident(self, tid: int) -> bool:
+        if not tid:
+            return False
+        # residency on ANY of the replica's pools counts — a pair keeps one
+        # store per role and the tenant crosses the split with the request
+        return any(
+            eng.adapters is not None and eng.adapters.resident(tid)
+            for eng in self.engines
+        )
+
+    # -- telemetry -----------------------------------------------------------
+
+    def compiles_warmup_by_role(self) -> dict:
+        if self.is_pair:
+            return dict(getattr(self.backend, "compiles_warmup_by_role", {}))
+        return {"engine": self.compiles_warmup}
+
+    def stats_row(self) -> dict:
+        prefix_rates, adapter_rates = [], []
+        for eng in self.engines:
+            if eng.prefix is not None:
+                prefix_rates.append(eng.prefix.hit_rate())
+            if eng.adapters is not None:
+                adapter_rates.append(eng.adapters.hit_rate())
+        return {
+            "replica": self.index,
+            "role": self.role,
+            "alive": self.alive,
+            "routed": self.routed,
+            "completed": len(self.results),
+            "engine_steps": sum(eng.steps for eng in self.engines),
+            "waiting": self.queue_len(),
+            "kv_occupancy": round(self.kv_occupancy(), 4),
+            "prefix_hit_rate": round(max(prefix_rates), 4) if prefix_rates else 0.0,
+            "adapter_pool_hit_rate": (
+                round(max(adapter_rates), 4) if adapter_rates else 0.0
+            ),
+            "compiles_warmup": self.compiles_warmup,
+        }
+
+
+class FleetRouter:
+    """Deterministic affinity router over N replicas (fused engines or
+    disaggregated pairs, freely mixed).
+
+    ``policy`` is ``"affinity"`` (prefix → adapter → load, the default) or
+    ``"round_robin"`` (the baseline the perf pin beats).  The placement
+    score is the lexicographic tuple ``(prefix_tokens, adapter_affinity,
+    -queue_len, -kv_occupancy, -index)`` maximized over alive replicas —
+    every component is integer-or-exact, so placement is reproducible
+    across runs and hosts.
+
+    ``respawn`` (optional) is a factory ``index -> backend``: after a
+    ``replica_kill`` drain the router appends a fresh warmed replica so
+    fleet capacity recovers.  Without it the fleet just narrows.
+    """
+
+    def __init__(self, replicas: Sequence, *, policy: str = "affinity",
+                 respawn: Optional[Callable[[int], object]] = None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"unknown routing policy {policy!r}: "
+                "expected 'affinity' or 'round_robin'"
+            )
+        self.replicas = [_Replica(i, b) for i, b in enumerate(replicas)]
+        self.policy = policy
+        self.respawn = respawn
+        self.routed_by = {"prefix": 0, "adapter": 0, "load": 0}
+        self.drain_events: list[dict] = []
+        self.clock = 0
+        self.monitor = None
+        self._rr = 0
+        self._home: dict[int, int] = {}   # tenant -> sticky home replica
+        self._compile_base: dict[int, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self, prewarm_dir: Optional[str] = None,
+               cache_tag: str = "fleet") -> dict:
+        """Warm every replica, sharing one compile sweep per role.
+
+        In-process replicas already share the jitted program cache, so the
+        first replica of a role pays the sweep and the rest warm nearly
+        free.  With ``prewarm_dir`` the first replica of each role also
+        packs its scoped compilation cache into ``prewarm-<role>.tar``
+        (:func:`~accelerate_tpu.utils.compile_cache.export_prewarm`) and
+        later same-role replicas — or other PROCESSES, the real win —
+        :func:`~accelerate_tpu.utils.compile_cache.load_prewarm` it before
+        warming.  Returns ``compiles_warmup`` summed per role."""
+        by_role: dict[str, int] = {}
+        exported: set[str] = set()
+        if prewarm_dir:
+            from ..utils.compile_cache import (enable_scoped_compilation_cache,
+                                               export_prewarm, load_prewarm)
+
+            os.makedirs(prewarm_dir, exist_ok=True)
+            enable_scoped_compilation_cache(cache_tag,
+                                            min_compile_time_secs=0.0)
+        for rep in self.replicas:
+            archive = (os.path.join(prewarm_dir, f"prewarm-{rep.role}.tar")
+                       if prewarm_dir else "")
+            if archive and rep.role not in exported and os.path.exists(archive):
+                load_prewarm(archive, tag=cache_tag)
+                exported.add(rep.role)
+            rep.compiles_warmup = rep.warmup()
+            for role, n in rep.compiles_warmup_by_role().items():
+                by_role[role] = by_role.get(role, 0) + n
+            if archive and rep.role not in exported:
+                export_prewarm(archive, tag=cache_tag)
+                exported.add(rep.role)
+        for rep in self.replicas:
+            self._compile_base[rep.index] = self._compiles(rep)
+        return by_role
+
+    @staticmethod
+    def _compiles(rep: _Replica) -> int:
+        return sum(eng.compile_events for eng in rep.engines)
+
+    def compiles_measured(self) -> dict[int, int]:
+        """Post-warmup compile events per replica — zero everywhere is the
+        fleet's strict_compiles contract."""
+        return {
+            rep.index: self._compiles(rep) - self._compile_base.get(rep.index, 0)
+            for rep in self.replicas
+        }
+
+    # -- placement -----------------------------------------------------------
+
+    def alive_replicas(self) -> list[_Replica]:
+        return [rep for rep in self.replicas if rep.alive]
+
+    def route(self, request: Request) -> _Replica:
+        """Place one request: score alive replicas, submit to the winner,
+        update the planned-prefix index and the tenant home map.  Returns
+        the chosen replica."""
+        alive = self.alive_replicas()
+        if not alive:
+            raise RuntimeError(
+                "fleet has no alive replicas to route to "
+                "(every replica drained without a respawn factory)"
+            )
+        if self.policy == "round_robin":
+            rep = alive[self._rr % len(alive)]
+            self._rr += 1
+            reason = "load"
+        else:
+            tid = request.adapter_id
+
+            def score(rep: _Replica):
+                affinity = (2 if rep.adapter_resident(tid)
+                            else 1 if tid and self._home.get(tid) == rep.index
+                            else 0)
+                return (rep.prefix_score(request), affinity,
+                        -rep.queue_len(), -rep.kv_occupancy(), -rep.index)
+
+            scored = max(alive, key=score)
+            s = score(scored)
+            rep = scored
+            reason = "prefix" if s[0] > 0 else "adapter" if s[1] > 0 else "load"
+        self.routed_by[reason] += 1
+        rep.plan_prefix(request)
+        if request.adapter_id:
+            self._home[request.adapter_id] = rep.index
+        rep.submit(request)
+        return rep
+
+    # -- drain / respawn -----------------------------------------------------
+
+    def drain(self, rep: _Replica) -> list[Request]:
+        """Kill one replica: collect its survivors through the
+        ``remaining_requests()`` contract, mark it dead (completed results
+        stay attributed to it), re-route every survivor exactly once, and
+        pre-seed each target's once-only prefix-counting set for survivors
+        the victim already counted as offered traffic.  Returns the
+        survivors, in the victim's submission order."""
+        survivors = rep.remaining_requests()
+        counted = rep.prefix_counted()
+        rep.alive = False
+        self.drain_events.append({
+            "replica": rep.index, "at_clock": self.clock,
+            "survivors": len(survivors),
+        })
+        if self.respawn is not None:
+            fresh = _Replica(len(self.replicas), self.respawn(rep.index))
+            fresh.compiles_warmup = fresh.warmup()
+            self._compile_base[fresh.index] = self._compiles(fresh)
+            self.replicas.append(fresh)
+        for r in survivors:
+            target = self.route(r)
+            if r.uid in counted:
+                target.mark_prefix_counted([r.uid])
+        return survivors
+
+    def _kill_one(self) -> None:
+        """The ``replica_kill`` fault body: deterministically pick the
+        victim — the highest-index alive replica that is busy (the fault
+        wants mid-flight work to re-route), else the highest-index alive —
+        and drain it.  A single-replica fleet with no respawn ignores the
+        kill: there is nowhere to re-route."""
+        alive = self.alive_replicas()
+        if len(alive) <= 1 and self.respawn is None:
+            return
+        busy = [rep for rep in alive if rep.busy()]
+        victim = (busy or alive)[-1]
+        self.drain(victim)
+
+    # -- the fleet loop ------------------------------------------------------
+
+    def run(self, trace: list[Request], max_steps: int = 500_000) -> dict:
+        """Replay a trace through the fleet: one fleet tick delivers due
+        arrivals through :meth:`route`, fires the ``fleet_route`` fault
+        point, then ticks every busy alive replica once.  Returns the
+        merged ``{uid: tokens}`` results."""
+        from ..resilience.faults import fault_point
+
+        pending = sorted(trace, key=lambda r: (r.arrival_step, r.uid))
+        i = 0
+        while True:
+            for e in fault_point("fleet_route"):
+                if e.kind == "replica_kill":
+                    self._kill_one()
+            while i < len(pending) and pending[i].arrival_step <= self.clock:
+                self.route(pending[i])
+                i += 1
+            busy = [rep for rep in self.alive_replicas() if rep.busy()]
+            if not busy and i >= len(pending):
+                break
+            for rep in busy:
+                rep.tick()
+            self.clock += 1
+            if self.clock >= max_steps:
+                raise RuntimeError(f"fleet replay exceeded {max_steps} ticks")
+        return self.results
+
+    @property
+    def results(self) -> dict:
+        """Merged results across ALL replicas — drained replicas keep the
+        work they completed before the drain."""
+        out: dict = {}
+        for rep in self.replicas:
+            out.update(rep.results)
+        return out
+
+    # -- fleet-wide degradation ----------------------------------------------
+
+    def attach(self, monitor) -> None:
+        """Chain an :class:`~accelerate_tpu.telemetry.SLOMonitor` to the
+        WHOLE fleet: a trip escalates every alive replica's degradation
+        ladder one stage, a recovery relaxes every one — the fleet moves
+        through the ladder in lockstep, like one engine.  Callbacks the
+        monitor already carries keep firing (the
+        :meth:`~.overload.DegradationLadder.attach` chaining rule)."""
+        self.monitor = monitor
+        prev_trip, prev_recover = monitor.on_trip, monitor.on_recover
+
+        def trip(metric, quantile, value):
+            self.escalate(metric, quantile, value)
+            if prev_trip is not None:
+                prev_trip(metric, quantile, value)
+
+        def recover(metric, quantile, value):
+            self.relax(metric, quantile, value)
+            if prev_recover is not None:
+                prev_recover(metric, quantile, value)
+
+        monitor.on_trip = trip
+        monitor.on_recover = recover
+
+    def escalate(self, metric=None, quantile=None, value=None) -> None:
+        for rep in self.alive_replicas():
+            for eng in rep.engines:
+                eng.ladder.escalate(metric, quantile, value)
+
+    def relax(self, metric=None, quantile=None, value=None) -> None:
+        for rep in self.alive_replicas():
+            for eng in rep.engines:
+                eng.ladder.relax(metric, quantile, value)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def ttft_ticks(self) -> list[int]:
+        """Every replica's deterministic TTFT samples (virtual ticks from
+        rebased arrival to first token) — the fleet perf pin's clock."""
+        out: list[int] = []
+        for rep in self.replicas:
+            for eng in rep.engines:
+                out.extend(eng.ttft_ticks)
+        return out
+
+    def prefix_hit_rate(self) -> float:
+        """Fleet-aggregate prefix hit rate: index-served cacheable pages
+        over cacheable pages offered, summed across every replica's cache
+        — each request counted exactly once even across a drain re-route
+        (the ``mark_prefix_counted`` hand-off)."""
+        hits = lookups = 0
+        for rep in self.replicas:
+            for eng in rep.engines:
+                if eng.prefix is not None:
+                    hits += eng.prefix.stats["hit_pages"]
+                    lookups += eng.prefix.stats["lookup_pages"]
+        return round(hits / lookups, 4) if lookups else 0.0
+
+    def adapter_pool_hit_rate(self) -> float:
+        hits = total = 0
+        for rep in self.replicas:
+            for eng in rep.engines:
+                store = eng.adapters
+                if store is not None:
+                    hits += store.hits
+                    total += store.hits + store.swaps
+        return round(hits / total, 4) if total else 0.0
+
+    def transfer_bytes(self) -> int:
+        return sum(rep.backend.transport.bytes_moved
+                   for rep in self.replicas if rep.is_pair)
+
+    def report(self) -> dict:
+        return {
+            "replicas": len(self.replicas),
+            "alive": len(self.alive_replicas()),
+            "policy": self.policy,
+            "routed_by_prefix": self.routed_by["prefix"],
+            "routed_by_adapter": self.routed_by["adapter"],
+            "routed_by_load": self.routed_by["load"],
+            "drain_events": list(self.drain_events),
+            "fleet_clock": self.clock,
+            "per_replica": [rep.stats_row() for rep in self.replicas],
+        }
+
+
+def fleet_replay(router: FleetRouter, trace: list[Request], *,
+                 strict_compiles: bool = True,
+                 prewarm_dir: Optional[str] = None,
+                 slo_monitor=None) -> dict:
+    """Run a trace through a fleet and compose the fleet serving report
+    (the :func:`~.harness.replay` contract lifted over N replicas): every
+    field always present, zeros on an empty trace.
+
+    Warmup shares one compile sweep per role (optionally through an
+    ``export_prewarm`` pack in ``prewarm_dir``); after the run every
+    replica must show ZERO post-warmup compile events — with
+    ``strict_compiles`` (default) a violation raises instead of publishing
+    a report a recompile stall poisoned.
+
+    Twins recorded into the central registry: ``fleet.request_goodput``
+    (clean-run prediction 1.0 — only recorded with no fault plan active),
+    ``fleet.prefix_hit_rate`` / ``fleet.adapter_pool_hit_rate`` (predicted
+    from the single-cache trace models — informational: a fleet splits
+    traffic, affinity routing is what closes the gap), and the summed
+    ``transfer.page_bytes`` across every pair replica's transport."""
+    from ..resilience.faults import active_fault_plan
+    from ..telemetry import twin_registry
+
+    compiles_warmup_by_role = router.warmup(prewarm_dir)
+    if slo_monitor is not None:
+        router.attach(slo_monitor)
+    results = router.run(trace)
+    compiles = router.compiles_measured()
+    measured_compiles = sum(compiles.values())
+    if strict_compiles and measured_compiles > 0:
+        bad = {k: v for k, v in compiles.items() if v}
+        raise RuntimeError(
+            f"post-warmup compile event(s) on replica(s) {bad} during the "
+            "fleet replay: a mid-traffic recompile — some replica's program "
+            "shape is not pinned to its bucket ladder"
+        )
+    if slo_monitor is not None:
+        for rep in router.replicas:
+            for eng in rep.engines:
+                if getattr(eng, "slo", None) is not slo_monitor:
+                    slo_monitor.observe_many("token_latency_s", eng.token_gaps_s)
+                    slo_monitor.observe_many("ttft_s", eng.ttft_s)
+    ticks = router.ttft_ticks()
+    goodput = round(len(results) / len(trace), 4) if trace else 0.0
+    prefix_rate = router.prefix_hit_rate()
+    adapter_rate = router.adapter_pool_hit_rate()
+    reg = twin_registry()
+    reg.record_measured("fleet.request_goodput", goodput,
+                        source="serving/router.fleet_replay")
+    if active_fault_plan() is None:
+        # the clean-run model: nothing sheds, every routed request completes
+        reg.record_predicted("fleet.request_goodput",
+                             1.0 if trace else 0.0,
+                             source="serving/router clean-run model")
+    reg.record_measured("fleet.prefix_hit_rate", prefix_rate,
+                        source="serving/router.fleet_replay")
+    reg.record_measured("fleet.adapter_pool_hit_rate", adapter_rate,
+                        source="serving/router.fleet_replay")
+    admit = router.replicas[0]._admit_engine
+    if admit.prefix is not None and trace:
+        from .harness import predicted_prefix_hit_rate
+
+        p = admit.plugin
+        reg.record_predicted(
+            "fleet.prefix_hit_rate",
+            predicted_prefix_hit_rate(
+                trace, num_slots=p.num_slots, num_pages=p.num_pages,
+                page_size=p.page_size, pages_per_slot=p.pages_per_slot,
+                prefill_chunk=p.prefill_chunk,
+            ),
+            source="serving/router single-cache trace model",
+        )
+    stores = [eng.adapters for rep in router.replicas for eng in rep.engines
+              if eng.adapters is not None]
+    if stores and trace:
+        from .adapters import predicted_adapter_hit_rate
+
+        tenant_ids = [r.adapter_id for r in
+                      sorted(trace, key=lambda r: (r.arrival_step, r.uid))]
+        reg.record_predicted(
+            "fleet.adapter_pool_hit_rate",
+            predicted_adapter_hit_rate(tenant_ids, stores[0].plugin.pool_slots),
+            source="serving/router single-pool trace model",
+        )
+    wire_bytes = router.transfer_bytes()
+    if wire_bytes:
+        reg.record_measured("transfer.page_bytes", wire_bytes,
+                            source="serving/router.fleet_replay")
+    return {
+        "requests": len(trace),
+        "completed": len(results),
+        "goodput_frac": goodput,
+        "ttft_p50_ticks": (
+            round(float(np.percentile(np.asarray(ticks), 50)), 2)
+            if ticks else 0.0
+        ),
+        "prefix_hit_rate": prefix_rate,
+        "adapter_pool_hit_rate": adapter_rate,
+        "page_transfer_bytes": wire_bytes,
+        "compiles_warmup_by_role": compiles_warmup_by_role,
+        "compiles_measured": measured_compiles,
+        **router.report(),
+        "results": results,
+    }
+
+
+def fleet_chaos_replay(router_factory: Callable[[], FleetRouter],
+                       trace: list[Request], plan, *,
+                       strict_compiles: bool = True,
+                       baseline_parity: bool = True) -> dict:
+    """Seeded fleet chaos soak: replay the trace through a fleet while the
+    :class:`~accelerate_tpu.resilience.FaultPlan` kills replicas
+    (``replica_kill`` at the ``fleet_route`` site) mid-traffic.
+
+    The acceptance pin: the router drains each victim through the
+    survivors contract and re-routes pending work exactly once, so the
+    surviving tokens are **BITWISE identical** to a fault-free replay of
+    the same trace through a fresh identical fleet — a kill may change
+    WHERE a request decodes, never what it says.  ``strict_compiles``
+    holds across the soak (the respawn/warmup path included)."""
+    from ..resilience.faults import fault_plan as _fault_plan
+    from ..telemetry import twin_registry
+
+    with _fault_plan(plan):
+        router = router_factory()
+        router.warmup()
+        results = router.run(trace)
+        compiles = sum(router.compiles_measured().values())
+    if strict_compiles and compiles > 0:
+        raise RuntimeError(
+            f"{compiles} post-warmup compile event(s) during the fleet "
+            "chaos soak: a drain/re-route pushed a replica off its warmed "
+            "program set"
+        )
+    token_parity = True
+    if baseline_parity and results:
+        baseline = router_factory()
+        baseline.warmup()
+        base_results = baseline.run(trace)
+        token_parity = (
+            {uid: base_results.get(uid) for uid in results} == results
+        )
+    goodput = round(len(results) / len(trace), 4) if trace else 0.0
+    twin_registry().record_measured(
+        "fleet.request_goodput", goodput,
+        source="serving/router.fleet_chaos_replay",
+    )
+    return {
+        "requests": len(trace),
+        "completed": len(results),
+        "goodput_frac": goodput,
+        "faults_fired": len(plan.fired),
+        "drain_events": list(router.drain_events),
+        "token_parity": token_parity,
+        "compiles_measured": compiles,
+        **{k: v for k, v in router.report().items() if k != "drain_events"},
+        "results": results,
+    }
+
+
+__all__ = ["FleetRouter", "fleet_replay", "fleet_chaos_replay"]

@@ -258,6 +258,15 @@ class ContinuousBatchingScheduler:
     def requeue_front(self, request: Request) -> None:
         self.waiting.appendleft(request)
 
+    def mark_prefix_counted(self, uids) -> None:
+        """Pre-seed the once-only offered-traffic set behind the prefix
+        hit-rate twin: a request re-routed here after another replica
+        drained (serving/router.py) was already counted as offered traffic
+        at its FIRST admission — its re-admission on this scheduler must
+        not count a second lookup, or the fleet's measured hit rate
+        double-counts every drained request's preamble."""
+        self._prefix_counted.update(uids)
+
     # -- deadlines / shedding / cancellation ---------------------------------
 
     def request_expired(self, req: Request) -> bool:

@@ -23,11 +23,13 @@ the in-process two-engine slice of that split:
   the payload bytes ARE the K/V, so the decode side attends over exactly
   what a local prefill would have written.
 
-Multi-host streaming (real DCN between slices, the
-``parallel/hierarchical.py`` transport under a ``dcn`` mesh axis) is the
-documented follow-up: the wire payload, page accounting and twin names are
-already shaped for it — only the in-process device-to-device copy becomes
-a cross-slice send.
+Multi-host streaming is live in the 2-process fabric leg
+(``test_utils/scripts/fleet_fabric.py``, launched over jax.distributed by
+the dryrun's ``_fleet_leg``): the SAME wire payload crosses a real process
+boundary over the ``dcn`` plumbing, gated by the same shared
+``wire_schema`` derivation, with independent per-role pool geometry and
+the byte twin exact.  N pairs compose into a fleet behind the
+deterministic affinity router in :mod:`.router`.
 """
 
 from __future__ import annotations
@@ -238,29 +240,45 @@ class PagedKVTransport:
 
 
 class DisaggregatedPair:
-    """The first disaggregated prefill→decode deployment shape: one
-    prefill-role engine (requests clamped to ``max_new_tokens=1`` — the
-    prompt plus the first sampled token), one decode-role engine, and the
-    transport streaming finished KV pages between them.
+    """The disaggregated prefill→decode deployment shape: one prefill-role
+    engine (requests clamped to ``max_new_tokens=1`` — the prompt plus the
+    first sampled token), one decode-role engine, and the transport
+    streaming finished KV pages between them.
 
     ``run(trace)`` replays a request trace to completion and returns the
     same ``{uid: tokens}`` dict a single engine's ``run`` would — BITWISE
     identical greedy tokens (the acceptance pin): the first token comes
     off the prefill engine's last-chunk logits exactly as a fused engine
     would sample it, and the decode engine attends over the transferred
-    bytes verbatim.
+    bytes verbatim.  Speculation composes on the decode side
+    (``plugin.speculate`` arms the decode engine's verify ladder; the
+    prefill role is forced plain — its requests never decode), and
+    multi-tenant adapters ride the split with one :class:`AdapterStore`
+    per role (``adapters``/``prefill_adapters``, published identical
+    weights: each engine's pool refcounts balance independently, and the
+    decode role re-pins the tenant at :meth:`~.engine.ServingEngine.
+    adopt_prefilled`).
+
+    The incremental API (:meth:`submit` / :meth:`tick` / :meth:`busy`)
+    exposes the same host loop one step at a time — the fleet router
+    (``serving/router.py``) drives N pairs this way, interleaved, and
+    :meth:`remaining_requests` extends the single-engine drain/survivors
+    contract across the pair.
     """
 
     def __init__(self, model, params, plugin=None, generation_config=None,
-                 rng=None, prefill_plugin=None):
+                 rng=None, prefill_plugin=None, adapters=None,
+                 prefill_adapters=None):
         from ..utils.dataclasses import ServingPlugin
 
         plugin = plugin or ServingPlugin()
-        if plugin.speculate != "off":
+        if (adapters is None) != (prefill_adapters is None):
             raise ValueError(
-                "the disaggregation slice is plain-decode only: disarm "
-                "ServingPlugin.speculate on the pair (speculation composes "
-                "on the decode engine as a follow-up)"
+                "adapter traffic crosses the split: pass BOTH role stores "
+                "(adapters= for the decode engine, prefill_adapters= for "
+                "the prefill engine, published identical weights) or "
+                "neither — one engine computing LoRA prompts the other "
+                "cannot apply breaks token parity"
             )
         # per-tick deadlines belong to the fused engine's admission story
         # (each half runs its own virtual clock) — disarm the DEFAULT too,
@@ -268,17 +286,26 @@ class DisaggregatedPair:
         # default_deadline_ticks onto any request carrying 0, which would
         # silently defeat run()'s deadline_ticks=0 opt-out
         plugin = _dc.replace(plugin, default_deadline_ticks=0)
+        # the prefill role never decodes past the first token, so its
+        # verify ladder would warm dead programs — force it plain and let
+        # speculation live where the tokens do (the decode role)
         prefill_plugin = _dc.replace(prefill_plugin or plugin,
-                                     default_deadline_ticks=0)
+                                     default_deadline_ticks=0,
+                                     speculate="off")
         self.prefill_engine = ServingEngine(
             model, params, prefill_plugin, generation_config,
-            rng=rng, hold_finished=True,
+            rng=rng, hold_finished=True, adapters=prefill_adapters,
         )
         self.decode_engine = ServingEngine(
             model, params, plugin, generation_config, rng=rng,
+            adapters=adapters,
         )
         self.transport = PagedKVTransport(self.prefill_engine,
                                           self.decode_engine)
+        self._pending: list[Request] = []
+        self._i = 0
+        self._originals: dict[int, Request] = {}
+        self._done: dict[int, list[int]] = {}
 
     def preflight(self) -> tuple[list, dict]:
         """Run the GL4xx pair audit (wire schema, handoff schedule, traced
@@ -297,68 +324,104 @@ class DisaggregatedPair:
         )
 
     def warmup(self) -> int:
-        before = self.prefill_engine._compile_counter.count
+        c0 = self.prefill_engine._compile_counter.count
         self.prefill_engine.warmup()
+        c1 = self.prefill_engine._compile_counter.count
         self.decode_engine.warmup()
+        c2 = self.prefill_engine._compile_counter.count
         self.transport.warmup()
+        c3 = self.prefill_engine._compile_counter.count
+        # per-role warmup cost off the process-wide counter (the fleet
+        # bench's compiles_warmup-per-role rows; replicas sharing a jit
+        # cache or a prewarm pack show up here as near-zero roles)
+        self.compiles_warmup_by_role = {
+            "prefill": c1 - c0, "decode": c2 - c1, "wire": c3 - c2,
+        }
         # post-warmup compile baselines: run() must stay compile-free from
         # here (the strict_compiles contract extends across the pair — the
         # wire programs are production programs too)
         self._compile_base = (self.prefill_engine.compile_events,
                               self.decode_engine.compile_events)
-        return self.prefill_engine._compile_counter.count - before
+        return c3 - c0
+
+    # -- the incremental host loop (the fleet router's drive surface) --------
+
+    def submit(self, request: Request) -> None:
+        """Queue one request with the pair (virtual arrival honored against
+        the prefill engine's clock).  ``run`` is ``submit`` for the whole
+        trace plus ``tick`` until :meth:`busy` clears."""
+        import bisect
+
+        key = (request.arrival_step, request.uid)
+        lo = self._i + bisect.bisect_right(
+            [(r.arrival_step, r.uid) for r in self._pending[self._i:]], key
+        )
+        self._pending.insert(lo, request)
+        self._originals[request.uid] = request
+
+    def tick(self) -> bool:
+        """One host-loop decision: deliver due arrivals, stream every held
+        finished prefill the decode side can seat, then step exactly one
+        engine.  Returns ``False`` when there is nothing left to do."""
+        P, D = self.prefill_engine, self.decode_engine
+        eos = P.gen_config.eos_token_id
+        while self._i < len(self._pending) and \
+                self._pending[self._i].arrival_step <= P.steps:
+            P.add_request(_dc.replace(self._pending[self._i],
+                                      max_new_tokens=1, deadline_ticks=0))
+            self._i += 1
+        # stream every held finished prefill the decode side can seat
+        while P.held and self._dst_capacity():
+            slot = P.held[0]
+            uid = P.sched.slots[slot].request.uid
+            tok = P.results[uid][0]
+            if self._originals[uid].max_new_tokens == 1 or \
+                    (eos is not None and tok == eos):
+                # the first token already finished the request: nothing
+                # to decode, nothing to stream
+                P.release_held(slot)
+                self._done[uid] = [tok]
+                continue
+            # the decode engine runs on its own virtual clock: per-tick
+            # deadlines belong to the fused engine's admission story and
+            # stay a documented follow-up for the split
+            self.transport.transfer(
+                slot, _dc.replace(self._originals[uid], deadline_ticks=0),
+                P.results[uid][0],
+            )
+        if P.held and not self._dst_capacity() and not D.idle():
+            # a finished prefill is waiting on decode capacity: drain
+            # decode FIRST (prefill idling ahead of a blocked handoff
+            # must never starve the decode engine of ticks)
+            D.step()
+        elif self._p_busy():
+            P.step()
+        elif not D.idle():
+            D.step()
+        elif self._i < len(self._pending):
+            P.step()  # idle tick — advances the virtual arrival clock
+        elif P.held:
+            raise RuntimeError(
+                "disaggregated handoff wedged: held prefill slots with "
+                "an idle decode engine that cannot seat them — "
+                "mismatched pool geometry?"
+            )  # pragma: no cover - geometry validated at construction
+        else:
+            return False
+        return True
+
+    def busy(self) -> bool:
+        """Work anywhere in the pair: undelivered arrivals, a busy prefill
+        engine, a held handoff, or a non-idle decode engine."""
+        return (self._i < len(self._pending) or self._p_busy()
+                or bool(self.prefill_engine.held)
+                or not self.decode_engine.idle())
 
     def run(self, trace: list[Request], max_steps: int = 200_000) -> dict[int, list[int]]:
-        P, D = self.prefill_engine, self.decode_engine
-        pending = sorted(trace, key=lambda r: (r.arrival_step, r.uid))
-        originals = {r.uid: r for r in pending}
-        eos = P.gen_config.eos_token_id
-        done: dict[int, list[int]] = {}
-        i = 0
+        for r in sorted(trace, key=lambda r: (r.arrival_step, r.uid)):
+            self.submit(r)
         steps = 0
-        while True:
-            while i < len(pending) and pending[i].arrival_step <= P.steps:
-                P.add_request(_dc.replace(pending[i], max_new_tokens=1,
-                                          deadline_ticks=0))
-                i += 1
-            # stream every held finished prefill the decode side can seat
-            while P.held and self._dst_capacity():
-                slot = P.held[0]
-                uid = P.sched.slots[slot].request.uid
-                tok = P.results[uid][0]
-                if originals[uid].max_new_tokens == 1 or \
-                        (eos is not None and tok == eos):
-                    # the first token already finished the request: nothing
-                    # to decode, nothing to stream
-                    P.release_held(slot)
-                    done[uid] = [tok]
-                    continue
-                # the decode engine runs on its own virtual clock: per-tick
-                # deadlines belong to the fused engine's admission story and
-                # stay a documented follow-up for the split
-                self.transport.transfer(
-                    slot, _dc.replace(originals[uid], deadline_ticks=0),
-                    P.results[uid][0],
-                )
-            if P.held and not self._dst_capacity() and not D.idle():
-                # a finished prefill is waiting on decode capacity: drain
-                # decode FIRST (prefill idling ahead of a blocked handoff
-                # must never starve the decode engine of ticks)
-                D.step()
-            elif self._p_busy():
-                P.step()
-            elif not D.idle():
-                D.step()
-            elif i < len(pending):
-                P.step()  # idle tick — advances the virtual arrival clock
-            elif P.held:
-                raise RuntimeError(
-                    "disaggregated handoff wedged: held prefill slots with "
-                    "an idle decode engine that cannot seat them — "
-                    "mismatched pool geometry?"
-                )  # pragma: no cover - geometry validated at construction
-            else:
-                break
+        while self.tick():
             steps += 1
             if steps >= max_steps:
                 raise RuntimeError(
@@ -367,7 +430,31 @@ class DisaggregatedPair:
         # the prefill engine recorded 1-token results; the decode engine
         # owns the full streams (first token included); one-token requests
         # finished at the handoff boundary
-        return {**D.results, **done}
+        return self.results
+
+    @property
+    def results(self) -> dict[int, list[int]]:
+        return {**self.decode_engine.results, **self._done}
+
+    @property
+    def interrupted(self) -> bool:
+        return self.prefill_engine.interrupted or self.decode_engine.interrupted
+
+    def remaining_requests(self) -> list[Request]:
+        """The pair-wide drain/survivors contract (the single-engine
+        :meth:`~.engine.ServingEngine.remaining_requests` extended across
+        the split): every submitted ORIGINAL not yet completed and not
+        deliberately retired on either engine — undelivered arrivals,
+        prefilling, held at the handoff, or decoding — exactly once, in
+        submission order.  The fleet router re-routes these when a replica
+        drains."""
+        retired = (self.prefill_engine.sched.retired_uids
+                   | self.decode_engine.sched.retired_uids)
+        results = self.results
+        return [
+            r for r in self._pending
+            if r.uid not in results and r.uid not in retired
+        ]
 
     def _p_busy(self) -> bool:
         P = self.prefill_engine
@@ -379,11 +466,23 @@ class DisaggregatedPair:
         P, D = self.prefill_engine, self.decode_engine
         if not P.held or not D.sched.free_slots:
             return False
-        uid = P.sched.slots[P.held[0]].request.uid
-        need = pages_for(
-            P.sched.slots[P.held[0]].request.prompt_len, D.plugin.page_size
-        )
-        return need <= D.sched.free_pages
+        req = P.sched.slots[P.held[0]].request
+        uid = req.uid
+        # speculative decode books the worst-case first verify pass at
+        # admission (scheduler.admission_page_need) — the handoff seat must
+        # reserve the same headroom or the first verify wedges the pool
+        depth = 0
+        if D.sched.speculate_k:
+            depth = 1 + min(D.sched.speculate_k,
+                            self._originals[uid].max_new_tokens - 1)
+        need = pages_for(req.prompt_len + depth, D.plugin.page_size)
+        if need > D.sched.free_pages:
+            return False
+        # adapter routing across the split: the decode role must be able to
+        # pin the tenant before the transfer seats the slot
+        if D.adapters is not None and req.adapter_id:
+            return D.adapters.can_pin(req.adapter_id)
+        return True
 
     def report(self) -> dict:
         t = self.transport

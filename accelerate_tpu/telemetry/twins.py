@@ -107,6 +107,22 @@ STANDARD_TWINS: dict[str, tuple] = {
     # tolerance 0.0 turns any drift (the gate auditing a different schema
     # than the transport enforces) into an error
     "distributed.wire_bytes_per_page": ("bytes/page", 0.0, 0.0),
+    # serving/router.fleet_replay: completed / offered across the whole
+    # fleet; the clean-run model (no fault plan) predicts 1.0 — a chaos
+    # soak records measured only, and a drain re-routes survivors so the
+    # goodput holds through a replica kill
+    "fleet.request_goodput": ("frac", 0.1, None),
+    # fleet-aggregate prefix hit rate (index-served cacheable pages over
+    # cacheable pages offered, summed over every replica's cache, each
+    # request's offered traffic counted ONCE across drain re-routes) vs
+    # the single-cache trace model — informational tolerance: a fleet
+    # splits traffic across indexes, and the measured-vs-model gap IS the
+    # routing quality the affinity policy exists to close
+    "fleet.prefix_hit_rate": ("frac", 1.0, 1.0),
+    # fleet-aggregate adapter-pool hit rate vs the single-pool LRU trace
+    # model — informational for the same reason (tenant traffic splits;
+    # adapter affinity closes the gap)
+    "fleet.adapter_pool_hit_rate": ("frac", 1.0, 1.0),
 }
 
 

@@ -35,3 +35,11 @@ def launch_parity_script_path() -> Path:
     (hierarchical ICI->DCN sync over a real ``accelerate_tpu launch`` gang;
     consumed by __graft_entry__._launch_leg and tests/test_launch.py)."""
     return Path(__file__).parent / "scripts" / "launch_parity.py"
+
+
+def fleet_fabric_script_path() -> Path:
+    """Path to the 2-process disaggregated serving fabric worker (prefill
+    role on rank 0 streams KV pages to the decode role on rank 1 over the
+    real process boundary, plus the in-process fleet-router smoke;
+    consumed by __graft_entry__._fleet_leg and tests/test_router.py)."""
+    return Path(__file__).parent / "scripts" / "fleet_fabric.py"

@@ -1,0 +1,271 @@
+"""Worker script for the 2-process disaggregated serving fabric leg.
+
+Launched by ``accelerate_tpu launch --num_processes 2`` (one CPU device per
+process).  Rank 0 runs a **prefill-role** engine, rank 1 a **decode-role**
+engine with independent pool geometry (slots/pages/chunk/buckets differ;
+page geometry and ``kv_dtype`` are gated equal by the shared
+``wire_schema`` derivation — the same GL403 gate ``pair_preflight`` runs
+statically).  Finished KV pages — quantized codes PLUS their per-(kv-head,
+page) amax scales — cross the REAL process boundary over the ``dcn``
+plumbing (gloo/jax.distributed, :func:`~accelerate_tpu.ops.operations.
+broadcast`), byte-for-byte the payload the in-process
+:class:`~accelerate_tpu.serving.PagedKVTransport` carries.
+
+What the callers (``__graft_entry__`` ``_fleet_leg``, the slow test in
+tests/test_router.py) pin off the JSON line rank 0 prints:
+
+- **Token parity**: the decode role (speculation armed) attends over the
+  received bytes verbatim — its streams are BITWISE identical to a local
+  fused replay of the same trace;
+- **Byte twin, tolerance 0**: bytes sent (rank 0), bytes received (rank 1)
+  and :func:`~accelerate_tpu.serving.transfer_accounting`'s dcn model
+  agree EXACTLY — the trace is crafted so every request ships exactly once
+  (``max_new_tokens >= 2``, no EOS);
+- **strict_compiles on both roles**: zero post-warmup compile events on
+  either engine — the wire programs are production programs too;
+- **Fleet routing** (rank 0, after the fabric rounds): a 2-replica
+  in-process fleet behind the prefix-affinity router serves a seeded
+  shared-preamble trace at goodput 1.0 with prefix-routed placements.
+
+Env contract (all optional):
+  FLEET_LEG_REQUESTS  fabric requests to stream (default 6)
+  FLEET_LEG_SEED      trace seed (default 23)
+  FLEET_LEG_KV_DTYPE  pool/wire dtype (default "int8" — codes + scales)
+  FLEET_LEG_DIR       directory for the per-role ``export_prewarm`` packs
+"""
+
+import dataclasses as dc
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _plugins(kv_dtype: str):
+    """Independent per-role geometry: ONLY slots/pages/chunk/buckets may
+    differ — page_size, pages_per_slot and kv_dtype are wire-schema fields
+    and the shared gate refuses a pair that disagrees on any of them."""
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    shared = dict(page_size=4, pages_per_slot=8, kv_dtype=kv_dtype,
+                  decode_kernel="native", default_deadline_ticks=0)
+    prefill = ServingPlugin(num_slots=2, num_pages=20, prefill_chunk=8,
+                            prefill_buckets=(4, 8), speculate="off", **shared)
+    decode = ServingPlugin(num_slots=8, num_pages=64, prefill_chunk=4,
+                           prefill_buckets=(4,), speculate="ngram",
+                           speculate_k=2, **shared)
+    return prefill, decode
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import PartialState
+    from accelerate_tpu.analysis.distributed_audit import (check_wire_schemas,
+                                                           wire_schema)
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from accelerate_tpu.ops import operations as ops
+    from accelerate_tpu.serving import (ServingEngine, pages_for,
+                                        synthesize_trace, transfer_accounting)
+    from accelerate_tpu.serving.transfer import _transfer_fns
+    from accelerate_tpu.utils.compile_cache import (
+        enable_scoped_compilation_cache, export_prewarm)
+
+    state = PartialState()
+    assert state.num_processes == 2, (
+        f"the fabric leg is a 2-process pair, got {state.num_processes}"
+    )
+    rank = state.process_index
+    role = "prefill" if rank == 0 else "decode"
+
+    n = int(os.environ.get("FLEET_LEG_REQUESTS", "6"))
+    seed = int(os.environ.get("FLEET_LEG_SEED", "23"))
+    kv_dtype = os.environ.get("FLEET_LEG_KV_DTYPE", "int8")
+    work = os.environ.get("FLEET_LEG_DIR")
+
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    gen = GenerationConfig(max_new_tokens=6, eos_token_id=None)
+
+    prefill_plugin, decode_plugin = _plugins(kv_dtype)
+    # the shared gate, run identically on BOTH ranks before anything
+    # allocates: a schema mismatch must kill the launch, not corrupt pools
+    schema = wire_schema(cfg, prefill_plugin)
+    check_wire_schemas(schema, wire_schema(cfg, decode_plugin))
+
+    # every request ships exactly once (max_new >= 2, no EOS), so the byte
+    # twin agrees with the dcn model at tolerance 0
+    trace = [dc.replace(r, arrival_step=0, deadline_ticks=0)
+             for r in synthesize_trace(seed, n, prompt_len_range=(4, 12),
+                                       new_tokens_range=(2, 6))]
+    originals = {r.uid: r for r in trace}
+    bytes_pred = transfer_accounting(
+        cfg, trace, prefill_plugin.page_size, kv_dtype=kv_dtype,
+    )["page_transfer_bytes"]
+
+    if work:
+        enable_scoped_compilation_cache(f"fleet-{role}",
+                                        min_compile_time_secs=0.0)
+
+    geom = (prefill_plugin.page_size, prefill_plugin.pages_per_slot,
+            schema["kv_dtype"])
+    page_bytes = schema["page_bytes"]
+    header_zero = np.zeros(4, np.int64)
+    # one broadcast per payload leaf, in sorted-name order on BOTH ranks:
+    # the wire is a sequence of fixed-shape tensors and the two processes
+    # must agree on the sequence exactly (gloo pairs ops by issue order)
+    wire_names = sorted(schema["payload"])
+    payload_zero = {
+        name: np.zeros(*schema["payload"][name]) for name in wire_names
+    }
+
+    if rank == 0:
+        # -- prefill role: prompt -> first token -> pages on the wire ------
+        eng = ServingEngine(model, params, prefill_plugin, gen,
+                            hold_finished=True)
+        eng.warmup()
+        send_fn, _ = _transfer_fns(geom)
+        # wire warmup: the gather program and every broadcast shape compile
+        # BEFORE the compile baseline — they are production programs too,
+        # and strict_compiles covers the whole wire path
+        send_fn(eng.cache, jnp.asarray(0, jnp.int32))
+        ops.broadcast(header_zero)
+        for name in wire_names:
+            ops.broadcast(payload_zero[name])
+        base = eng.compile_events
+        for r in trace:
+            eng.add_request(dc.replace(r, max_new_tokens=1))
+        sent = bytes_sent = 0
+        while sent < n:
+            if eng.held:
+                slot = eng.held[0]
+                req = eng.sched.slots[slot].request
+                first = eng.results[req.uid][0]
+                n_pages = int(pages_for(req.prompt_len,
+                                        prefill_plugin.page_size))
+                ops.broadcast(np.asarray(
+                    [req.uid, req.prompt_len, first, n_pages], np.int64))
+                payload = jax.device_get(
+                    send_fn(eng.cache, jnp.asarray(slot, jnp.int32)))
+                for name in wire_names:
+                    ops.broadcast(np.asarray(payload[name]))
+                eng.release_held(slot)
+                bytes_sent += n_pages * page_bytes
+                sent += 1
+            else:
+                eng.step()
+        compiles = eng.compile_events - base
+        assert compiles == 0, f"prefill role recompiled: {compiles}"
+        assert bytes_sent == bytes_pred, (bytes_sent, bytes_pred)
+        prewarm = export_prewarm(os.path.join(work, "prewarm-prefill.tar"),
+                                 tag="fleet-prefill") if work else ""
+
+        # -- the router smoke: 2 in-process replicas, prefix affinity ------
+        from accelerate_tpu.serving import FleetRouter, fleet_replay
+        from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+        fp = ServingPlugin(num_slots=4, page_size=4, pages_per_slot=8,
+                           num_pages=24, prefill_chunk=8,
+                           prefill_buckets=(4, 8), decode_kernel="native",
+                           prefix_cache="on", default_deadline_ticks=0)
+        fleet_trace = synthesize_trace(seed + 1, 8, prefix_share=0.9,
+                                       shared_prefixes=2,
+                                       prompt_len_range=(4, 12),
+                                       new_tokens_range=(2, 6))
+        router = FleetRouter([ServingEngine(model, params, fp, gen),
+                              ServingEngine(model, params, fp, gen)])
+        frep = fleet_replay(router, fleet_trace)
+        assert frep["goodput_frac"] == 1.0, frep["goodput_frac"]
+        assert frep["routed_by_prefix"] > 0, frep["routed_by_prefix"]
+        assert frep["compiles_measured"] == 0, frep["compiles_measured"]
+
+        # rank 1's verdict arrives as one fixed-shape report tensor
+        parity, bytes_recv, compiles_decode, completed = (
+            int(x) for x in ops.broadcast(header_zero, from_process=1))
+        assert parity == 1, "decode-role tokens diverged from the fused replay"
+        assert bytes_recv == bytes_pred, (bytes_recv, bytes_pred)
+        assert compiles_decode == 0, compiles_decode
+        assert completed == n, (completed, n)
+        print(json.dumps({
+            "parity": True,
+            "requests": n,
+            "kv_dtype": schema["kv_dtype"],
+            "bytes_pred": bytes_pred,
+            "bytes_sent": bytes_sent,
+            "bytes_recv": bytes_recv,
+            "bytes_per_page": page_bytes,
+            "compiles_prefill": compiles,
+            "compiles_decode": compiles_decode,
+            "prewarm": prewarm,
+            "fleet": {
+                "replicas": frep["replicas"],
+                "goodput_frac": frep["goodput_frac"],
+                "routed_by_prefix": frep["routed_by_prefix"],
+                "prefix_hit_rate": frep["prefix_hit_rate"],
+                "compiles_measured": frep["compiles_measured"],
+            },
+        }))
+    else:
+        # -- decode role: adopt + scatter the received pages, then decode --
+        eng = ServingEngine(model, params, decode_plugin, gen)
+        eng.warmup()
+        _, recv_fn = _transfer_fns(geom)
+        # wire warmup, mirroring rank 0: a zero-page install compiles the
+        # scatter program, and the dummy round compiles every broadcast
+        # shape before the compile baseline
+        eng.cache = recv_fn(
+            eng.cache, jnp.asarray(0, jnp.int32),
+            {k: jnp.asarray(v) for k, v in payload_zero.items()},
+            jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+        )
+        ops.broadcast(header_zero)
+        for name in wire_names:
+            ops.broadcast(payload_zero[name])
+        base = eng.compile_events
+        bytes_recv = 0
+        for _ in range(n):
+            header = ops.broadcast(header_zero)
+            uid, plen, first, n_pages = (int(x) for x in header)
+            # the transport may widen small dtypes on the wire (gloo has no
+            # int8 lane) — restore the schema dtype HOST-side before the
+            # scatter, so the warmed recv program signature never changes
+            payload = {
+                name: np.asarray(ops.broadcast(payload_zero[name]),
+                                 schema["payload"][name][1])
+                for name in wire_names
+            }
+            slot = eng.adopt_prefilled(originals[uid], first)
+            eng.cache = recv_fn(
+                eng.cache, jnp.asarray(slot, jnp.int32),
+                {k: jnp.asarray(v) for k, v in payload.items()},
+                jnp.asarray(n_pages, jnp.int32), jnp.asarray(plen, jnp.int32),
+            )
+            bytes_recv += n_pages * page_bytes
+        while not eng.idle():
+            eng.step()
+        compiles = eng.compile_events - base
+        if work:
+            export_prewarm(os.path.join(work, "prewarm-decode.tar"),
+                           tag="fleet-decode")
+
+        # the parity oracle: a LOCAL fused replay of the same trace with
+        # the same decode-role config — received-bytes attention must be
+        # bitwise indistinguishable from local prefill
+        fused = ServingEngine(model, params, decode_plugin, gen)
+        fused.warmup()
+        fused_results = fused.run([dc.replace(r) for r in trace])
+        parity = fused_results == eng.results
+        ops.broadcast(np.asarray(
+            [int(parity), bytes_recv, compiles, len(eng.results)], np.int64),
+            from_process=1)
+
+    PartialState().destroy_process_group()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -557,24 +557,41 @@ def serve_report(args) -> dict:
                      predicted=ttft_no_reuse_ticks,
                      measured=rep["ttft_p50_ticks"],
                      source="bench.serve prefix baseline")
-    if getattr(args, "disaggregate", False) and n_adapters:
-        raise SystemExit(
-            "--disaggregate composes with the base model only for now "
-            "(adapter routing across the prefill→decode split is the "
-            "documented follow-up) — drop --adapters"
-        )
-    if getattr(args, "disaggregate", False):
-        from accelerate_tpu.serving import (
-            DisaggregatedPair, transfer_accounting,
-        )
+    # multi-tenant stores for the disaggregated/fleet replicas below: each
+    # engine pool publishes the SAME seeded adapter trees (a fleet shares
+    # the tenant registry), each from its own offload dir
+    _extra_store_dirs = []
 
-        # the first disaggregated prefill→decode slice on the same trace:
+    def _replica_store():
+        if n_adapters <= 0:
+            return None
+        d = tempfile.TemporaryDirectory(prefix="bench_fleet_adapters_")
+        _extra_store_dirs.append(d)
+        s = AdapterStore(params, lora_plugin, dtype=cfg.dtype,
+                         offload_dir=d.name)
+        for t in range(1, n_adapters + 1):
+            s.publish_random(t, jax.random.PRNGKey(1000 + t))
+        return s
+
+    def _make_pair():
+        from accelerate_tpu.serving import DisaggregatedPair
+
+        # one AdapterStore per role: the tenant crosses the prefill→decode
+        # split with its request (both-or-neither, enforced by the pair)
+        kw = {}
+        if n_adapters > 0:
+            kw = {"adapters": _replica_store(),
+                  "prefill_adapters": _replica_store()}
+        return DisaggregatedPair(model, params, plugin, gen_cfg, **kw)
+
+    if getattr(args, "disaggregate", False):
+        from accelerate_tpu.serving import transfer_accounting
+
+        # the disaggregated prefill→decode slice on the same trace:
         # page_transfer_bytes measured vs the dcn accounting model (the
         # transfer.page_bytes twin — exact unless a request never reached
-        # the handoff)
-        pair = DisaggregatedPair(
-            model, params, _dc.replace(plugin, speculate="off"), gen_cfg,
-        )
+        # the handoff); speculation and adapters ride the split
+        pair = _make_pair()
         pair.warmup()
         pair_results = pair.run(trace)
         pair_rep = pair.report()
@@ -591,6 +608,39 @@ def serve_report(args) -> dict:
     else:
         rep["disaggregated"] = {"page_transfers": 0, "page_transfer_bytes": 0,
                                 "token_parity_vs_fused": True}
+    n_fleet = getattr(args, "fleet", 0) or 0
+    if n_fleet > 0:
+        from accelerate_tpu.serving import FleetRouter, fleet_replay
+
+        # --fleet N: the same trace through N replicas (fused engines, or
+        # prefill→decode pairs with --disaggregate) behind the
+        # prefix-/adapter-affinity router — tokens must stay BITWISE equal
+        # to the single fused engine above, zero post-warmup compiles per
+        # replica (fleet_replay raises otherwise)
+        def _backend():
+            if getattr(args, "disaggregate", False):
+                return _make_pair()
+            return ServingEngine(model, params, plugin, gen_cfg,
+                                 adapters=_replica_store())
+
+        router = FleetRouter([_backend() for _ in range(n_fleet)])
+        fleet_rep = fleet_replay(router, trace)
+        fleet_results = fleet_rep.pop("results")
+        fleet_rep["token_parity_vs_fused"] = fleet_results == rep["results"]
+        rep["fleet"] = fleet_rep
+    else:
+        rep["fleet"] = {
+            "replicas": 0, "alive": 0, "policy": "",
+            "requests": 0, "completed": 0, "goodput_frac": 0.0,
+            "ttft_p50_ticks": 0.0, "prefix_hit_rate": 0.0,
+            "adapter_pool_hit_rate": 0.0, "page_transfer_bytes": 0,
+            "compiles_warmup_by_role": {}, "compiles_measured": 0,
+            "routed_by_prefix": 0, "routed_by_adapter": 0,
+            "routed_by_load": 0, "drain_events": [], "fleet_clock": 0,
+            "per_replica": [], "token_parity_vs_fused": True,
+        }
+    for d in _extra_store_dirs:
+        d.cleanup()
     if trace_out is not None and trace_out != "-":
         engine.trace.write_chrome_trace(trace_out)
         rep["trace_file"] = trace_out
@@ -839,6 +889,17 @@ def main():
                          "page_transfer_bytes is reported against the "
                          "dcn-accounting model (the transfer.page_bytes "
                          "twin, exact by construction)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="N",
+                    help="with --serve: route the same trace across N "
+                         "replicas (fused engines, or prefill→decode pairs "
+                         "with --disaggregate) behind the deterministic "
+                         "prefix-/adapter-affinity router "
+                         "(serving/router.py).  Adds the fleet block to the "
+                         "report (routed-by counts, per-replica occupancy "
+                         "and hit rates, drain events, fleet twins) — "
+                         "fields always present, zeros when N=0.  Tokens "
+                         "stay bitwise identical to the single fused "
+                         "engine, zero post-warmup compiles per replica")
     ap.add_argument("--trace-requests", nargs="?", const="-", default=None,
                     metavar="FILE",
                     help="with --serve: record request-level lifecycle spans "

@@ -1,0 +1,30 @@
+"""Corrected twin of ``planted_fleet.py``: a well-deployed router pair.
+
+Both roles quantize to int8, so the wire schemas agree (GL403 quiet) and
+the handoff wire-leg schedules are symmetric (GL401 quiet) — while the
+roles still size their OWN serving geometry (slots, pages, chunk,
+buckets, speculation differ freely across the split).  This is the
+contract the fleet router relies on: geometry is per-role, the wire
+schema is the pair's only shared law.
+"""
+
+
+def router_pair():
+    """``(model_config, prefill_plugin, decode_plugin)`` for
+    ``pair_preflight`` — audits clean, including the traced wire
+    programs (``trace_wire=True``)."""
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    cfg = LlamaConfig.tiny()
+    prefill = ServingPlugin(
+        num_slots=2, page_size=4, pages_per_slot=8, num_pages=20,
+        prefill_chunk=8, prefill_buckets=(4, 8), decode_kernel="native",
+        kv_dtype="int8",
+    )
+    decode = ServingPlugin(
+        num_slots=8, page_size=4, pages_per_slot=8, num_pages=64,
+        prefill_chunk=4, prefill_buckets=(4,), decode_kernel="native",
+        kv_dtype="int8", speculate="ngram", speculate_k=2,
+    )
+    return cfg, prefill, decode

@@ -1,0 +1,35 @@
+"""PLANTED BUG for the fleet router's go-live gate: a role-mismatched
+replica pair behind the router.
+
+The fleet router freely mixes fused engines and disaggregated pairs, and
+each pair's two roles may size their OWN geometry (slots, pages, chunk,
+buckets, speculation) — but the wire-schema fields (page_size,
+pages_per_slot, kv_dtype, prefix convention) are the cross-role contract.
+This fixture deploys a prefill role that quantizes KV pages to int8
+codes+scales against a decode role expecting dense bf16: routed through
+``pair_preflight`` the pair must fire **GL403** (the schemas disagree on
+kv_dtype, payload leaves, and bytes/page) AND **GL401** (the handoff
+wire-leg schedules diverge — the int8 side streams scale legs the dense
+side never receives, so a launched fabric wedges at the first handoff).
+Corrected twin: ``clean_fleet.py``.
+"""
+
+
+def router_pair():
+    """``(model_config, prefill_plugin, decode_plugin)`` for
+    ``pair_preflight`` — the mis-deployed replica the router gate must
+    reject before any traffic routes to it."""
+    from accelerate_tpu.models import LlamaConfig
+    from accelerate_tpu.utils.dataclasses import ServingPlugin
+
+    cfg = LlamaConfig.tiny()
+    prefill = ServingPlugin(
+        num_slots=2, page_size=4, pages_per_slot=8, num_pages=20,
+        prefill_chunk=8, prefill_buckets=(4, 8), decode_kernel="native",
+        kv_dtype="int8",  # the planted skew: codes+scales on the wire
+    )
+    decode = ServingPlugin(
+        num_slots=8, page_size=4, pages_per_slot=8, num_pages=64,
+        prefill_chunk=4, prefill_buckets=(4,), decode_kernel="native",
+    )
+    return cfg, prefill, decode

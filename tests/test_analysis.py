@@ -840,6 +840,34 @@ def test_pair_preflight_matched_pair_clean_and_planted_mismatch_fires():
     assert summary["schema_ok"] is False
 
 
+def test_fixture_fleet_planted_router_pair_fires_gl401_and_gl403():
+    """The fleet-router go-live gate: a role-mismatched replica pair
+    (int8 prefill vs dense decode) routed through ``pair_preflight`` fires
+    BOTH GL403 (schemas disagree) and GL401 (the handoff wire-leg
+    schedules diverge — the scale legs exist on one side only).
+    Trace-only — nothing compiles."""
+    from accelerate_tpu.analysis import pair_preflight
+
+    mod = _load_fixture("planted_fleet")
+    findings, summary = pair_preflight(*mod.router_pair())
+    rules = _rules_of(findings)
+    assert {"GL401", "GL403"} <= rules, findings
+    assert summary["schema_ok"] is False
+
+
+def test_fixture_fleet_clean_router_pair_quiet():
+    """The corrected twin: matched int8 wire schemas with per-role
+    geometry freedom (slots/pages/chunk/buckets/speculation differ across
+    the split) audits clean through the FULL gate, traced wire programs
+    included."""
+    from accelerate_tpu.analysis import pair_preflight
+
+    mod = _load_fixture("clean_fleet")
+    findings, summary = pair_preflight(*mod.router_pair())
+    assert findings == [], findings
+    assert summary["schema_ok"] and summary["wire_legs"]
+
+
 def test_every_rule_has_planted_and_clean_fixture_twins():
     """The fixture meta-gate: every registered GLxxx rule id appears in at
     least one planted-fires fixture AND at least one clean-quiet twin under

@@ -245,6 +245,25 @@ def test_bench_serve_smoke():
     assert extra["twins"]["prefix_cache.hit_rate"]["status"] == "idle"
     assert extra["twins"]["transfer.page_bytes"]["status"] == "idle"
 
+    # the fleet block rides EVERY serve report, zeros-clean without
+    # --fleet (ISSUE 19: the always-emitted contract — no replicas, no
+    # routing, parity vacuously true)
+    fleet = extra["fleet"]
+    for field in ("replicas", "alive", "policy", "requests", "completed",
+                  "goodput_frac", "ttft_p50_ticks", "prefix_hit_rate",
+                  "adapter_pool_hit_rate", "page_transfer_bytes",
+                  "compiles_warmup_by_role", "compiles_measured",
+                  "routed_by_prefix", "routed_by_adapter", "routed_by_load",
+                  "drain_events", "per_replica", "token_parity_vs_fused"):
+        assert field in fleet, field
+    assert fleet["replicas"] == fleet["alive"] == 0
+    assert fleet["goodput_frac"] == 0.0
+    assert fleet["page_transfer_bytes"] == 0
+    assert fleet["compiles_measured"] == 0
+    assert fleet["routed_by_prefix"] == fleet["routed_by_adapter"] == 0
+    assert fleet["drain_events"] == [] and fleet["per_replica"] == []
+    assert fleet["token_parity_vs_fused"] is True
+
     # idle trace: every field still present, zeros (the always-emitted
     # contract BENCH_*.json relies on)
     rep_idle = _run(["bench.py", "--serve", "--batch", "8",
@@ -294,6 +313,40 @@ def test_bench_serve_prefix_share_smoke():
     assert extra["page_transfer_bytes"] == \
         extra["transfer_accounting"]["page_transfer_bytes"] > 0
     assert extra["twins"]["transfer.page_bytes"]["rel_err"] == 0.0
+
+
+@pytest.mark.slow
+def test_bench_serve_fleet_smoke():
+    """``--serve --fleet 2``: the same seeded trace routed across two
+    replicas — merged tokens BITWISE equal to the single fused engine in
+    the same report, goodput 1.0, zero post-warmup compiles per replica,
+    and the shared-preamble trace actually routes by prefix affinity;
+    with ``--disaggregate`` each replica is a prefill→decode pair and KV
+    pages cross the wire."""
+    rep = _run(["bench.py", "--serve", "--batch", "4", "--serve-requests",
+                "10", "--prefix-share", "0.8", "--fleet", "2"])
+    fleet = rep["extra"]["fleet"]
+    assert fleet["replicas"] == fleet["alive"] == 2
+    assert fleet["policy"] == "affinity"
+    assert fleet["token_parity_vs_fused"] is True
+    assert fleet["goodput_frac"] == 1.0
+    assert fleet["completed"] == fleet["requests"] > 0
+    assert fleet["compiles_measured"] == 0
+    assert fleet["routed_by_prefix"] > 0
+    assert len(fleet["per_replica"]) == 2
+
+    # fleet of disaggregated pairs with adapters + speculation: the
+    # previously-forbidden combination rides the split per replica
+    rep2 = _run(["bench.py", "--serve", "--batch", "4", "--serve-requests",
+                 "10", "--prefix-share", "0.8", "--fleet", "2",
+                 "--disaggregate", "--adapters", "2", "--speculate", "2"])
+    fleet2 = rep2["extra"]["fleet"]
+    assert fleet2["token_parity_vs_fused"] is True
+    assert fleet2["goodput_frac"] == 1.0
+    assert fleet2["compiles_measured"] == 0
+    assert fleet2["page_transfer_bytes"] > 0
+    assert fleet2["adapter_pool_hit_rate"] > 0
+    assert set(fleet2["compiles_warmup_by_role"]) >= {"prefill", "decode"}
 
 
 @pytest.mark.slow

@@ -1,0 +1,434 @@
+"""Fleet router tests: prefix-/adapter-affinity routing over N replicas,
+drain/respawn on ``replica_kill``, fleet twins, and the multi-host fabric
+leg (ISSUE 19).
+
+The acceptance pins: a routed fleet's tokens are BITWISE identical to a
+single fused engine serving the same trace (prefix reuse + adapters +
+speculation all armed), zero post-warmup compiles on every replica
+(``fleet_replay`` raises otherwise), prefix-affinity routing beats
+round-robin on BOTH fleet prefix hit rate and p50 TTFT ticks on the
+seeded shared-preamble trace, and a ``replica_kill`` mid-traffic drains
+the victim through the survivors contract — pending work re-routes
+exactly once, surviving tokens stay bitwise equal to the fault-free
+fleet replay, and the fleet prefix twin counts each request's offered
+traffic exactly once across the re-route.
+
+Every engine in this module shares test_prefix_cache.py's geometry
+(slots=4, page=4, pool=24, chunk=8) so the process-shared jit cache
+compiles each program exactly once across the serving modules (the
+tier-1 time budget).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.generation import GenerationConfig
+from accelerate_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from accelerate_tpu.resilience import FaultEvent, FaultPlan
+from accelerate_tpu.serving import (
+    AdapterStore,
+    DisaggregatedPair,
+    FleetRouter,
+    ServingEngine,
+    fleet_chaos_replay,
+    fleet_replay,
+    replay,
+    synthesize_trace,
+)
+from accelerate_tpu.telemetry import SLOMonitor, twin_registry
+from accelerate_tpu.utils.dataclasses import LoraPlugin, ServingPlugin
+
+MAX_NEW = 16  # ONE decode budget for the module: every engine shares jits
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _plugin(**kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("pages_per_slot", 8)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("prefill_buckets", (4, 8))
+    kw.setdefault("decode_kernel", "native")
+    return ServingPlugin(**kw)
+
+
+def _gen():
+    return GenerationConfig(max_new_tokens=MAX_NEW)
+
+
+def _engine(tiny_model, store=None, **kw):
+    model, params = tiny_model
+    return ServingEngine(model, params, _plugin(**kw), _gen(), adapters=store)
+
+
+def _engine_fleet(tiny_model, n=2, policy="affinity", **kw):
+    kw.setdefault("prefix_cache", "on")
+    return FleetRouter([_engine(tiny_model, **kw) for _ in range(n)],
+                       policy=policy)
+
+
+def _store(tiny_model, n_tenants=2):
+    """A pool store with the SAME seeded adapter trees every call — a
+    fleet shares the tenant registry, each replica keeps its own pool."""
+    _, params = tiny_model
+    s = AdapterStore(params, LoraPlugin(rank=2, pool_slots=2),
+                     dtype=jnp.float32)
+    for t in range(1, n_tenants + 1):
+        s.publish_random(t, jax.random.PRNGKey(1000 + t))
+    return s
+
+
+def _shared_trace(seed, n, share=0.9, groups=2, pre_len=12, inter=1.0):
+    return synthesize_trace(
+        seed, n, vocab_size=256, mean_interarrival_steps=inter,
+        prompt_len_range=(4, 12), new_tokens_range=(4, 8),
+        prefix_share=share, shared_prefixes=groups, shared_prefix_len=pre_len,
+    )
+
+
+# ---------------------------------------------------------------------------
+# construction + policy validation
+# ---------------------------------------------------------------------------
+
+
+def test_router_rejects_empty_fleet_and_unknown_policy(tiny_model):
+    with pytest.raises(ValueError, match="at least one replica"):
+        FleetRouter([])
+    with pytest.raises(ValueError, match="routing policy"):
+        FleetRouter([_engine(tiny_model)], policy="random")
+
+
+def test_replica_kill_is_a_registered_fault_kind():
+    """``replica_kill`` validates as a fault kind; a typo still raises."""
+    FaultEvent("replica_kill", at=3)  # must not raise
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent("replica_smite", at=3)
+
+
+# ---------------------------------------------------------------------------
+# the fleet parity pin
+# ---------------------------------------------------------------------------
+
+
+def test_engine_fleet_parity_vs_fused(tiny_model):
+    """2 fused-engine replicas behind affinity routing: merged tokens are
+    BITWISE identical to ONE engine serving the same trace, goodput 1.0,
+    zero post-warmup compiles on every replica, and the shared-preamble
+    trace actually routes by prefix."""
+    trace = _shared_trace(3, 10)
+    router = _engine_fleet(tiny_model)
+    rep = fleet_replay(router, trace)
+    fused = replay(_engine(tiny_model, prefix_cache="on"), trace)
+    assert rep["results"] == fused["results"]
+    assert rep["goodput_frac"] == 1.0
+    assert rep["completed"] == len(trace)
+    assert rep["compiles_measured"] == 0
+    assert rep["routed_by_prefix"] > 0
+    assert rep["alive"] == rep["replicas"] == 2
+    assert len(rep["per_replica"]) == 2
+    assert all(row["routed"] > 0 for row in rep["per_replica"])
+
+
+def test_pair_fleet_parity_all_armed(tiny_model):
+    """The full fleet parity pin: 2 disaggregated prefill→decode pairs
+    (prefix reuse + multi-tenant adapters + speculative decode all armed,
+    one AdapterStore per role per replica) behind the router — tokens
+    BITWISE equal to one fused speculative engine with the same adapters,
+    KV pages crossed the wire (bytes > 0), zero post-warmup compiles, and
+    the warmup sweep reports compiles per role."""
+    model, params = tiny_model
+    trace = synthesize_trace(
+        23, 12, vocab_size=256, mean_interarrival_steps=1.0,
+        prompt_len_range=(4, 12), new_tokens_range=(4, 8),
+        adapters=2, prefix_share=0.6, shared_prefix_len=8,
+    )
+
+    def pair():
+        return DisaggregatedPair(
+            model, params,
+            _plugin(prefix_cache="on", speculate="ngram", speculate_k=2),
+            _gen(), adapters=_store(tiny_model),
+            prefill_adapters=_store(tiny_model),
+        )
+
+    router = FleetRouter([pair(), pair()])
+    rep = fleet_replay(router, trace)
+    fused = replay(
+        _engine(tiny_model, store=_store(tiny_model), prefix_cache="on",
+                speculate="ngram", speculate_k=2),
+        trace,
+    )
+    assert rep["results"] == fused["results"]
+    assert rep["goodput_frac"] == 1.0
+    assert rep["compiles_measured"] == 0
+    assert rep["page_transfer_bytes"] > 0
+    assert rep["adapter_pool_hit_rate"] > 0
+    roles = rep["compiles_warmup_by_role"]
+    assert set(roles) >= {"prefill", "decode"}, roles
+    assert all(row["role"] == "pair" for row in rep["per_replica"])
+
+
+# ---------------------------------------------------------------------------
+# the perf pin: prefix affinity beats round-robin
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_affinity_beats_round_robin(tiny_model):
+    """The routing win, CPU-measurable and deterministic: on a loaded
+    4-preamble trace (more hot preambles than one replica's cache can
+    keep resident) affinity routing converges each preamble class onto a
+    home replica while round-robin scatters them — affinity must beat
+    round-robin on BOTH the fleet prefix hit rate and p50 TTFT (virtual
+    ticks, the deterministic clock)."""
+    trace = _shared_trace(3, 24, share=0.95, groups=4, inter=0.5)
+    by_policy = {}
+    for policy in ("affinity", "round_robin"):
+        rep = fleet_replay(_engine_fleet(tiny_model, policy=policy), trace)
+        assert rep["goodput_frac"] == 1.0
+        assert rep["compiles_measured"] == 0
+        by_policy[policy] = rep
+    aff, rr = by_policy["affinity"], by_policy["round_robin"]
+    assert rr["routed_by_prefix"] == 0  # round-robin never routes by content
+    assert aff["routed_by_prefix"] > len(trace) // 2
+    assert aff["prefix_hit_rate"] > rr["prefix_hit_rate"], (
+        aff["prefix_hit_rate"], rr["prefix_hit_rate"])
+    assert aff["ttft_p50_ticks"] < rr["ttft_p50_ticks"], (
+        aff["ttft_p50_ticks"], rr["ttft_p50_ticks"])
+    # both policies keep token parity with each other — routing moves
+    # WHERE a request decodes, never what it says
+    assert aff["results"] == rr["results"]
+
+
+def test_adapter_affinity_keeps_tenants_home(tiny_model):
+    """A tenant sticks to replicas holding its adapter resident: after the
+    first placement pins the weights, later same-tenant arrivals route by
+    adapter affinity instead of scattering (the S-LoRA discipline)."""
+    trace = synthesize_trace(
+        7, 12, vocab_size=256, mean_interarrival_steps=1.0,
+        prompt_len_range=(4, 12), new_tokens_range=(4, 8), adapters=2,
+    )
+    router = FleetRouter([
+        _engine(tiny_model, store=_store(tiny_model)) for _ in range(2)
+    ])
+    rep = fleet_replay(router, trace)
+    assert rep["goodput_frac"] == 1.0
+    assert rep["routed_by_adapter"] > 0
+    assert rep["adapter_pool_hit_rate"] > 0
+
+
+# ---------------------------------------------------------------------------
+# replica_kill: drain, re-route, respawn
+# ---------------------------------------------------------------------------
+
+
+def test_replica_kill_drain_reroute_bitwise_parity(tiny_model):
+    """The chaos pin: a ``replica_kill`` mid-traffic drains the victim
+    (completed work stays completed), re-routes every pending request
+    exactly once, and the surviving tokens are BITWISE identical to the
+    fault-free fleet replay — with zero post-warmup compiles across the
+    drain."""
+    trace = _shared_trace(5, 12, inter=1.0)
+    rep = fleet_chaos_replay(
+        lambda: _engine_fleet(tiny_model), trace,
+        FaultPlan([FaultEvent("replica_kill", at=8)]),
+    )
+    assert rep["token_parity"] is True
+    assert rep["goodput_frac"] == 1.0
+    assert rep["completed"] == len(trace)
+    assert rep["faults_fired"] == 1
+    assert len(rep["drain_events"]) == 1
+    assert rep["drain_events"][0]["survivors"] > 0
+    assert rep["compiles_measured"] == 0
+    assert rep["alive"] == 1
+
+
+def test_drain_counts_offered_traffic_once(tiny_model):
+    """The fleet prefix twin's once-only contract: a drained request's
+    cacheable preamble was already counted as offered traffic on the
+    victim, so the re-route target must NOT count it again — the fleet's
+    total offered pages match the fault-free fleet's exactly."""
+
+    def offered(router):
+        return sum(
+            eng.prefix.stats["admission_lookups"]
+            for rep_ in router.replicas for eng in rep_.engines
+            if eng.prefix is not None
+        )
+
+    trace = _shared_trace(5, 12, inter=1.0)
+    clean = _engine_fleet(tiny_model)
+    fleet_replay(clean, trace)
+    chaos = _engine_fleet(tiny_model)
+    from accelerate_tpu.resilience import fault_plan
+
+    chaos.warmup()
+    with fault_plan(FaultPlan([FaultEvent("replica_kill", at=8)])):
+        chaos.run(trace)
+    assert len(chaos.drain_events) == 1
+    assert chaos.drain_events[0]["survivors"] > 0
+    assert offered(chaos) == offered(clean), (
+        "a drained request's preamble was double-counted across the "
+        "re-route")
+
+
+def test_respawn_restores_fleet_capacity(tiny_model):
+    """With a respawn factory the drain appends a fresh warmed replica:
+    capacity recovers, the fresh replica takes traffic, strict_compiles
+    still holds (the respawn warms before admitting)."""
+    trace = _shared_trace(9, 12, inter=0.5)
+    router = FleetRouter(
+        [_engine(tiny_model, prefix_cache="on") for _ in range(2)],
+        respawn=lambda i: _engine(tiny_model, prefix_cache="on"),
+    )
+    with_respawn = fleet_chaos_replay(
+        lambda: router, trace,
+        FaultPlan([FaultEvent("replica_kill", at=6)]),
+        baseline_parity=False,
+    )
+    assert with_respawn["goodput_frac"] == 1.0
+    assert with_respawn["replicas"] == 3      # victim kept + fresh appended
+    assert with_respawn["alive"] == 2
+    assert with_respawn["compiles_measured"] == 0
+
+
+def test_single_replica_fleet_ignores_kill(tiny_model):
+    """A 1-replica fleet with no respawn has nowhere to re-route: the kill
+    is ignored and every request still completes."""
+    trace = _shared_trace(11, 6, inter=1.0)
+    rep = fleet_chaos_replay(
+        lambda: _engine_fleet(tiny_model, n=1), trace,
+        FaultPlan([FaultEvent("replica_kill", at=5)]),
+    )
+    assert rep["goodput_frac"] == 1.0
+    assert rep["drain_events"] == []
+    assert rep["alive"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide degradation + twins + prewarm
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_ladder_escalates_in_lockstep(tiny_model):
+    """One breached SLO escalates EVERY alive replica's ladder one stage
+    (and recovery relaxes them all) — the fleet moves like one engine,
+    and callbacks the monitor already carried keep firing."""
+    router = _engine_fleet(tiny_model)
+    paged = []
+    mon = SLOMonitor({"token_latency_s": {"p50_trip": 0.5}},
+                     on_trip=lambda m, q, v: paged.append(m))
+    router.attach(mon)
+    for _ in range(8):
+        mon.observe("token_latency_s", 2.0)
+    for rep_ in router.replicas:
+        for eng in rep_.engines:
+            assert eng.ladder.stage == "despeculate"
+    assert paged == ["token_latency_s"]  # chained, not replaced
+    for _ in range(200):
+        mon.observe("token_latency_s", 0.001)
+    for rep_ in router.replicas:
+        for eng in rep_.engines:
+            assert eng.ladder.stage == "normal"
+
+
+def test_fleet_twins_recorded_and_zeros_clean(tiny_model):
+    """``fleet_replay`` records the fleet twin rows: request_goodput
+    measured 1.0 against the clean-run prediction 1.0 (status ok), the
+    hit-rate twins carry measured + predicted sides; an EMPTY trace keeps
+    every report field present and zeroed (the always-emitted
+    contract)."""
+    rep = fleet_replay(_engine_fleet(tiny_model), _shared_trace(13, 8))
+    assert rep["goodput_frac"] == 1.0
+    reg = twin_registry()
+    good = reg.get("fleet.request_goodput")
+    assert good is not None and good.status == "ok", good
+    assert good.measured == good.predicted == 1.0
+    prefix_twin = reg.get("fleet.prefix_hit_rate")
+    assert prefix_twin is not None
+    assert prefix_twin.measured == pytest.approx(rep["prefix_hit_rate"])
+    assert prefix_twin.predicted is not None
+
+    idle = fleet_replay(_engine_fleet(tiny_model), [])
+    assert idle["requests"] == idle["completed"] == 0
+    assert idle["goodput_frac"] == 0.0
+    assert idle["ttft_p50_ticks"] == 0.0
+    assert idle["prefix_hit_rate"] == 0.0
+    assert idle["adapter_pool_hit_rate"] == 0.0
+    assert idle["page_transfer_bytes"] == 0
+    assert idle["compiles_measured"] == 0
+    assert idle["drain_events"] == []
+
+
+def test_fleet_prewarm_pack_shared_across_replicas(tiny_model, tmp_path):
+    """``warmup(prewarm_dir=...)`` packs one ``export_prewarm`` tar per
+    role; a later fleet pointed at the same directory loads it before
+    warming (the cross-process compile-cache hand-off the fabric leg
+    exercises for real)."""
+    router = FleetRouter([_engine(tiny_model, prefix_cache="on")
+                          for _ in range(2)])
+    by_role = router.warmup(prewarm_dir=str(tmp_path))
+    assert (tmp_path / "prewarm-engine.tar").exists()
+    assert set(by_role) == {"engine"}
+    again = FleetRouter([_engine(tiny_model, prefix_cache="on")])
+    again.warmup(prewarm_dir=str(tmp_path))  # loads, must not raise
+    assert again.compiles_measured() == {0: 0}
+
+
+# ---------------------------------------------------------------------------
+# the multi-host fabric leg (slow: real process boundaries)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_fabric_two_process_launch(tmp_path):
+    """The fabric across REAL process boundaries: rank 0 (prefill role)
+    streams finished KV pages — int8 codes + fp32 amax scales — to rank 1
+    (decode role, speculation armed) over the dcn broadcast plumbing.
+    Pins: bitwise token parity vs a fused serve, bytes sent == received ==
+    the dcn byte model (tolerance 0), ZERO post-warmup compiles per role,
+    one prewarm pack exported per role, and the on-rank fleet-router
+    smoke."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from accelerate_tpu.test_utils import fleet_fabric_script_path
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_",
+                                "FSDP_"))}
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+    env["FLEET_LEG_DIR"] = str(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+         "--num_processes", "2", "--num_cpu_devices", "1",
+         str(fleet_fabric_script_path())],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    payload = json.loads(
+        [l for l in r.stdout.splitlines() if l.startswith("{")][-1])
+    assert payload["parity"] is True
+    assert payload["bytes_sent"] == payload["bytes_recv"] \
+        == payload["bytes_pred"] > 0
+    assert payload["compiles_prefill"] == payload["compiles_decode"] == 0
+    assert (tmp_path / "prewarm-prefill.tar").exists()
+    assert (tmp_path / "prewarm-decode.tar").exists()
+    smoke = payload["fleet"]
+    assert smoke["goodput_frac"] == 1.0
+    assert smoke["routed_by_prefix"] > 0
+    assert smoke["compiles_measured"] == 0

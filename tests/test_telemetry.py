@@ -302,6 +302,17 @@ def test_all_standard_twins_register_from_their_accounting_sites():
                         schema["page_bytes"],
                         source="serving/transfer.PagedKVTransport")
 
+    # 20-22. fleet rows (serving/router.fleet_replay): goodput measured vs
+    # the clean-run model, fleet-aggregate prefix/adapter hit rates vs the
+    # single-cache/-pool trace models (tests/test_router.py drives the real
+    # site end-to-end; the stand-ins here pin registry membership)
+    reg.record("fleet.request_goodput", predicted=1.0, measured=1.0,
+               source="serving/router.fleet_replay")
+    reg.record("fleet.prefix_hit_rate", predicted=0.5, measured=0.4,
+               source="serving/router.fleet_replay")
+    reg.record("fleet.adapter_pool_hit_rate", predicted=0.75, measured=0.5,
+               source="serving/router.fleet_replay")
+
     rows = reg.drift_report()
     for name in STANDARD_TWINS:
         assert name in rows, name
