@@ -13,7 +13,7 @@
 FAST_BUDGET_S := 180
 FAST_HARD_S := 240
 
-.PHONY: test test-all test-examples quality lint preflight
+.PHONY: test test-all test-examples quality lint preflight chaos
 
 test:
 	@cache=/tmp/accelerate_tpu_test_jax_cache; \
@@ -53,6 +53,17 @@ text = pathlib.Path('/tmp/graft-lint.json').read_text(); \
 rep = Report.from_json(text); \
 assert json.loads(rep.to_json()) == json.loads(text), 'lint --json did not round-trip'; \
 print(f'lint --json round-trip ok ({len(rep.findings)} findings)')"
+
+# chaos tier: the full resilience story — the fault-injection matrix
+# (tests/test_resilience.py, slow tier included: subprocess SIGTERM /
+# corruption / resume legs) plus the 2-process recovery-ladder dryrun
+# (__graft_entry__._recovery_leg: peer-RAM rung beats disk, torn-wave crc
+# fallback, agreed preemption at mismatched boundaries, bitwise resume).
+# Kept out of tier-1 on purpose — budget ~minutes, run before releases
+# and after touching resilience/, checkpointing.py, or the step wrapper.
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py tests/test_train_fabric.py -q
+	JAX_PLATFORMS=cpu python -c "import __graft_entry__ as g; print('recovery leg:', g._recovery_leg())"
 
 # deploy preflight: the lint sweep + AOT compile of every production
 # program (train step + the serving bucket ladder) + the compiled-artifact

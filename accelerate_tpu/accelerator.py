@@ -61,6 +61,7 @@ from .parallel.sharding import (
 from .parallelism_config import ParallelismConfig
 from .resilience import faults as _faults
 from .resilience import guard as _guard
+from .resilience import peer_ckpt as _peer_ckpt
 from .resilience.goodput import GoodputTracker
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState, PartialState
@@ -396,6 +397,9 @@ class Accelerator:
 
             self.slo_monitor = SLOMonitor(self.telemetry_plugin.slo)
         self._slo_prev_step_t = None  # inter-step cadence anchor
+        # buddy-rank host-RAM snapshotter (resilience/peer_ckpt.py): armed
+        # lazily by the prepared step when peer_snapshot_every > 0
+        self._peer_snapshotter = None
         self._preemption = None
         if self.resilience_plugin.handle_preemption:
             self.install_preemption_handler()
@@ -1790,6 +1794,18 @@ class Accelerator:
                             else _signal.SIGTERM)
                 elif ev.kind == "nan_grad":
                     batch = _faults.poison_batch(batch)
+                elif ev.kind == "straggler":
+                    # deterministic host stall: skews this rank's step-
+                    # boundary arrival against its peers (what the agreed
+                    # preemption stop must absorb without shard skew)
+                    time.sleep(_faults.STRAGGLER_STALL_S)
+                elif ev.kind == "rank_loss":
+                    # this rank's state is gone — NOT retryable; the caller
+                    # routes the gang through Accelerator.recover()'s ladder
+                    raise _faults.RankLostError(
+                        f"injected rank loss at step {self.step_count + 1} "
+                        f"(process {self.process_index})"
+                    )
             if not getattr(self, "_in_accumulate", False):
                 self.step_count += 1
                 # goodput counts in step_count units (the accumulate()
@@ -1839,6 +1855,20 @@ class Accelerator:
                 if prev is not None:
                     slo.observe("step_time_s", now - prev)
                 slo.observe("goodput_frac", self.goodput.goodput_frac())
+            rp = self.resilience_plugin
+            if rp.peer_snapshot_every > 0 and not getattr(self, "_in_accumulate", False):
+                # peer-redundant hot snapshot (resilience/peer_ckpt.py): armed
+                # lazily at the first post-step boundary so the schema gate
+                # sees the REAL prepared state; the device→host copy inside is
+                # the only synchronous part (CheckFreq), and it runs on the
+                # NEW state — the donated input buffers are already dead here,
+                # so there is no aliasing window (the GL206 hazard)
+                if self._peer_snapshotter is None:
+                    self._peer_snapshotter = _peer_ckpt.PeerSnapshotter(
+                        new_state, rp.peer_snapshot_every,
+                        keep=rp.peer_snapshot_keep,
+                    )
+                self._peer_snapshotter.maybe_snapshot(new_state, self.step_count)
             if self._preemption is not None and self._agreed_preemption():
                 # stop AT the step boundary: the post-step state is exactly
                 # consistent with the dataloader position and step counters,
@@ -2294,6 +2324,10 @@ class Accelerator:
             "preemption requested: stopping at step boundary (step_count=%d)",
             self.step_count,
         )
+        # count the preemption BEFORE the emergency save so the persisted
+        # goodput counters (checkpoint metadata) include the very event
+        # that wrote them — the resumed incarnation restores preemptions=1
+        self.goodput.record_preemption()
         try:
             self.wait_for_checkpoint()
             if rp.emergency_checkpoint and train_state is not None:
@@ -2314,8 +2348,6 @@ class Accelerator:
                 "resume code anyway — resume will fall back to the newest "
                 "valid periodic checkpoint", type(e).__name__, e,
             )
-        finally:
-            self.goodput.record_preemption()
         raise SystemExit(rp.resume_exit_code)
 
     @property
@@ -2346,6 +2378,89 @@ class Accelerator:
             self.step_count, self.goodput.restarts,
         )
         return restored
+
+    @property
+    def peer_snapshotter(self):
+        """The buddy-rank host-RAM snapshotter, or ``None`` until the
+        prepared step arms it (``ResiliencePlugin.peer_snapshot_every > 0``
+        and at least one snapshot boundary has passed construction)."""
+        return self._peer_snapshotter
+
+    def recover(self, train_state=None, *, lost_local: bool = False,
+                **load_kwargs):
+        """Walk the recovery ladder after a fault (``RankLostError``, a
+        restarted rank, a torn snapshot): newest consistent **peer-RAM**
+        wave → newest **verified disk** checkpoint → **fresh start**.
+
+        Collective in multi-process runs — every rank must call it together
+        (the wave agreement and any buddy re-stream are collectives).
+        ``lost_local=True`` marks THIS rank's own state as gone (the
+        ``rank_loss`` fault): its local waves are dropped first, so recovery
+        exercises the buddy's copy for real.
+
+        Returns ``(train_state_or_None, report)`` where ``report`` carries
+        ``restore_path`` (``"peer"`` / ``"disk"`` / ``"fresh"``),
+        ``restored_step``, ``steps_recomputed``, ``peer_snapshot_bytes`` and
+        ``restore_time_s`` — the shape bench.py's always-emitted ``recovery``
+        block mirrors.  Records the measured ``recovery.restore_time_s``
+        twin."""
+        from .telemetry import twin_registry
+
+        t0 = time.perf_counter()
+        prev_step = self.step_count
+        report = {
+            "restore_path": "fresh",
+            "restored_step": 0,
+            "steps_recomputed": 0,
+            "peer_snapshot_bytes": 0,
+            "restore_time_s": 0.0,
+        }
+        restored = None
+        snap = self._peer_snapshotter
+        if snap is not None and train_state is not None:
+            if lost_local:
+                snap.forget_local()
+            got = snap.recover(train_state)
+            if got is not None:
+                restored, step = got
+                self.step_count = int(step)
+                report["restore_path"] = "peer"
+                report["restored_step"] = int(step)
+                report["peer_snapshot_bytes"] = snap.schema["snapshot_bytes"]
+                self.goodput.record_restart(
+                    steps_recomputed=max(0, prev_step - int(step)))
+        if restored is None:
+            # disk rung: newest VERIFIED checkpoint (corrupt ones fall
+            # through inside load_state's valid-fallback scan)
+            try:
+                restored = self.maybe_resume(train_state=train_state,
+                                             **load_kwargs)
+            except Exception as e:  # corrupted-beyond-fallback → fresh
+                logger.error(
+                    "disk recovery failed (%s: %s); starting fresh",
+                    type(e).__name__, e,
+                )
+                restored = None
+            if restored is not None or self.step_count != prev_step:
+                report["restore_path"] = "disk"
+                report["restored_step"] = int(self.step_count)
+                self.goodput.steps_recomputed += max(
+                    0, prev_step - self.step_count)
+            else:
+                self.step_count = 0
+                self.goodput.record_restart(steps_recomputed=prev_step)
+        report["steps_recomputed"] = max(0, prev_step - report["restored_step"])
+        report["restore_time_s"] = round(time.perf_counter() - t0, 6)
+        twin_registry().record_measured(
+            "recovery.restore_time_s", report["restore_time_s"],
+            source="Accelerator.recover",
+        )
+        logger.warning(
+            "recovered via %s rung at step %d (replaying %d steps, %.3fs)",
+            report["restore_path"], report["restored_step"],
+            report["steps_recomputed"], report["restore_time_s"],
+        )
+        return restored, report
 
     def save_model(self, train_state_or_params, save_directory: str, max_shard_size: str = "10GB", safe_serialization: bool = True):
         from .checkpointing import save_model

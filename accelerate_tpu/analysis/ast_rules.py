@@ -767,6 +767,110 @@ def _rule_timing_without_block(index: _ModuleIndex, path: str) -> list[Finding]:
     return findings
 
 
+# GL206: calls that DRAIN a pending async snapshot (or otherwise fence the
+# background read) — any of these between the initiator and the donating
+# call closes the aliasing window
+_SNAPSHOT_DRAIN_NAMES = frozenset({
+    "wait_for_checkpoint",
+    "wait_for_pending_checkpoint",
+    "wait_until_finished",
+    "block_until_ready",
+    "join",
+    "end_training",
+})
+
+
+def _rule_snapshot_donation_race(index: _ModuleIndex, path: str) -> list[Finding]:
+    """GL206: a TrainState name handed to an async checkpoint initiator
+    (``async_save=True``) is later passed in a DONATED position with no
+    rebind or drain in between.
+
+    The background write may still be reading the very buffers the compiled
+    program then overwrites in place — the snapshot-aliasing race the
+    sharding-preserving copy in ``save_accelerator_state`` (and the
+    ``np.array(copy=True)`` in ``peer_ckpt._host_view``) exists to close.
+    User code that starts its OWN async write and then donates the same
+    state re-opens it.  Rebinding the name (``state, m = step(state, b)``
+    consumed by a later save) or any drain call
+    (:data:`_SNAPSHOT_DRAIN_NAMES`) between the two closes the window."""
+    findings: list[Finding] = []
+    scopes: list = [index.tree] + list(index.functions)
+    for scope in scopes:
+        own = (
+            lambda n: index.enclosing_function(n) is scope
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else index.enclosing_function(n) is None
+        )
+        initiators: list[tuple[ast.Call, set]] = []  # (call, snapshotted names)
+        donators: list[tuple[ast.Call, list]] = []   # (call, donated names)
+        drains: list[int] = []
+        rebinds: dict[str, list[int]] = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Name) and own(node):
+                aug = isinstance(index._parent.get(id(node)), ast.AugAssign)
+                if isinstance(node.ctx, (ast.Store, ast.Del)) and not aug:
+                    rebinds.setdefault(node.id, []).append(node.lineno)
+            if not (isinstance(node, ast.Call) and own(node)):
+                continue
+            fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                     else node.func.id if isinstance(node.func, ast.Name)
+                     else None)
+            if fname in _SNAPSHOT_DRAIN_NAMES:
+                drains.append(node.lineno)
+                continue
+            if any(kw.arg == "async_save"
+                   and isinstance(kw.value, ast.Constant)
+                   and kw.value.value is True
+                   for kw in node.keywords):
+                names = {a.id for a in node.args if isinstance(a, ast.Name)}
+                names |= {kw.value.id for kw in node.keywords
+                          if kw.arg != "async_save"
+                          and isinstance(kw.value, ast.Name)}
+                if names:
+                    initiators.append((node, names))
+                continue
+            donated: tuple[int, ...] = ()
+            if isinstance(node.func, ast.Name) and node.func.id in index.donated_callables:
+                donated = index.donated_callables[node.func.id]
+            elif isinstance(node.func, ast.Call) and index._is_jit_call(node.func):
+                donated = _donate_positions(node.func)
+            dnames = [
+                node.args[i].id
+                for i in donated
+                if i < len(node.args) and isinstance(node.args[i], ast.Name)
+            ]
+            if dnames:
+                donators.append((node, dnames))
+        for init, snap_names in initiators:
+            init_end = getattr(init, "end_lineno", init.lineno) or init.lineno
+            for call, dnames in sorted(donators, key=lambda c: c[0].lineno):
+                if call.lineno <= init_end:
+                    continue
+                hot = [n for n in dnames if n in snap_names]
+                if not hot:
+                    continue
+                if any(init_end < l <= call.lineno for l in drains):
+                    break  # drained: this and every later donation is safe
+                name = hot[0]
+                if any(init_end < l < call.lineno
+                       for l in rebinds.get(name, [])):
+                    continue  # rebound: the snapshotted buffer is detached
+                findings.append(
+                    _finding(
+                        "GL206",
+                        f"`{name}` was handed to an async checkpoint at line "
+                        f"{init.lineno} (async_save=True) and is donated here "
+                        "with no drain or rebind in between: the background "
+                        "write may still be reading the buffers the compiled "
+                        "program overwrites in place — drain "
+                        "(wait_for_checkpoint) or snapshot-copy first",
+                        path, call.lineno,
+                    )
+                )
+                break  # one finding per initiator keeps the report readable
+    return findings
+
+
 _ALL_RULES = (
     _rule_donated_reuse,
     _rule_host_sync,
@@ -776,6 +880,7 @@ _ALL_RULES = (
     _rule_shape_dependent_trace,
     _rule_jit_in_hot_loop,
     _rule_timing_without_block,
+    _rule_snapshot_donation_race,
 )
 
 

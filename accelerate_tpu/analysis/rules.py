@@ -211,6 +211,19 @@ RULES: dict[str, Rule] = {
             "never silently swallow exceptions on the save/restore spine — "
             "log, re-raise, or route through resilience.retry.with_retries",
         ),
+        Rule(
+            "GL206", "donate-under-pending-snapshot", Severity.ERROR, "ast",
+            "a TrainState name handed to an async checkpoint initiator "
+            "(async_save=True) is later passed in a donated position with "
+            "no rebind or drain in between: the background write may still "
+            "be reading the very buffers the compiled program overwrites "
+            "in place — the snapshot-aliasing race the sharding-preserving "
+            "copy in save_accelerator_state exists to close, re-opened by "
+            "user code",
+            "drain first (wait_for_checkpoint / wait_for_pending_checkpoint"
+            ") or snapshot the state (sharding-preserving copy) before "
+            "donating it",
+        ),
         # ------------------------------------------------------------------
         # compiled engine (GL301-303) + recompile-cause rules (GL304-306):
         # what the lowered XLA executable actually does, and the trace- and

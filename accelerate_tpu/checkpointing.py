@@ -652,6 +652,11 @@ def save_accelerator_state(
             "step_count": accelerator.step_count,
             "num_processes": accelerator.num_processes,
             "mixed_precision": accelerator.mixed_precision,
+            # goodput counters ride the metadata (NOT the numbered custom-
+            # object pickles — those are positional, and shifting user
+            # registrations against old checkpoints would mis-restore them)
+            # so goodput_frac and its twin span process restarts
+            "goodput": accelerator.goodput.state_dict(),
         }
         (output_dir / METADATA_NAME).write_text(json.dumps(meta))
 
@@ -837,6 +842,8 @@ def _load_checkpoint_dir(
     if (input_dir / METADATA_NAME).exists():
         meta = json.loads((input_dir / METADATA_NAME).read_text())
         accelerator.step_count = meta.get("step_count", 0)
+        if "goodput" in meta:
+            accelerator.goodput.load_state_dict(meta["goodput"])
 
     for i, obj in enumerate(accelerator._custom_objects):
         f = input_dir / CUSTOM_STATES_NAME.format(i)

@@ -202,6 +202,13 @@ def _spawn_local_workers(cmd, args, config) -> int:
             f"(attempt {attempt}/{max_restarts}) after exit code {code}",
             file=sys.stderr,
         )
+        from ..resilience.preemption import RESUME_EXIT_CODE
+
+        if code == RESUME_EXIT_CODE:
+            # the gang stopped gracefully at an agreed boundary and wrote an
+            # emergency checkpoint — arm the elastic-resume signal so the
+            # restarted workers pick it up instead of starting from scratch
+            config.env["ACCELERATE_AUTO_RESUME"] = "true"
         if auto_port:
             config.main_process_port = None
 

@@ -7,8 +7,12 @@ The subsystem that turns faults from run-killers into accounting entries:
   transfers, checkpoint corruption);
 - :mod:`.guard` — jit-compatible NaN/Inf skip-step with persisted counters
   and a consecutive-skip abort;
-- :mod:`.preemption` — SIGTERM → step boundary → emergency checkpoint →
-  distinct resume exit code (75, ``EX_TEMPFAIL``);
+- :mod:`.preemption` — SIGTERM → cross-rank agreed stop → emergency
+  checkpoint at one common step → distinct resume exit code (75,
+  ``EX_TEMPFAIL``);
+- :mod:`.peer_ckpt` — buddy-rank host-RAM snapshots (Gemini-style): the
+  fast rung of the recovery ladder, crc-verified, byte-priced by
+  ``peer_ckpt_accounting`` at tolerance 0;
 - :mod:`.retry` — bounded retry/backoff for checkpoint I/O and host↔device
   staging;
 - :mod:`.goodput` — measured + predicted goodput accounting (the
@@ -23,9 +27,11 @@ lives in :mod:`accelerate_tpu.checkpointing`; the knobs live on
 from .faults import (  # noqa: F401
     CORRUPTION_MODES,
     FAULT_KINDS,
+    STRAGGLER_STALL_S,
     FaultEvent,
     FaultPlan,
     InjectedTransferError,
+    RankLostError,
     active_fault_plan,
     corrupt_checkpoint,
     fault_plan,
@@ -35,6 +41,17 @@ from .faults import (  # noqa: F401
     poison_batch,
 )
 from .goodput import GoodputTracker, goodput_accounting  # noqa: F401
+from .peer_ckpt import (  # noqa: F401
+    HostSnapshot,
+    PeerSchemaError,
+    PeerSnapshotCorruptError,
+    PeerSnapshotter,
+    capture_host_snapshot,
+    check_snapshot_schemas,
+    peer_ckpt_accounting,
+    restore_host_snapshot,
+    snapshot_schema,
+)
 from .guard import (  # noqa: F401
     GUARD_METRIC_KEYS,
     NanGuardAbort,

@@ -48,6 +48,21 @@ site                      kinds
                           ``remaining_requests()`` and the router re-routes
                           its survivors exactly once (tokens stay bitwise —
                           the fleet chaos leg pins it)
+``step`` (training)       ``rank_loss`` — one training rank dies at a step
+                          boundary (:class:`RankLostError` from the prepared
+                          step): the gang rolls back through the recovery
+                          ladder (``resilience/peer_ckpt.py`` — newest
+                          consistent peer/host-RAM snapshot, else the newest
+                          verified disk checkpoint) and ``straggler`` — a
+                          deterministic host-side stall on this rank, so
+                          preemption notices land at *mismatched* boundaries
+                          and the agreed-stop reduction has real skew to
+                          close over
+``peer_snapshot``         ``partial_ckpt`` — the peer-replicated snapshot
+                          wave just streamed is torn on the receiving side
+                          (one stored leaf corrupted): the crc gate must
+                          skip the wave and the recovery ladder fall back to
+                          an older consistent wave or disk
 ========================  =====================================================
 
 Occurrence counting is per-site and 1-based: an event ``FaultEvent("preempt",
@@ -75,7 +90,8 @@ from .retry import TransientIOError
 logger = get_logger(__name__)
 
 FAULT_KINDS = ("preempt", "nan_grad", "transfer", "corrupt_ckpt", "cancel",
-               "deadline", "prefix", "replica_kill")
+               "deadline", "prefix", "replica_kill", "rank_loss", "straggler",
+               "partial_ckpt")
 
 # default hook site per kind (a transfer event may override its site to
 # "checkpoint_io"/"adapter_transfer"/"adapter_memmap" to target checkpoint
@@ -97,6 +113,16 @@ KIND_DEFAULT_SITE = {
     # fleet-replica loss: the router's per-tick hook drains the victim and
     # re-routes its pending work to the surviving replicas (exactly once)
     "replica_kill": "fleet_route",
+    # training-rank loss: the prepared step raises RankLostError at the
+    # boundary; the harness routes the gang through the recovery ladder
+    # (peer RAM -> verified disk -> fresh, resilience/peer_ckpt.py)
+    "rank_loss": "step",
+    # deterministic host-side stall on this rank's step: skews the boundary
+    # arrival times the agreed preemption stop must reduce over
+    "straggler": "step",
+    # torn peer-snapshot stream: the receiver's stored copy of the wave is
+    # corrupted; the crc gate skips it on restore
+    "partial_ckpt": "peer_snapshot",
 }
 
 CORRUPTION_MODES = ("truncate", "bitflip")
@@ -104,6 +130,23 @@ CORRUPTION_MODES = ("truncate", "bitflip")
 
 class InjectedTransferError(TransientIOError):
     """The fault plan's transient transfer failure (retryable by design)."""
+
+
+class RankLostError(RuntimeError):
+    """An injected ``rank_loss`` fault: this rank's training state is gone.
+
+    Raised by the prepared step at the boundary the plan names — NOT
+    retryable.  The training loop (or the chaos harness) is expected to
+    route the gang through the recovery ladder
+    (:meth:`~accelerate_tpu.Accelerator.recover`): the lost rank's newest
+    snapshot lives in its buddy's host RAM, and the whole gang rolls back to
+    the newest wave every rank can restore."""
+
+
+# deterministic host-side stall a ``straggler`` fault injects (seconds):
+# long enough to skew step-boundary arrival times across ranks, short
+# enough for CI
+STRAGGLER_STALL_S = 0.25
 
 
 @dataclasses.dataclass(frozen=True)

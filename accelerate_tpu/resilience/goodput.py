@@ -71,6 +71,25 @@ class GoodputTracker:
         else:
             self.transfer_retries += 1
 
+    # -- persistence (save_accelerator_state rides this through METADATA) ---
+
+    _PERSISTED = ("steps", "nan_skips", "restarts", "preemptions",
+                  "steps_recomputed", "time_lost_s", "io_retries",
+                  "transfer_retries")
+
+    def state_dict(self) -> dict:
+        """Counters only — ``started_at`` stays per-incarnation on purpose:
+        ``goodput_frac``'s time fraction measures THIS process's wall clock,
+        while the step/skip/restart counters span the whole run across
+        restarts (so ``goodput.goodput_frac`` reflects the replayed work a
+        preemption cost, not just the post-resume slice)."""
+        return {k: getattr(self, k) for k in self._PERSISTED}
+
+    def load_state_dict(self, sd: dict) -> None:
+        for k in self._PERSISTED:
+            if k in sd:
+                setattr(self, k, type(getattr(self, k))(sd[k]))
+
     # -- reductions ---------------------------------------------------------
 
     def goodput_frac(self) -> float:

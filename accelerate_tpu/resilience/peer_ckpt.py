@@ -1,0 +1,450 @@
+"""Peer-redundant hot checkpoints: buddy-rank host-RAM snapshots for training.
+
+Disk checkpoints survive anything but cost a filesystem round-trip on every
+restore; a preempted gang that only lost ONE rank's state should not pay it.
+Gemini (Wang et al., SOSP'23) shows the cheap middle rung: keep the newest
+snapshots in a *peer's* host RAM, so rank loss recovers over the interconnect
+in seconds, and CheckFreq (Mohan et al., FAST'21) shows the snapshot itself
+can be nearly free — the device→host copy is the only synchronous part, and
+it runs every few steps instead of every checkpoint interval.
+
+:class:`PeerSnapshotter` is that middle rung for this repo's training loop:
+
+- **Two-phase snapshot.**  Phase 1 (synchronous): copy every ``TrainState``
+  leaf to host RAM with an explicit ``np.array(copy=True)`` — the copy is
+  load-bearing, it breaks aliasing with the donated device buffers the next
+  step overwrites in place (the exact hazard graft-lint's GL206 flags when
+  user code skips it).  Phase 2: stream the host snapshot to the buddy rank
+  (``rank ^ 1``) over the dcn/gloo broadcast plumbing in sorted wire-name
+  order, the discipline ``serving/transfer.py`` established — both ranks
+  issue identical collectives, receivers pass schema-shaped zeros.
+- **Schema gate.**  Construction derives :func:`snapshot_schema` from the
+  state template and all-reduces its hash; ranks whose templates disagree
+  fail LOUDLY at arm time, not with a shape error mid-exchange.
+- **CRC-verified recovery.**  :meth:`PeerSnapshotter.recover` intersects the
+  waves every rank can still obtain (its own host copies ∪ what its buddy
+  holds for it), agrees on the newest common wave with fixed-shape int64
+  collectives, re-streams missing copies from buddies, re-verifies per-leaf
+  crc32s, and rebuilds device arrays on the template's shardings.  A torn or
+  bit-flipped copy (the ``partial_ckpt`` fault) fails crc and drops that
+  wave out of the intersection — the gang falls back to an older wave or,
+  past the RAM horizon, to :meth:`~accelerate_tpu.Accelerator.recover`'s
+  disk rung.
+
+The predicted/measured twin: :func:`peer_ckpt_accounting` prices a snapshot
+wave in bytes from the schema alone (predicted side of
+``recovery.peer_snapshot_bytes``); each phase-1 capture records the measured
+side.  Tolerance is 0 — any disagreement between the model and the captured
+host bytes is a bug, same contract as ``transfer.page_bytes``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import time
+import zlib
+from typing import Any
+
+import jax
+import numpy as np
+
+
+class PeerSchemaError(RuntimeError):
+    """Ranks tried to arm peer snapshots over disagreeing state schemas."""
+
+
+class PeerSnapshotCorruptError(RuntimeError):
+    """A peer-held snapshot failed crc re-verification after re-streaming."""
+
+
+def _flat_leaves(train_state) -> dict[str, Any]:
+    """Wire-name → leaf, the checkpoint convention: flatten order indexed by
+    position, typed PRNG keys exposed as their raw key data."""
+    from ..checkpointing import _is_key_array
+
+    leaves = jax.tree_util.tree_flatten(train_state)[0]
+    out = {}
+    for i, leaf in enumerate(leaves):
+        if _is_key_array(leaf):
+            leaf = jax.random.key_data(leaf)
+        out[str(i)] = leaf
+    return out
+
+
+def snapshot_schema(train_state) -> dict:
+    """Wire schema of one snapshot wave: per-leaf (shape, dtype) plus the
+    total byte price.  Both the construction-time cross-rank gate and
+    :func:`peer_ckpt_accounting` read THIS dict, so they cannot drift."""
+    leaves = {}
+    total = 0
+    for name, leaf in _flat_leaves(train_state).items():
+        shape = tuple(int(s) for s in np.shape(leaf))
+        dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        leaves[name] = {"shape": list(shape), "dtype": dtype.str}
+        total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return {"leaves": leaves, "snapshot_bytes": int(total)}
+
+
+def check_snapshot_schemas(a: dict, b: dict) -> None:
+    """Raise :class:`PeerSchemaError` unless two schemas agree exactly."""
+    if a != b:
+        mine, theirs = set(a["leaves"]), set(b["leaves"])
+        extra = sorted(mine ^ theirs)
+        raise PeerSchemaError(
+            "peer snapshot schemas disagree"
+            + (f" (leaf set differs: {extra})" if extra else
+               f" (byte price {a['snapshot_bytes']} != {b['snapshot_bytes']}"
+               " or per-leaf shape/dtype mismatch)")
+        )
+
+
+def peer_ckpt_accounting(train_state) -> dict:
+    """Predicted byte price of one peer snapshot wave.
+
+    Records the predicted side of the ``recovery.peer_snapshot_bytes`` twin
+    (tolerance 0 vs the captured host bytes) — the ``offload_transfer_accounting``
+    pattern applied to the recovery ladder."""
+    schema = snapshot_schema(train_state)
+    from ..telemetry import twin_registry
+
+    twin_registry().record_predicted(
+        "recovery.peer_snapshot_bytes", float(schema["snapshot_bytes"]),
+        source="resilience/peer_ckpt.peer_ckpt_accounting",
+    )
+    return {
+        "leaves": len(schema["leaves"]),
+        "snapshot_bytes": schema["snapshot_bytes"],
+        "kind": "predicted",
+    }
+
+
+@dataclasses.dataclass
+class HostSnapshot:
+    """One captured wave: host-RAM leaves + per-leaf crc32s."""
+
+    step: int
+    leaves: dict[str, np.ndarray]
+    crc: dict[str, int]
+    nbytes: int
+    taken_at: float
+
+    def verify(self) -> bool:
+        return all(
+            zlib.crc32(np.ascontiguousarray(v).tobytes()) & 0xFFFFFFFF == self.crc[k]
+            for k, v in self.leaves.items()
+        )
+
+
+def _host_view(x) -> np.ndarray:
+    """Device leaf → detached host copy.  ``copy=True`` is the CheckFreq
+    phase-1 contract: after this returns, the donated device buffer may be
+    overwritten by the next step without corrupting the snapshot."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return np.array(x.addressable_data(0), copy=True)
+    return np.array(jax.device_get(x), copy=True)
+
+
+def capture_host_snapshot(train_state, step: int = 0) -> HostSnapshot:
+    """Phase 1 alone: one crc-tagged host-RAM copy of a live state (the
+    synchronous part of the two-phase snapshot — also handy standalone for
+    compile-free state cloning in harnesses)."""
+    host = {k: _host_view(v) for k, v in _flat_leaves(train_state).items()}
+    return HostSnapshot(
+        step=int(step),
+        leaves=host,
+        crc={k: zlib.crc32(np.ascontiguousarray(v).tobytes()) & 0xFFFFFFFF
+             for k, v in host.items()},
+        nbytes=sum(int(v.nbytes) for v in host.values()),
+        taken_at=time.monotonic(),
+    )
+
+
+def restore_host_snapshot(snap: HostSnapshot, template):
+    """Host wave → device state on the template's shardings (typed PRNG keys
+    re-wrapped from raw key data, the checkpoint discipline).  Only the
+    template's METADATA is read — donated/deleted leaves are fine."""
+    from ..checkpointing import _is_key_array
+
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out = []
+    for i, leaf in enumerate(leaves):
+        host = snap.leaves[str(i)]
+        if _is_key_array(leaf):
+            kd = jax.eval_shape(jax.random.key_data, leaf)
+            host_t = np.asarray(host, dtype=kd.dtype)
+            arr = jax.make_array_from_callback(
+                kd.shape, leaf.sharding, lambda idx, h=host_t: h[idx])
+            out.append(jax.random.wrap_key_data(
+                arr, impl=jax.random.key_impl(leaf)))
+        elif isinstance(leaf, jax.Array):
+            host_t = np.asarray(host, dtype=leaf.dtype)
+            out.append(jax.make_array_from_callback(
+                leaf.shape, leaf.sharding, lambda idx, h=host_t: h[idx]))
+        else:
+            out.append(np.array(host, copy=True))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _buddy(rank: int, world: int) -> int:
+    """Pair adjacent ranks (0↔1, 2↔3, …); the odd rank out buddies itself
+    (its 'peer' copies are just extra local waves — still crc-verified)."""
+    b = rank ^ 1
+    return b if b < world else rank
+
+
+class PeerSnapshotter:
+    """Interval-driven buddy-rank host-RAM snapshots of one ``TrainState``.
+
+    Armed lazily by the prepared step when
+    ``ResiliencePlugin.peer_snapshot_every > 0``; the Accelerator exposes it
+    as ``accelerator.peer_snapshotter``.  Holds the newest ``keep`` waves of
+    its OWN state (``local``) and of its buddy's (``peer``)."""
+
+    def __init__(self, template, interval: int, *, keep: int = 2):
+        if interval <= 0:
+            raise ValueError("peer snapshot interval must be positive")
+        if keep < 1:
+            raise ValueError("peer_snapshot_keep must be >= 1")
+        from ..state import PartialState
+
+        state = PartialState()
+        self.rank = state.process_index
+        self.world = state.num_processes
+        self.buddy = _buddy(self.rank, self.world)
+        self.interval = int(interval)
+        self.keep = int(keep)
+        self.schema = snapshot_schema(template)
+        self.local: list[HostSnapshot] = []   # my waves, newest last
+        self.peer: list[HostSnapshot] = []    # buddy's waves I hold for it
+
+        # gate 1: peer copies require process-replicated leaves — a leaf
+        # whose process-local block is a strict subset of the global value
+        # cannot be re-streamed whole from one buddy's RAM
+        for name, leaf in _flat_leaves(template).items():
+            if (isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+                    and leaf.addressable_data(0).shape != leaf.shape):
+                raise PeerSchemaError(
+                    f"peer snapshots need process-replicated state, but leaf "
+                    f"{name} is sharded across processes "
+                    f"(local block {leaf.addressable_data(0).shape} != global "
+                    f"{leaf.shape}); use dp_shard within one process or disk "
+                    f"checkpoints"
+                )
+
+        # gate 2: every rank must see the SAME schema (the transfer.py
+        # discipline) — hash it and all-gather the fixed-shape digest so a
+        # mismatch fails loudly at arm time on every rank at once
+        if self.world > 1:
+            from jax.experimental import multihost_utils
+
+            digest = hashlib.sha256(
+                json.dumps(self.schema, sort_keys=True).encode()
+            ).digest()[:8]
+            mine = np.frombuffer(digest, dtype=np.int64)
+            gathered = np.asarray(
+                multihost_utils.process_allgather(mine, tiled=False)
+            ).reshape(self.world, -1)
+            if not (gathered == gathered[0]).all():
+                raise PeerSchemaError(
+                    "peer snapshot schema hash differs across ranks — "
+                    "templates disagree in shape, dtype, or leaf order"
+                )
+
+    # -- phase 1 + 2: capture and exchange ---------------------------------
+
+    def maybe_snapshot(self, train_state, step: int) -> HostSnapshot | None:
+        if step % self.interval != 0:
+            return None
+        return self.snapshot(train_state, step)
+
+    def snapshot(self, train_state, step: int) -> HostSnapshot:
+        """Capture one wave (synchronous device→host copy) and stream it to
+        the buddy.  EVERY rank in the gang must call this at the same step —
+        phase 2 is collective."""
+        snap = capture_host_snapshot(train_state, step)
+        from ..telemetry import twin_registry
+
+        twin_registry().record_measured(
+            "recovery.peer_snapshot_bytes", float(snap.nbytes),
+            source="resilience/peer_ckpt.PeerSnapshotter",
+        )
+        self.local.append(snap)
+        del self.local[: -self.keep]
+        if self.world > 1:
+            self._exchange(snap)
+        self._maybe_tear()
+        return snap
+
+    def _exchange(self, snap: HostSnapshot) -> None:
+        """Phase 2: every rank broadcasts its wave; each rank keeps only its
+        buddy's copy.  All ranks issue the SAME collectives in the SAME
+        sorted wire-name order (receivers pass schema-shaped zeros; gloo
+        widens small dtypes, so receivers restore dtype host-side)."""
+        from ..ops import operations
+
+        for src in range(self.world):
+            received: dict[str, np.ndarray] = {}
+            crc_vec_in = np.zeros(len(self.schema["leaves"]), dtype=np.int64)
+            names = sorted(self.schema["leaves"], key=int)
+            if src == self.rank:
+                crc_vec_in = np.array([snap.crc[n] for n in names], dtype=np.int64)
+            # mask on receive: without x64 the collective narrows int64 to
+            # int32, wrapping crcs above 2**31 negative — the low 32 bits
+            # (all a crc32 has) survive the trip
+            crc_vec = np.asarray(
+                operations.broadcast(crc_vec_in, from_process=src)
+            ).astype(np.int64) & 0xFFFFFFFF
+            for name in names:
+                spec = self.schema["leaves"][name]
+                dtype = np.dtype(spec["dtype"])
+                if src == self.rank:
+                    payload = snap.leaves[name]
+                else:
+                    payload = np.zeros(tuple(spec["shape"]), dtype=dtype)
+                out = operations.broadcast(payload, from_process=src)
+                if _buddy(src, self.world) == self.rank and src != self.rank:
+                    received[name] = np.asarray(out, dtype=dtype).reshape(
+                        tuple(spec["shape"])
+                    ).copy()
+            if received:
+                self.peer.append(HostSnapshot(
+                    step=snap.step,
+                    leaves=received,
+                    crc={n: int(crc_vec[i]) for i, n in enumerate(names)},
+                    nbytes=sum(int(v.nbytes) for v in received.values()),
+                    taken_at=time.monotonic(),
+                ))
+                del self.peer[: -self.keep]
+
+    def _maybe_tear(self) -> None:
+        """``partial_ckpt`` fault hook: tear the newest stored copy (peer if
+        any, else local) by flipping one byte WITHOUT updating its crc, so
+        recovery's re-verification must skip the wave."""
+        from .faults import fault_point
+
+        for ev in fault_point("peer_snapshot"):
+            if ev.kind != "partial_ckpt":
+                continue
+            store = self.peer if self.peer else self.local
+            if not store:
+                continue
+            snap = store[-1]
+            name = sorted(snap.leaves, key=int)[0]
+            leaf = np.ascontiguousarray(snap.leaves[name])
+            flat = leaf.view(np.uint8).reshape(-1)
+            flat[0] ^= 0xFF
+            snap.leaves[name] = flat.view(leaf.dtype.str).reshape(leaf.shape)
+
+    # -- rank-loss bookkeeping ---------------------------------------------
+
+    def forget_local(self) -> None:
+        """Simulate this rank's state loss (the ``rank_loss`` fault): drop
+        every wave of OUR OWN state.  What the buddy holds for us survives —
+        that is the whole point."""
+        self.local.clear()
+
+    def reset(self) -> None:
+        self.local.clear()
+        self.peer.clear()
+
+    # -- recovery -----------------------------------------------------------
+
+    def newest_restorable_step(self) -> int | None:
+        """Newest crc-valid wave THIS rank could restore alone (no
+        collectives — safe to call rank-locally for reporting)."""
+        steps = [s.step for s in self.local if s.verify()]
+        if self.buddy == self.rank:
+            steps += [s.step for s in self.peer if s.verify()]
+        return max(steps) if steps else None
+
+    def recover(self, template):
+        """Collectively agree on the newest wave EVERY rank can restore,
+        re-stream missing copies from buddies, and rebuild the state on the
+        template's shardings.  Returns ``(train_state, step)`` or ``None``
+        when no common wave survives (callers fall back to disk).
+
+        All ranks must call this together — the agreement and any re-send
+        are collective."""
+        if self.world <= 1:
+            candidates = [s for s in self.local + self.peer if s.verify()]
+            if not candidates:
+                return None
+            snap = max(candidates, key=lambda s: s.step)
+            return self._restore(snap, template), snap.step
+
+        from jax.experimental import multihost_utils
+
+        def _vec(snaps):
+            steps = sorted({s.step for s in snaps if s.verify()})[-self.keep:]
+            v = np.full(self.keep, -1, dtype=np.int64)
+            v[: len(steps)] = steps
+            return v
+
+        mine = np.asarray(multihost_utils.process_allgather(
+            _vec(self.local), tiled=False)).reshape(self.world, self.keep)
+        held = np.asarray(multihost_utils.process_allgather(
+            _vec(self.peer), tiled=False)).reshape(self.world, self.keep)
+
+        # computed identically on every rank: rank r can obtain a wave it
+        # still holds, or one its buddy holds FOR it
+        common: set[int] | None = None
+        for r in range(self.world):
+            obtainable = {int(s) for s in mine[r] if s >= 0}
+            obtainable |= {int(s) for s in held[_buddy(r, self.world)] if s >= 0}
+            common = obtainable if common is None else common & obtainable
+        if not common:
+            return None
+        agreed = max(common)
+
+        names = sorted(self.schema["leaves"], key=int)
+        snap = next((s for s in self.local if s.step == agreed and s.verify()), None)
+        from ..ops import operations
+
+        # re-send legs: for every rank missing the agreed wave, its buddy
+        # streams the held copy back — again all ranks issue identical
+        # collectives, in rank order then sorted wire-name order
+        for r in range(self.world):
+            if any(int(s) == agreed for s in mine[r]):
+                continue
+            src = _buddy(r, self.world)
+            src_snap = None
+            if src == self.rank:
+                src_snap = next(
+                    (s for s in self.peer if s.step == agreed and s.verify()), None)
+            crc_vec_in = np.zeros(len(names), dtype=np.int64)
+            if src_snap is not None:
+                crc_vec_in = np.array(
+                    [src_snap.crc[n] for n in names], dtype=np.int64)
+            crc_vec = np.asarray(
+                operations.broadcast(crc_vec_in, from_process=src)
+            ).astype(np.int64) & 0xFFFFFFFF  # undo the x64-off int32 wrap
+            received = {}
+            for name in names:
+                spec = self.schema["leaves"][name]
+                dtype = np.dtype(spec["dtype"])
+                payload = (src_snap.leaves[name] if src_snap is not None
+                           else np.zeros(tuple(spec["shape"]), dtype=dtype))
+                out = operations.broadcast(payload, from_process=src)
+                if r == self.rank:
+                    received[name] = np.asarray(out, dtype=dtype).reshape(
+                        tuple(spec["shape"])).copy()
+            if r == self.rank:
+                snap = HostSnapshot(
+                    step=agreed,
+                    leaves=received,
+                    crc={n: int(crc_vec[i]) for i, n in enumerate(names)},
+                    nbytes=sum(int(v.nbytes) for v in received.values()),
+                    taken_at=time.monotonic(),
+                )
+                if not snap.verify():
+                    raise PeerSnapshotCorruptError(
+                        f"re-streamed wave {agreed} failed crc re-verification"
+                    )
+        if snap is None:  # pragma: no cover - agreement guarantees a copy
+            return None
+        return self._restore(snap, template), agreed
+
+    def _restore(self, snap: HostSnapshot, template):
+        return restore_host_snapshot(snap, template)

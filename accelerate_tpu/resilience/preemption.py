@@ -22,13 +22,16 @@ the only thing standing between "preemption" and "lost work" is this flow
 re-run me" — deliberately distinct from 0 (done) and 1 (crash) so restart
 policies can re-queue preemptions without masking real failures.
 
-Known multi-host limitation: the stop flag is per-process and uncoordinated
-— ranks that receive the signal at different step boundaries would write
-shards of different steps into one emergency checkpoint.  Cloud preemption
-notices are per-VM and sliced TPU jobs lose the whole slice together, so in
-practice every worker receives the same SIGTERM; a belt-and-braces
-cross-rank flag reduction (max over ranks before the boundary check) is the
-follow-up for mixed-arrival topologies, tracked in docs/resilience.md.
+Multi-host coordination: the flag itself stays per-process (signal context
+allows nothing more), but the boundary check is **gang-agreed** — the
+Accelerator's step wrapper reduces the flag across ranks (max over the gang
+via ``process_allgather``, throttled by
+``ResiliencePlugin.preemption_check_every``) before acting on it, so a
+SIGTERM that lands on ONE rank mid-interval stops EVERY rank at the same
+lockstep boundary and the emergency checkpoint's shards all carry one step.
+The 2-process chaos harness (``test_utils/scripts/train_fabric.py``,
+``preempt`` mode) pins exactly this: mismatched signal arrival → a single
+consistent emergency checkpoint, metadata step agreed across ranks.
 """
 
 from __future__ import annotations

@@ -63,6 +63,13 @@ STANDARD_TWINS: dict[str, tuple] = {
     # resilience/goodput.goodput_accounting (or the clean-run model) vs
     # GoodputTracker
     "goodput.goodput_frac": ("frac", 0.1, None),
+    # resilience/peer_ckpt.peer_ckpt_accounting vs PeerSnapshotter's captured
+    # host bytes — priced from the SAME schema dict, so tolerance 0.0: ANY
+    # disagreement is an error
+    "recovery.peer_snapshot_bytes": ("bytes", 0.0, 0.0),
+    # Accelerator.recover wall time — informational (no analytic model
+    # predicts host I/O latency; tolerance 1.0 never errors)
+    "recovery.restore_time_s": ("s", 1.0, 1.0),
     # the recompile guard: predicted 0 post-warmup vs the monitoring stream
     # — tolerance 0.0: ANY disagreement is an error
     "compiles.steady_state": ("events", 0.0, 0.0),

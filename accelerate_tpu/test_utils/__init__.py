@@ -37,6 +37,14 @@ def launch_parity_script_path() -> Path:
     return Path(__file__).parent / "scripts" / "launch_parity.py"
 
 
+def train_fabric_script_path() -> Path:
+    """Path to the 2-process training chaos harness (coordinated preemption
+    at mismatched boundaries, rank-loss recovery through the peer-RAM →
+    disk ladder, torn peer snapshots; consumed by
+    __graft_entry__._recovery_leg and tests/test_train_fabric.py)."""
+    return Path(__file__).parent / "scripts" / "train_fabric.py"
+
+
 def fleet_fabric_script_path() -> Path:
     """Path to the 2-process disaggregated serving fabric worker (prefill
     role on rank 0 streams KV pages to the decode role on rank 1 over the

@@ -1,0 +1,307 @@
+"""2-process training chaos harness: the recovery ladder under real faults.
+
+The ``fleet_fabric.py`` pattern applied to TRAINING — launched by
+``accelerate_tpu launch`` (2 procs × 1 CPU device each, mesh ``dcn=2``),
+it drives the same tiny deterministic MLP as ``launch_parity.py`` through
+three fault stories and prints one JSON verdict line (rank 0):
+
+``chaos`` mode (one launch, three passes against a clean reference):
+  A. ``rank_loss`` at step 7 with peer snapshots every 2 steps and a disk
+     checkpoint at step 4 — recovery must take the **peer-RAM** rung
+     (wave 6, held in the buddy's host RAM), replay FEWER steps than the
+     disk rung would, and continue with the loss trajectory bitwise equal
+     to the uninterrupted run.
+  B. ``partial_ckpt`` tears the wave-6 peer copies mid-exchange, then
+     ``rank_loss`` at 7 — the crc gate must drop the torn wave and the
+     gang agrees on wave 4 instead (still peer, still bitwise).
+  C. ``rank_loss`` at 3 with peer snapshots disarmed — the ladder falls
+     through to the newest **verified disk** checkpoint (step 2) and
+     still recovers bitwise.
+  Zero new compiles across passes B and C (each includes a recovery and a
+  full step trace): every program — the step, the peer-exchange
+  collectives, the recovery agreement and re-stream legs, the checkpoint
+  save copies — warms during the reference pass and pass A.
+
+``preempt`` mode: a ``straggler`` stall on rank 0 and a real SIGTERM on
+rank 1 at the SAME nominal step — maximally mismatched arrival at the
+boundary.  The agreed stop must still drain both ranks at one step and
+write ONE consistent emergency checkpoint (the caller verifies: exit 75,
+a single checkpoint whose metadata step matches on every shard).
+
+``resume`` mode: relaunched with ``--resume`` over the ``preempt`` dir;
+prints the resume point and the continued losses (the caller pins them
+bitwise against the chaos reference tail) plus post-first-step compiles
+(must be 0 — same topology, warmed persistent cache).
+
+Env contract:
+  TRAIN_FABRIC_MODE        chaos | preempt | resume   (default chaos)
+  TRAIN_FABRIC_DIR         project dir (checkpoints; required)
+  TRAIN_FABRIC_STEPS       total steps (default 8)
+  TRAIN_FABRIC_PEER_EVERY  peer snapshot interval (default 2)
+  TRAIN_FABRIC_PREEMPT_AT  preempt/straggler step for ``preempt`` mode
+                           (default 5)
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _build(work, peer_every):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.utils.dataclasses import (
+        FullyShardedDataParallelPlugin,
+        ProjectConfiguration,
+        ResiliencePlugin,
+        ShardingStrategy,
+    )
+
+    acc = Accelerator(
+        parallelism_config=ParallelismConfig(dcn_size=2, dp_shard_size=-1),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            sharding_strategy=ShardingStrategy.NO_SHARD
+        ),
+        resilience_plugin=ResiliencePlugin(
+            handle_preemption=True,
+            nan_guard=False,
+            peer_snapshot_every=peer_every,
+        ),
+        project_config=ProjectConfiguration(
+            project_dir=work, automatic_checkpoint_naming=True
+        ),
+    )
+
+    def loss_fn(p, b):
+        h = jax.nn.relu(b["x"] @ p["w1"])
+        return jnp.mean(((h @ p["w2"])[:, 0] - b["y"]) ** 2)
+
+    k1, k2 = jax.random.split(jax.random.key(0))
+    params = {
+        "w1": np.asarray(jax.random.normal(k1, (8, 16))) * 0.3,
+        "w2": np.asarray(jax.random.normal(k2, (16, 1))) * 0.3,
+    }
+    state0 = acc.create_train_state(params, optax.sgd(0.05))
+
+    # compile-free per-pass reset: create_train_state once, clone via the
+    # host-snapshot round-trip (a fresh create per pass would re-jit the
+    # optax init closures and poison the zero-compile pins)
+    from accelerate_tpu.resilience.peer_ckpt import (
+        capture_host_snapshot,
+        restore_host_snapshot,
+    )
+
+    init_snap = capture_host_snapshot(state0)
+
+    def fresh_state():
+        return restore_host_snapshot(init_snap, state0)
+
+    step = acc.prepare_train_step(loss_fn)
+    return acc, fresh_state, step
+
+
+def _batches(acc, steps):
+    """Deterministic GLOBAL stream, materialized ONCE through one prepared
+    loader (each pass replays the same per-host blocks by index; the batch
+    arg is not donated, so reuse is safe)."""
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(8,)).astype(np.float32)
+    raw = []
+    for _ in range(steps):
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        raw.append({"x": x, "y": (x @ w_true).astype(np.float32)})
+
+    def source():
+        for b in raw:
+            yield b
+
+    return list(acc.prepare_data_loader(source()))
+
+
+def _install(plan_events):
+    from accelerate_tpu.resilience.faults import FaultPlan, install_fault_plan
+
+    install_fault_plan(FaultPlan(plan_events))
+
+
+def _chaos(acc, fresh_state, step, batches, steps, peer_every):
+    from accelerate_tpu.resilience.faults import FaultEvent
+    from accelerate_tpu.resilience.peer_ckpt import peer_ckpt_accounting
+    from accelerate_tpu.resilience import RankLostError
+
+    victim = 1 if acc.num_processes > 1 else 0
+
+    # ---- reference pass: uninterrupted, snapshots armed (warms the
+    # peer-exchange collectives alongside the step program) ----------------
+    state = fresh_state()
+    ref_losses = []
+    for b in batches:
+        state, m = step(state, b)
+        ref_losses.append(float(m["loss"]))
+    predicted_bytes = peer_ckpt_accounting(state)["snapshot_bytes"]
+    measured_bytes = acc.peer_snapshotter.local[-1].nbytes
+    compiles_ref = acc.compile_events
+
+    def run_pass(plan, disk_save_at, peer_armed=True):
+        """One faulted pass: fresh state, fault plan installed on BOTH
+        ranks (the gang notices a lost rank together — divergent collective
+        schedules would deadlock), recovery on RankLostError, then finish
+        the trace and return the verdicts."""
+        acc.peer_snapshotter.reset()
+        acc.resilience_plugin.peer_snapshot_every = peer_every if peer_armed else 0
+        acc.step_count = 0
+        _install(plan)
+        state = fresh_state()
+        losses = []
+        i = 0
+        report = None
+        prefix_len = 0
+        while i < len(batches):
+            try:
+                out_state, m = step(state, batches[i])
+            except RankLostError:
+                prefix_len = len(losses)
+                state, report = acc.recover(
+                    train_state=state,
+                    lost_local=acc.process_index == victim,
+                    load_sampler_states=False,
+                )
+                assert state is not None, "recovery fell through to fresh"
+                i = acc.step_count
+                continue
+            state = out_state
+            losses.append(float(m["loss"]))
+            i += 1
+            if disk_save_at is not None and i == disk_save_at:
+                acc.save_state(train_state=state)
+        _install([])  # disarm
+        assert report is not None, "fault plan never fired"
+        # bitwise parity: the pre-fault prefix, then the replayed-and-
+        # continued tail from the restored step — both against the
+        # uninterrupted reference (same batches, same init)
+        expect = ref_losses[:prefix_len] + ref_losses[report["restored_step"]:]
+        return {
+            "restore_path": report["restore_path"],
+            "restored_step": report["restored_step"],
+            "steps_recomputed": report["steps_recomputed"],
+            "parity": losses == expect,
+        }
+
+    # ---- pass A: rank loss with a fresh wave in the buddy's RAM ----------
+    a = run_pass([FaultEvent("rank_loss", at=7)], disk_save_at=4)
+    compiles_after_a = acc.compile_events
+
+    # ---- pass B: the newest wave is TORN (partial_ckpt) — crc gate must
+    # drop it and the gang falls back one wave, still peer ------------------
+    b = run_pass(
+        [FaultEvent("partial_ckpt", at=3), FaultEvent("rank_loss", at=7)],
+        disk_save_at=None,
+    )
+
+    # ---- pass C: peer snapshots DISARMED — the disk rung catches ---------
+    c = run_pass([FaultEvent("rank_loss", at=3)], disk_save_at=2,
+                 peer_armed=False)
+    acc.resilience_plugin.peer_snapshot_every = peer_every
+
+    return {
+        "mode": "chaos",
+        "ref_losses": ref_losses,
+        "predicted_bytes": predicted_bytes,
+        "measured_bytes": measured_bytes,
+        "pass_a": a,
+        "pass_b": b,
+        "pass_c": c,
+        "disk_step_a": 4,
+        "compiles_passes_bc": acc.compile_events - compiles_after_a,
+        "num_processes": acc.num_processes,
+    }
+
+
+def _preempt(acc, fresh_state, step, batches, preempt_at):
+    from accelerate_tpu.resilience.faults import FaultEvent
+
+    # maximally mismatched boundary arrival: rank 0 stalls, rank 1 gets a
+    # REAL SIGTERM — the agreed stop must still drain both at one step
+    if acc.process_index == 0:
+        _install([FaultEvent("straggler", at=preempt_at)])
+    else:
+        _install([FaultEvent("preempt", at=preempt_at)])
+    state = fresh_state()
+    for b in batches:
+        state, m = step(state, b)
+    # unreachable in a multi-process run: the agreed stop exits 75 first
+    return {"mode": "preempt", "completed": acc.step_count,
+            "num_processes": acc.num_processes}
+
+
+def _resume(acc, fresh_state, step, batches):
+    state = fresh_state()
+    restored = acc.maybe_resume(train_state=state, load_sampler_states=False)
+    if restored is not None:
+        state = restored
+    start = acc.step_count
+    losses = []
+    compiles_first = None
+    for b in batches[start:]:
+        state, m = step(state, b)
+        losses.append(float(m["loss"]))
+        if compiles_first is None:
+            compiles_first = acc.compile_events
+    compiles_after_first = acc.compile_events - (compiles_first or 0)
+    restarts = acc.goodput.restarts
+    # uninterrupted reference trajectory (for the bitwise-parity pin):
+    # replayed AFTER the measurements above so its steps can't mask a
+    # post-resume compile; everything is warmed, so it adds zero compiles
+    acc.resilience_plugin.peer_snapshot_every = 0
+    acc.step_count = 0
+    ref_state = fresh_state()
+    ref_losses = []
+    for b in batches:
+        ref_state, m = step(ref_state, b)
+        ref_losses.append(float(m["loss"]))
+    return {
+        "mode": "resume",
+        "start": start,
+        "losses": losses,
+        "ref_losses": ref_losses,
+        "compiles_after_first": compiles_after_first,
+        "goodput_restarts": restarts,
+        "num_processes": acc.num_processes,
+    }
+
+
+def main():
+    mode = os.environ.get("TRAIN_FABRIC_MODE", "chaos")
+    steps = int(os.environ.get("TRAIN_FABRIC_STEPS", "8"))
+    peer_every = int(os.environ.get("TRAIN_FABRIC_PEER_EVERY", "2"))
+    preempt_at = int(os.environ.get("TRAIN_FABRIC_PREEMPT_AT", "5"))
+    work = os.environ["TRAIN_FABRIC_DIR"]
+
+    acc, fresh_state, step = _build(work, peer_every)
+    batches = _batches(acc, steps)
+
+    if mode == "chaos":
+        rep = _chaos(acc, fresh_state, step, batches, steps, peer_every)
+    elif mode == "preempt":
+        rep = _preempt(acc, fresh_state, step, batches, preempt_at)
+    elif mode == "resume":
+        rep = _resume(acc, fresh_state, step, batches)
+    else:
+        raise SystemExit(f"unknown TRAIN_FABRIC_MODE {mode!r}")
+
+    if acc.is_main_process:
+        print(json.dumps(rep))
+    acc.end_training()
+    from accelerate_tpu import PartialState
+
+    PartialState().destroy_process_group()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -444,6 +444,14 @@ class ResiliencePlugin(KwargsHandler):
     io_retries: int = 3                     # bounded retry budget for
                                             # checkpoint I/O + host transfers
     io_backoff_s: float = 0.05              # first backoff; doubles per retry
+    peer_snapshot_every: int = 0            # >0: CheckFreq-style host snapshot
+                                            # of the TrainState every N steps,
+                                            # replicated to the buddy rank's
+                                            # host RAM (resilience/peer_ckpt) —
+                                            # the fast rung of the recovery
+                                            # ladder.  0 disables.
+    peer_snapshot_keep: int = 2             # newest waves kept per side
+                                            # (local + buddy copies)
 
     def __post_init__(self):
         armed = parse_flag_from_env("ACCELERATE_RESILIENCE")
@@ -464,6 +472,15 @@ class ResiliencePlugin(KwargsHandler):
             )
         if self.io_retries < 0:
             raise ValueError(f"io_retries must be >= 0, got {self.io_retries}")
+        if self.peer_snapshot_every < 0:
+            raise ValueError(
+                "peer_snapshot_every must be >= 0 (0 disables peer "
+                f"snapshots), got {self.peer_snapshot_every}"
+            )
+        if self.peer_snapshot_keep < 1:
+            raise ValueError(
+                f"peer_snapshot_keep must be >= 1, got {self.peer_snapshot_keep}"
+            )
 
 
 @dataclass

@@ -1507,6 +1507,19 @@ def main():
         "compiles_measured": compiles_measured,
     }
     extra_report["goodput"] = goodput
+    # Recovery-ladder block — ALWAYS emitted, zeros-clean: a bench run never
+    # walks the ladder (restore_path "none", zero bytes/seconds); when peer
+    # snapshots are armed the snapshotter's captured bytes land here and the
+    # recovery.peer_snapshot_bytes twin (tolerance 0 vs peer_ckpt_accounting)
+    # carries the drift verdict.
+    snap = acc.peer_snapshotter
+    extra_report["recovery"] = {
+        "restore_path": "none",
+        "peer_snapshot_bytes": (
+            snap.schema["snapshot_bytes"] if snap is not None else 0
+        ),
+        "restore_time_s": 0.0,
+    }
 
     # Unified telemetry (telemetry/): schema_version + twins +
     # telemetry_overhead_frac are ALWAYS emitted — zeros-clean when nothing

@@ -1,0 +1,32 @@
+"""Corrected twin of ``planted_snapshot_race.py`` — both safe shapes.
+
+Shape 1 drains the pending write (``wait_for_checkpoint``) before donating,
+so the background reader is finished by the time XLA reuses the buffers.
+Shape 2 rebinds the name from the donating call's result before the next
+initiator sees it, so each async write only ever holds buffers no later
+step donates.  GL206 must stay quiet on both.
+"""
+
+import jax
+
+
+def _train_step(state, batch):
+    return {"params": state["params"] * 0.9 + batch.mean()}
+
+
+jitted_step = jax.jit(_train_step, donate_argnums=(0,))
+
+
+def drain_then_train(acc, state, batch):
+    acc.save_state(train_state=state, async_save=True)
+    acc.wait_for_checkpoint()  # background read fenced before donation
+    new_state = jitted_step(state, batch)
+    return new_state
+
+
+def train_then_snapshot_next(acc, state, batch):
+    new_state = jitted_step(state, batch)
+    acc.save_state(train_state=new_state, async_save=True)
+    # `state` was donated BEFORE the initiator armed, and the initiator
+    # holds `new_state`, which is never donated here.
+    return new_state

@@ -1,0 +1,31 @@
+"""PLANTED BUG — donate-under-pending-snapshot (GL206), minimal.
+
+``save_state(async_save=True)`` returns as soon as the background orbax
+writer is armed; the writer then reads the handed-in train state's buffers
+off the step critical path.  Donating that SAME name to the compiled step
+before the write drains re-opens the aliasing race the sharding-preserving
+copy in ``save_accelerator_state`` exists to close: checkpoint N can land
+with step N+1's values.  This module reproduces the exact caller shape the
+AST engine must flag (GL206): the name goes to an ``async_save=True``
+initiator, then into a donated position, with no rebind or drain between.
+
+Never imported by the suite — linted as source only.  The corrected twin
+lives in ``clean_snapshot_race.py``.
+"""
+
+import jax
+
+
+def _train_step(state, batch):
+    return {"params": state["params"] * 0.9 + batch.mean()}
+
+
+jitted_step = jax.jit(_train_step, donate_argnums=(0,))
+
+
+def snapshot_then_train(acc, state, batch):
+    acc.save_state(train_state=state, async_save=True)
+    # BUG: the background writer may still be reading `state`'s buffers
+    # while the donated step overwrites them in place.
+    new_state = jitted_step(state, batch)
+    return new_state

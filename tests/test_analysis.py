@@ -515,6 +515,15 @@ def test_fixture_donate_race_planted_vs_fixed():
     assert not fixed.unsuppressed(), fixed.render()
 
 
+def test_fixture_snapshot_race_planted_vs_clean():
+    """GL206: donating a name an async_save=True initiator still holds is
+    flagged; draining (wait_for_checkpoint) or rebinding first is quiet."""
+    planted = lint_paths([FIXTURES / "planted_snapshot_race.py"], excludes=())
+    assert _rules_of(planted) == {"GL206"}, planted.render()
+    clean = lint_paths([FIXTURES / "clean_snapshot_race.py"], excludes=())
+    assert not clean.unsuppressed(), clean.render()
+
+
 def test_fixture_ast_planted_all_rules_fire():
     rep = lint_paths([FIXTURES / "planted_ast_rules.py"], excludes=())
     assert _rules_of(rep) == {"GL202", "GL203", "GL204"}, rep.render()

@@ -35,20 +35,31 @@ from accelerate_tpu.checkpointing import (
     write_checkpoint_manifest,
 )
 from accelerate_tpu.resilience import (
+    CORRUPTION_MODES,
+    FAULT_KINDS,
     RESUME_EXIT_CODE,
     FaultEvent,
     FaultPlan,
     GoodputTracker,
     InjectedTransferError,
     NanGuardAbort,
+    PeerSchemaError,
+    PeerSnapshotter,
     PreemptionHandler,
+    RankLostError,
     RetryPolicy,
+    capture_host_snapshot,
+    check_snapshot_schemas,
     corrupt_checkpoint,
     fault_plan,
     goodput_accounting,
     install_fault_plan,
+    peer_ckpt_accounting,
+    restore_host_snapshot,
+    snapshot_schema,
     with_retries,
 )
+from accelerate_tpu.resilience.faults import KIND_DEFAULT_SITE
 from accelerate_tpu.test_utils.training import (
     make_regression_loader,
     regression_init_params,
@@ -746,10 +757,12 @@ def test_resilience_plugin_env_defaults(monkeypatch):
         ResiliencePlugin(max_consecutive_nan_skips=-1)
 
 
-def test_retention_gc_vs_fallback_scan(tmp_path):
+@pytest.mark.parametrize("mode", CORRUPTION_MODES)
+def test_retention_gc_vs_fallback_scan(tmp_path, mode):
     """Satellite: rank-0 GC must never delete the checkpoint a fallback
-    load_state scan could still select — with the latest corrupt, the
-    previous valid one survives retention and the resume lands on it."""
+    load_state scan could still select — with the latest corrupt (every
+    CORRUPTION_MODES entry), the previous valid one survives retention and
+    the resume lands on it."""
     acc, dl, state, step = _setup(tmp_path, total_limit=2)
     it = iter(dl)
     state, _ = step(state, next(it))
@@ -758,7 +771,7 @@ def test_retention_gc_vs_fallback_scan(tmp_path):
     state, _ = step(state, next(it))
     acc.save_state(train_state=state)          # checkpoint_1
     ckpts = list_checkpoints(str(tmp_path))
-    corrupt_checkpoint(ckpts[-1], mode="truncate", seed=0)  # newest now corrupt
+    corrupt_checkpoint(ckpts[-1], mode=mode, seed=0)  # newest now corrupt
 
     # next save triggers GC at total_limit=2: the naive victim is
     # checkpoint_0 — but it is the only valid fallback candidate
@@ -773,3 +786,147 @@ def test_retention_gc_vs_fallback_scan(tmp_path):
     survivors = [os.path.basename(c) for c in list_checkpoints(str(tmp_path))]
     assert "checkpoint_0" not in survivors
     assert "checkpoint_3" in survivors
+
+
+@pytest.mark.parametrize("mode", CORRUPTION_MODES)
+def test_presumed_valid_for_gc_every_corruption_mode(tmp_path, mode):
+    """GC's validity oracle agrees with the full verify for every
+    corruption flavor: valid → True (and stat-snapshot refreshed), then
+    corrupted in place → the stat drift forces the crc re-verify → False."""
+    from accelerate_tpu.checkpointing import _presumed_valid_for_gc
+
+    acc, dl, state, step = _setup(tmp_path)
+    ckpt = Path(acc.save_state(train_state=state))
+    assert _presumed_valid_for_gc(ckpt) is True
+    corrupt_checkpoint(ckpt, mode=mode, seed=2)
+    assert verify_checkpoint(ckpt)[0] is False
+    assert _presumed_valid_for_gc(ckpt) is False
+    # still False on re-ask: a failed verify must not poison the snapshot
+    # cache into presuming the corrupt dir valid next round
+    assert _presumed_valid_for_gc(ckpt) is False
+
+
+# ---------------------------------------------------------------------------
+# peer-redundant hot checkpoints + the recovery ladder (single process; the
+# cross-rank legs live in tests/test_train_fabric.py, slow tier)
+# ---------------------------------------------------------------------------
+
+
+def test_new_fault_kinds_registered():
+    for kind in ("rank_loss", "straggler", "partial_ckpt"):
+        assert kind in FAULT_KINDS
+    assert KIND_DEFAULT_SITE["rank_loss"] == "step"
+    assert KIND_DEFAULT_SITE["straggler"] == "step"
+    assert KIND_DEFAULT_SITE["partial_ckpt"] == "peer_snapshot"
+    # the default-site table covers every kind — a new kind without a site
+    # would silently never fire
+    assert set(KIND_DEFAULT_SITE) == set(FAULT_KINDS)
+    assert issubclass(RankLostError, RuntimeError)
+
+
+def test_goodput_state_dict_roundtrip():
+    t = GoodputTracker()
+    for _ in range(5):
+        t.record_step()
+    t.record_nan_skip(2)
+    t.record_restart(steps_recomputed=3, time_lost_s=1.5)
+    t.record_preemption()
+    sd = t.state_dict()
+    assert sd["steps"] == 5 and sd["preemptions"] == 1
+    assert "started_at" not in sd  # per-incarnation on purpose
+
+    fresh = GoodputTracker()
+    fresh.load_state_dict(sd)
+    assert fresh.state_dict() == sd
+    # partial dicts (older checkpoints) load what they have, keep the rest
+    partial = GoodputTracker()
+    partial.load_state_dict({"steps": 7})
+    assert partial.steps == 7 and partial.restarts == 0
+
+
+def test_goodput_counters_persist_through_save_load(tmp_path):
+    acc, dl, state, step = _setup(tmp_path)
+    acc.goodput.record_nan_skip(3)
+    acc.goodput.record_restart(steps_recomputed=2)
+    ckpt = acc.save_state(train_state=state)
+
+    acc.goodput.load_state_dict({k: 0 for k in acc.goodput.state_dict()})
+    template = acc.create_train_state(regression_init_params(), optax.adam(0.05))
+    acc.load_state(ckpt, train_state=template)
+    assert acc.goodput.nan_skips == 3
+    assert acc.goodput.restarts == 1
+    assert acc.goodput.steps_recomputed == 2
+
+
+def test_host_snapshot_roundtrip_and_schema_gate(tmp_path):
+    acc, dl, state, step = _setup(tmp_path)
+    state, _ = step(state, next(iter(dl)))
+    snap = capture_host_snapshot(state, step=1)
+    assert snap.verify()
+    assert snap.nbytes == snapshot_schema(state)["snapshot_bytes"]
+    # the accounting model predicts exactly what capture measures
+    assert peer_ckpt_accounting(state)["snapshot_bytes"] == snap.nbytes
+
+    restored = restore_host_snapshot(snap, state)
+    assert _bytes_of(restored.params["a"]) == _bytes_of(state.params["a"])
+    assert _bytes_of(jax.random.key_data(restored.rng)) == _bytes_of(
+        jax.random.key_data(state.rng))
+
+    other = acc.create_train_state({"a": jnp.zeros((3,))}, optax.sgd(0.1))
+    with pytest.raises(PeerSchemaError):
+        check_snapshot_schemas(snapshot_schema(state), snapshot_schema(other))
+
+
+def test_peer_snapshotter_crc_gate_and_recover_single_process(tmp_path):
+    acc, dl, state, step = _setup(tmp_path)
+    snapper = PeerSnapshotter(state, 1)
+    state, _ = step(state, next(iter(dl)))
+    snapper.maybe_snapshot(state, 1)
+    assert snapper.newest_restorable_step() == 1
+    # the prepared step donates its input: read wave-1's expectation NOW,
+    # before state's buffers are reused in place by the next step
+    want = _bytes_of(state.params["a"])
+
+    # torn wave: the injected partial_ckpt flips a stored byte — verify()
+    # catches it and recover() skips the wave (at=1: the occurrence counter
+    # is per-plan, and this plan sees only the second snapshot)
+    install_fault_plan(FaultPlan([FaultEvent("partial_ckpt", at=1)]))
+    state2, _ = step(state, next(iter(dl)))
+    snapper.maybe_snapshot(state2, 2)
+    got, agreed = snapper.recover(state2)
+    assert agreed == 1  # wave 2 dropped by the crc gate
+    assert _bytes_of(got.params["a"]) == want
+
+
+def test_accelerator_recover_ladder_single_process(tmp_path):
+    """The three rungs in order: peer RAM (newest, fewest steps replayed),
+    verified disk, fresh start — with the report the bench surface emits."""
+    plugin = ResiliencePlugin(peer_snapshot_every=2)
+    acc, dl, state, step = _setup(tmp_path, plugin=plugin)
+    it = iter(dl)
+    for i in range(3):
+        state, _ = step(state, next(it))
+        if acc.step_count == 1:
+            acc.save_state(train_state=state)        # disk @ step 1
+    assert acc.peer_snapshotter.newest_restorable_step() == 2
+
+    restored, report = acc.recover(train_state=state, load_sampler_states=False)
+    assert report["restore_path"] == "peer"
+    assert report["restored_step"] == 2 and acc.step_count == 2
+    assert report["peer_snapshot_bytes"] > 0
+
+    acc.peer_snapshotter.forget_local()              # rank-local RAM gone
+    restored, report = acc.recover(train_state=state, load_sampler_states=False)
+    assert report["restore_path"] == "disk"
+    assert report["restored_step"] == 1 and acc.step_count == 1
+    assert report["steps_recomputed"] == 1           # step 2 replayed
+
+    acc.peer_snapshotter.reset()
+    import shutil
+    shutil.rmtree(Path(tmp_path) / "checkpoints")
+    restored, report = acc.recover(train_state=state, load_sampler_states=False)
+    assert report["restore_path"] == "fresh"
+    assert restored is None and acc.step_count == 0
+    # peer rung counted a restart; the disk rung RESTORED the persisted
+    # counters (saved with restarts=0) before counting its own; fresh adds 1
+    assert acc.goodput.restarts == 2

@@ -313,9 +313,24 @@ def test_all_standard_twins_register_from_their_accounting_sites():
     reg.record("fleet.adapter_pool_hit_rate", predicted=0.75, measured=0.5,
                source="serving/router.fleet_replay")
 
+    # 23-24. recovery rows (resilience/peer_ckpt + Accelerator.recover):
+    # the accounting model records the predicted wave bytes; the
+    # snapshotter's capture and the ladder walk record the measured sides
+    # (tests/test_resilience.py + the 2-proc fabric drive the real sites)
+    from accelerate_tpu.resilience.peer_ckpt import peer_ckpt_accounting
+
+    acct = peer_ckpt_accounting({"w": np.ones((4, 4), np.float32)})
+    reg.record_measured("recovery.peer_snapshot_bytes",
+                        float(acct["snapshot_bytes"]),
+                        source="resilience/peer_ckpt.PeerSnapshotter")
+    reg.record_measured("recovery.restore_time_s", 0.01,
+                        source="Accelerator.recover")
+
     rows = reg.drift_report()
     for name in STANDARD_TWINS:
         assert name in rows, name
+    # capture measures exactly what the model predicts (tolerance 0.0)
+    assert rows["recovery.peer_snapshot_bytes"]["status"] == "ok"
     # pairs that recorded both sides carry a real rel_err status
     for paired in ("dcn_comm.dcn_bytes", "kv_pool.utilization",
                    "adapter_pool.hit_rate", "goodput.goodput_frac",

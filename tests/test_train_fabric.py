@@ -1,0 +1,108 @@
+"""2-process training recovery-ladder fabric (slow tier; `make chaos`).
+
+Drives ``test_utils/scripts/train_fabric.py`` through REAL
+``accelerate_tpu launch`` subprocess gangs (2 procs x 1 CPU device, mesh
+dcn=2) and pins the recovery acceptance criteria from docs/resilience.md:
+
+- peer-RAM rung beats the disk rung (fewer steps replayed) when a fresh
+  buddy wave exists; a ``partial_ckpt`` torn wave is dropped by the crc
+  gate and the gang agrees one wave back; with snapshots disarmed the
+  verified-disk rung catches;
+- every recovered pass continues bitwise = the uninterrupted reference,
+  with zero new compiles once warmed;
+- ``recovery.peer_snapshot_bytes`` matches the ``peer_ckpt_accounting``
+  model exactly (twin tolerance 0);
+- a straggler/SIGTERM mismatch at the same nominal step drains to ONE
+  agreed boundary, one consistent emergency checkpoint, exit 75, and a
+  ``launch --resume`` picks it up bitwise with restored goodput counters.
+
+The single-process flavors of these pins live in tests/test_resilience.py;
+this module is the only place the cross-rank legs (buddy exchange, agreed
+stop at mismatched boundaries, re-send on rank loss) actually execute.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from accelerate_tpu.checkpointing import METADATA_NAME, list_checkpoints
+from accelerate_tpu.test_utils import train_fabric_script_path
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _launch(mode, work, resume=False, expect_code=0):
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+           "--num_processes", "2", "--num_cpu_devices", "1"]
+    if resume:
+        cmd.append("--resume")
+    cmd.append(str(train_fabric_script_path()))
+    # scrub inherited gang/fault env so nested launches start clean
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("ACCELERATE_", "PARALLELISM_CONFIG_", "FSDP_"))}
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), env.get("PYTHONPATH")) if p
+    )
+    env.update({"TRAIN_FABRIC_MODE": mode, "TRAIN_FABRIC_DIR": str(work)})
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=900,
+                       env=env)
+    assert r.returncode == expect_code, (
+        f"{mode} exited {r.returncode} (want {expect_code})\n"
+        f"--- stdout ---\n{r.stdout[-3000:]}\n--- stderr ---\n{r.stderr[-3000:]}"
+    )
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    return json.loads(lines[-1]) if lines else None
+
+
+def test_chaos_recovery_ladder_two_process(tmp_path):
+    """rank_loss x {fresh peer wave, torn wave, disarmed} → peer, peer-1,
+    disk rungs; bitwise parity; zero warm compiles; exact bytes twin."""
+    chaos = _launch("chaos", tmp_path / "chaos")
+    assert chaos["num_processes"] == 2
+    assert chaos["predicted_bytes"] == chaos["measured_bytes"], (
+        "recovery.peer_snapshot_bytes twin drifted (tolerance 0)")
+
+    a, b, c = chaos["pass_a"], chaos["pass_b"], chaos["pass_c"]
+    # fresh wave: peer rung restores NEWER state than the step-4 disk ckpt
+    assert a["restore_path"] == "peer"
+    assert a["restored_step"] > chaos["disk_step_a"]
+    assert a["steps_recomputed"] == 0
+    # torn wave dropped by crc → gang agrees one wave back, still peer
+    assert b["restore_path"] == "peer"
+    assert b["restored_step"] < a["restored_step"]
+    # snapshots disarmed → verified disk checkpoint catches
+    assert c["restore_path"] == "disk"
+    assert all(p["parity"] for p in (a, b, c)), chaos
+    assert chaos["compiles_passes_bc"] == 0
+
+
+def test_agreed_preemption_then_resume_bitwise(tmp_path):
+    """Straggler on rank 0 vs SIGTERM on rank 1 at the same nominal step:
+    one agreed boundary, one emergency checkpoint, exit 75; --resume
+    continues bitwise with zero post-warmup compiles."""
+    work = tmp_path / "preempt"
+    _launch("preempt", work, expect_code=75)
+
+    ckpts = list_checkpoints(str(work))
+    assert len(ckpts) == 1, "exactly one agreed emergency checkpoint"
+    meta = json.loads((Path(ckpts[0]) / METADATA_NAME).read_text())
+    assert meta["step_count"] == 5
+    assert meta["goodput"]["preemptions"] == 1  # satellite: counters persist
+    rng_shards = sorted(Path(ckpts[0]).glob("random_states_*.pkl"))
+    assert [p.name for p in rng_shards] == [
+        "random_states_0.pkl", "random_states_1.pkl"]
+
+    resumed = _launch("resume", work, resume=True)
+    assert resumed["start"] == 5
+    # the resumed tail is bitwise = the uninterrupted reference tail
+    assert resumed["losses"] == resumed["ref_losses"][5:]
+    assert resumed["compiles_after_first"] == 0
+    assert resumed["goodput_restarts"] == 1
